@@ -1,0 +1,16 @@
+"""elasticdl_tpu: a TPU-native elastic distributed training framework.
+
+Re-implements the capabilities of ElasticDL (reference:
+elasticdl/python/* in typhoonzero/elasticdl) with an idiomatic
+JAX/XLA/pjit design:
+
+- dynamic task dispatch for elasticity (master/task_dispatcher.py)
+- jitted ``value_and_grad`` worker steps; sync data parallelism is an
+  in-step XLA collective over a ``jax.sharding.Mesh`` instead of a gRPC
+  parameter-server round trip
+- row-sharded sparse embedding tables in device HBM with all-to-all
+  lookup/update (parallel/embedding_sharding.py)
+- host-level gRPC control plane for tasks/eval/checkpoint triggers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,101 @@
+"""Framework-wide constants and enums.
+
+Parity: reference `elasticdl/python/common/constants.py` and the TaskType /
+GetModel-method enums in `elasticdl/proto/elasticdl.proto:8-19`.
+"""
+
+import enum
+
+
+class TaskType(enum.IntEnum):
+    """Task types dispatched by the master.
+
+    Mirrors the reference proto enum (elasticdl.proto:8-14): WAIT tells a
+    worker to stand by because new tasks (e.g. a deferred SAVE_MODEL task or
+    a new membership epoch) may still arrive.
+    """
+
+    TRAINING = 0
+    EVALUATION = 1
+    PREDICTION = 2
+    WAIT = 3
+    SAVE_MODEL = 4
+
+
+class GetModelMethod(enum.IntEnum):
+    """How a worker asks for the model (elasticdl.proto:16-19).
+
+    MINIMUM: any version >= the requested one (returns current).
+    FIXED: exactly the requested version (served from a checkpoint if the
+    live model has moved on) — used by evaluation for pinned snapshots.
+    """
+
+    MINIMUM = 0
+    FIXED = 1
+
+
+class Mode:
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+
+
+class JobType:
+    TRAINING_ONLY = "training_only"
+    EVALUATION_ONLY = "evaluation_only"
+    PREDICTION_ONLY = "prediction_only"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+
+
+class DistributionStrategy:
+    """Distribution strategies.
+
+    PARAMETER_SERVER keeps the reference's host-PS semantics (sync/async
+    gradient push-pull; needed for sparse/async parity). ALLREDUCE is the
+    TPU-native fast path: the gradient exchange is an XLA collective over
+    ICI inside the jitted step, not an RPC. LOCAL is single-process.
+    """
+
+    PARAMETER_SERVER = "ParameterServerStrategy"
+    ALLREDUCE = "AllreduceStrategy"
+    LOCAL = "Local"
+
+
+class GRPC:
+    # The reference raises gRPC message caps to 256 MB because full dense
+    # models ride RPC (common/constants.py:1-5). We keep the caps for the
+    # control plane / host-PS mode; the ALLREDUCE path never ships tensors
+    # over gRPC.
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class InstanceManagerStatus:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    FINISHED = "Finished"
+
+
+class MetricsDictKey:
+    MODEL_OUTPUT = "output"
+    LABEL = "label"
+
+
+class SaveModelConfig:
+    SAVED_MODEL_PATH = "saved_model_path"
+
+
+class TaskExecCounterKey:
+    FAIL_COUNT = "fail_count"
+
+
+class ODPSConfig:
+    PROJECT_NAME = "ODPS_PROJECT_NAME"
+    ACCESS_ID = "ODPS_ACCESS_ID"
+    ACCESS_KEY = "ODPS_ACCESS_KEY"
+    ENDPOINT = "ODPS_ENDPOINT"
+
+
+# Worker-side cap on retries of one minibatch after the master/PS rejects a
+# stale-version gradient (reference worker.py:40).
+MAX_MINIBATCH_RETRY_NUM = 64

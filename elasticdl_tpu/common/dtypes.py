@@ -1,0 +1,56 @@
+"""numpy <-> wire dtype maps.
+
+Parity: reference common/dtypes.py:23-43 + proto enum tensor_dtype.proto:6-18.
+Extended with bfloat16 (first-class on TPU) via ml_dtypes.
+"""
+
+import numpy as np
+
+try:  # bfloat16 numpy dtype ships with jax
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+# wire name -> numpy dtype
+_NAME_TO_NP = {
+    "int8": np.dtype(np.int8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "uint8": np.dtype(np.uint8),
+    "uint16": np.dtype(np.uint16),
+    "uint32": np.dtype(np.uint32),
+    "uint64": np.dtype(np.uint64),
+    "float16": np.dtype(np.float16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "bool": np.dtype(np.bool_),
+}
+if _BF16 is not None:
+    _NAME_TO_NP["bfloat16"] = _BF16
+
+_NP_TO_NAME = {v: k for k, v in _NAME_TO_NP.items()}
+
+
+def dtype_numpy_to_name(dtype):
+    """Wire name for a numpy dtype; raises on unsupported dtypes."""
+    dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    if dtype not in _NP_TO_NAME:
+        raise ValueError("Unsupported tensor dtype: %s" % dtype)
+    return _NP_TO_NAME[dtype]
+
+
+def dtype_name_to_numpy(name):
+    if name not in _NAME_TO_NP:
+        raise ValueError("Unsupported wire dtype name: %s" % name)
+    return _NAME_TO_NP[name]
+
+
+def is_numpy_dtype_allowed(dtype):
+    try:
+        dtype_numpy_to_name(dtype)
+        return True
+    except ValueError:
+        return False

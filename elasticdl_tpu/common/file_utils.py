@@ -1,0 +1,14 @@
+"""Small filesystem helpers (parity: reference common/file_utils.py:7-17)."""
+
+import os
+import shutil
+
+
+def copy_if_not_exists(src, dst, is_dir=False):
+    if os.path.exists(dst):
+        return
+    if is_dir:
+        shutil.copytree(src, dst)
+    else:
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        shutil.copy(src, dst)

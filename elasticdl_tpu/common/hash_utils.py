@@ -1,0 +1,45 @@
+"""Deterministic partition/placement hashing.
+
+Parity: reference common/hash_utils.py:4-49 — variable->PS-shard placement
+by name hash, embedding-row->shard placement by id modulo, and a scatter
+helper grouping (values, ids) per shard. The same functions drive the
+TPU-native row-sharded embedding layout (shard = mesh slice instead of a PS
+pod), so checkpoint/restore row placement is stable across backends.
+"""
+
+import hashlib
+
+import numpy as np
+
+
+def string_to_id(name, bucket_num):
+    """Stable shard id for a parameter name (sha256 % buckets)."""
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive")
+    digest = hashlib.sha256(name.encode("utf-8")).hexdigest()
+    return int(digest, 16) % bucket_num
+
+
+def int_to_id(number, bucket_num):
+    """Shard id for an embedding row id (id % buckets)."""
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive")
+    return int(number) % bucket_num
+
+
+def scatter_embedding_vector(values, ids, bucket_num):
+    """Group rows per shard: returns {shard_id: (values_subset, ids_subset)}.
+
+    ``values`` is (n, dim); ``ids`` is (n,). Vectorized (the reference loops
+    per element, hash_utils.py:14-49).
+    """
+    values = np.asarray(values)
+    ids = np.asarray(ids, dtype=np.int64)
+    if values.shape[0] != ids.shape[0]:
+        raise ValueError("values and ids must have the same leading dim")
+    shard_ids = ids % bucket_num
+    result = {}
+    for shard in np.unique(shard_ids):
+        mask = shard_ids == shard
+        result[int(shard)] = (values[mask], ids[mask])
+    return result

@@ -1,0 +1,26 @@
+"""Uniform stderr logging (parity: reference common/log_utils.py:5-30)."""
+
+import logging
+
+_LOGGER_CACHE = {}
+
+_FORMAT = (
+    "[%(asctime)s] [%(levelname)s] "
+    "[%(filename)s:%(lineno)d:%(funcName)s] %(message)s"
+)
+
+
+def get_logger(name, level=logging.INFO, handler_stream=None):
+    if name in _LOGGER_CACHE:
+        return _LOGGER_CACHE[name]
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    handler = logging.StreamHandler(handler_stream)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.propagate = False
+    _LOGGER_CACHE[name] = logger
+    return logger
+
+
+default_logger = get_logger("elasticdl_tpu")

@@ -1,0 +1,17 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is unavailable in CI; all sharding/collective tests run
+against ``--xla_force_host_platform_device_count=8`` CPU devices, mirroring
+the reference's "fake the cluster in one process" test strategy
+(reference tests/in_process_master.py).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

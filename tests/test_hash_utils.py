@@ -1,0 +1,37 @@
+"""Partition hashing tests (parity: reference tests/hash_utils_test.py)."""
+
+import unittest
+
+import numpy as np
+
+from elasticdl_tpu.common.hash_utils import (
+    int_to_id,
+    scatter_embedding_vector,
+    string_to_id,
+)
+
+
+class HashUtilsTest(unittest.TestCase):
+    def test_string_to_id_stable_and_bounded(self):
+        for name in ("dense/kernel", "dense/bias", "emb"):
+            sid = string_to_id(name, 4)
+            self.assertEqual(sid, string_to_id(name, 4))
+            self.assertTrue(0 <= sid < 4)
+
+    def test_int_to_id(self):
+        self.assertEqual(int_to_id(10, 4), 2)
+        self.assertEqual(int_to_id(3, 4), 3)
+
+    def test_scatter_embedding_vector(self):
+        values = np.arange(12, dtype=np.float32).reshape(6, 2)
+        ids = np.array([0, 1, 2, 3, 4, 8])
+        groups = scatter_embedding_vector(values, ids, 4)
+        np.testing.assert_array_equal(groups[0][1], [0, 4, 8])
+        np.testing.assert_array_equal(groups[1][1], [1])
+        np.testing.assert_array_equal(
+            groups[0][0], values[np.array([0, 4, 5])]
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

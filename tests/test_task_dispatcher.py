@@ -1,0 +1,109 @@
+"""Dispatcher lifecycle tests (parity: reference tests/task_dispatcher_test.py)."""
+
+import unittest
+
+from elasticdl_tpu.common.constants import TaskType
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+class TaskDispatcherTest(unittest.TestCase):
+    def test_create_tasks_with_zero_start_ind(self):
+        task_d = TaskDispatcher({"f1": (0, 10), "f2": (0, 10)}, {}, {}, 3, 1)
+
+        all_tasks = [
+            ("f1", 0, 3, TaskType.TRAINING, -1),
+            ("f1", 3, 6, TaskType.TRAINING, -1),
+            ("f1", 6, 9, TaskType.TRAINING, -1),
+            ("f1", 9, 10, TaskType.TRAINING, -1),
+            ("f2", 0, 3, TaskType.TRAINING, -1),
+            ("f2", 3, 6, TaskType.TRAINING, -1),
+            ("f2", 6, 9, TaskType.TRAINING, -1),
+            ("f2", 9, 10, TaskType.TRAINING, -1),
+        ]
+
+        got_tasks = [task_d.get(i // 2) for i in range(8)]
+        self.assertEqual(list(range(1, 9)), [k for k, _ in got_tasks])
+        self.assertEqual(sorted(v._info() for _, v in got_tasks), all_tasks)
+
+        # drained
+        self.assertEqual((-1, None), task_d.get(10))
+
+        for t in (1, 3, 5, 7, 2, 8):
+            task_d.report(t, True)
+        self.assertEqual(2, len(task_d._doing))
+
+        # failure requeues
+        task_d.report(next(iter(task_d._doing)), False)
+        self.assertEqual(1, len(task_d._doing))
+
+        # dead-worker recovery requeues in-flight tasks
+        task_d.recover_tasks(next(iter(task_d._doing.values()))[0])
+        self.assertEqual(0, len(task_d._doing))
+        self.assertEqual(2, len(task_d._todo))
+
+        id1, _ = task_d.get(11)
+        id2, _ = task_d.get(12)
+        task_d.report(id1, True)
+        task_d.report(id2, True)
+        self.assertTrue(task_d.finished())
+
+    def test_create_tasks_with_non_zero_start_ind(self):
+        task_d = TaskDispatcher({"f1": (0, 10), "f2": (10, 10)}, {}, {}, 3, 1)
+        all_tasks = [
+            ("f1", 0, 3, TaskType.TRAINING, -1),
+            ("f1", 3, 6, TaskType.TRAINING, -1),
+            ("f1", 6, 9, TaskType.TRAINING, -1),
+            ("f1", 9, 10, TaskType.TRAINING, -1),
+            ("f2", 10, 13, TaskType.TRAINING, -1),
+            ("f2", 13, 16, TaskType.TRAINING, -1),
+            ("f2", 16, 19, TaskType.TRAINING, -1),
+            ("f2", 19, 20, TaskType.TRAINING, -1),
+        ]
+        got_tasks = [task_d.get(i // 2) for i in range(8)]
+        self.assertEqual(list(range(1, 9)), [k for k, _ in got_tasks])
+        self.assertEqual(sorted(v._info() for _, v in got_tasks), all_tasks)
+
+    def test_epoch_rollover(self):
+        task_d = TaskDispatcher({"f1": (0, 10), "f2": (0, 10)}, {}, {}, 3, 2)
+        epoch_tasks = [
+            ("f1", 0, 3, TaskType.TRAINING, -1),
+            ("f1", 3, 6, TaskType.TRAINING, -1),
+            ("f1", 6, 9, TaskType.TRAINING, -1),
+            ("f1", 9, 10, TaskType.TRAINING, -1),
+            ("f2", 0, 3, TaskType.TRAINING, -1),
+            ("f2", 3, 6, TaskType.TRAINING, -1),
+            ("f2", 6, 9, TaskType.TRAINING, -1),
+            ("f2", 9, 10, TaskType.TRAINING, -1),
+        ]
+        for _ in range(2):
+            got_tasks = [task_d.get(i // 2) for i in range(8)]
+            self.assertEqual(
+                sorted(v._info() for _, v in got_tasks), epoch_tasks
+            )
+
+    def test_invoke_save_model_callback(self):
+        task_d = TaskDispatcher({"f1": (0, 10), "f2": (0, 10)}, {}, {}, 3, 1)
+        task_d.add_deferred_callback_create_save_model_task("/saved_models/")
+        task_d._todo.clear()
+        task_d.invoke_deferred_callback()
+        self.assertEqual(len(task_d._todo), 1)
+        self.assertEqual(task_d._todo[0].type, TaskType.SAVE_MODEL)
+
+    def test_eval_tasks(self):
+        task_d = TaskDispatcher({}, {"e1": (0, 6)}, {}, 3, 1)
+        tid, task = task_d.get_eval_task(0)
+        self.assertEqual(task.type, TaskType.EVALUATION)
+        task_d.report(tid, False)  # failed eval goes back on eval queue
+        self.assertEqual(2, len(task_d._eval_todo))
+        ids = []
+        for _ in range(2):
+            tid, task = task_d.get_eval_task(0)
+            ids.append(tid)
+        self.assertEqual((-1, None), task_d.get_eval_task(0))
+        for tid in ids:
+            task_d.report(tid, True)
+        self.assertTrue(task_d.finished())
+
+
+if __name__ == "__main__":
+    unittest.main()

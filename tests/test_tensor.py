@@ -1,0 +1,85 @@
+"""Tensor codec tests (parity: reference tests/tensor_test.py)."""
+
+import unittest
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor import (
+    Tensor,
+    deserialize_tensor,
+    deserialize_tensors,
+    named_arrays_to_pytree,
+    pytree_to_named_arrays,
+    serialize_tensor,
+    serialize_tensors,
+)
+
+
+class TensorCodecTest(unittest.TestCase):
+    def test_dense_roundtrip(self):
+        arr = np.random.randn(4, 7).astype(np.float32)
+        t = Tensor("w", arr)
+        t2 = deserialize_tensor(serialize_tensor(t))
+        self.assertEqual(t2.name, "w")
+        np.testing.assert_array_equal(t2.values, arr)
+        self.assertIsNone(t2.indices)
+
+    def test_sparse_roundtrip(self):
+        arr = np.random.randn(3, 5).astype(np.float32)
+        idx = np.array([9, 2, 4], dtype=np.int64)
+        t2 = deserialize_tensor(serialize_tensor(Tensor("e", arr, idx)))
+        self.assertTrue(t2.is_indexed_slices())
+        np.testing.assert_array_equal(t2.values, arr)
+        np.testing.assert_array_equal(t2.indices, idx)
+
+    def test_dtypes(self):
+        for dtype in (np.int32, np.int64, np.float64, np.float16, np.bool_):
+            arr = np.ones((2, 2), dtype=dtype)
+            t2 = deserialize_tensor(serialize_tensor(Tensor("x", arr)))
+            self.assertEqual(t2.values.dtype, np.dtype(dtype))
+
+    def test_bfloat16(self):
+        import ml_dtypes
+
+        arr = np.ones((3, 3), dtype=ml_dtypes.bfloat16)
+        t2 = deserialize_tensor(serialize_tensor(Tensor("b", arr)))
+        self.assertEqual(t2.values.dtype, np.dtype(ml_dtypes.bfloat16))
+
+    def test_add_dense(self):
+        a = Tensor("x", np.ones((2, 2), np.float32))
+        b = Tensor("x", 2 * np.ones((2, 2), np.float32))
+        np.testing.assert_array_equal(
+            (a + b).values, 3 * np.ones((2, 2), np.float32)
+        )
+
+    def test_add_sparse_concatenates(self):
+        a = Tensor("e", np.ones((2, 3), np.float32), np.array([0, 1]))
+        b = Tensor("e", np.ones((1, 3), np.float32), np.array([5]))
+        c = a + b
+        self.assertEqual(c.values.shape, (3, 3))
+        np.testing.assert_array_equal(c.indices, [0, 1, 5])
+
+    def test_multi_tensor_stream(self):
+        ts = [
+            Tensor("a", np.arange(6, dtype=np.float32).reshape(2, 3)),
+            Tensor("b", np.arange(4, dtype=np.int64), np.array([1, 3, 5, 7])),
+        ]
+        out = deserialize_tensors(serialize_tensors(ts))
+        self.assertEqual([t.name for t in out], ["a", "b"])
+        np.testing.assert_array_equal(out[1].indices, [1, 3, 5, 7])
+
+    def test_pytree_bridge(self):
+        tree = {
+            "dense": {"kernel": np.ones((3, 4), np.float32), "bias": np.zeros(4, np.float32)},
+            "out": {"kernel": np.full((4, 2), 2.0, np.float32)},
+        }
+        named = pytree_to_named_arrays(tree)
+        self.assertIn("dense/kernel", named)
+        restored = named_arrays_to_pytree(named, tree)
+        np.testing.assert_array_equal(
+            restored["out"]["kernel"], tree["out"]["kernel"]
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()
