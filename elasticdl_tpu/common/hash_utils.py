@@ -33,6 +33,8 @@ def scatter_embedding_vector(values, ids, bucket_num):
     ``values`` is (n, dim); ``ids`` is (n,). Vectorized (the reference loops
     per element, hash_utils.py:14-49).
     """
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive")
     values = np.asarray(values)
     ids = np.asarray(ids, dtype=np.int64)
     if values.shape[0] != ids.shape[0]:

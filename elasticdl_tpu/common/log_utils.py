@@ -11,15 +11,16 @@ _FORMAT = (
 
 
 def get_logger(name, level=logging.INFO, handler_stream=None):
-    if name in _LOGGER_CACHE:
-        return _LOGGER_CACHE[name]
+    key = (name, level, id(handler_stream))
+    if key in _LOGGER_CACHE:
+        return _LOGGER_CACHE[key]
     logger = logging.getLogger(name)
     logger.setLevel(level)
     handler = logging.StreamHandler(handler_stream)
     handler.setFormatter(logging.Formatter(_FORMAT))
     logger.addHandler(handler)
     logger.propagate = False
-    _LOGGER_CACHE[name] = logger
+    _LOGGER_CACHE[key] = logger
     return logger
 
 

@@ -57,6 +57,10 @@ class Tensor:
         Mirrors reference tensor.py:92-104 (used for sync gradient
         accumulation; duplicate sparse indices are resolved at apply time).
         """
+        if not isinstance(other, Tensor):
+            if other == 0:  # support sum(tensors)
+                return self
+            return NotImplemented
         if self.is_indexed_slices() != other.is_indexed_slices():
             raise ValueError("cannot add sparse and dense tensors")
         if self.is_indexed_slices():

@@ -1,0 +1,179 @@
+"""Data reader contract + implementations + factory.
+
+Parity: reference data/data_reader.py:17-196 — ``AbstractDataReader``
+(read_records(task) / create_shards() / metadata), ``RecordIODataReader``
+(per-file record indices), ``ODPSDataReader`` (table slices), and an
+env-var-driven factory. The RecordIO backend here is the framework's own
+EDLR format (see recordio.py); the ODPS backend is import-gated on the odps
+SDK exactly like the reference is.
+"""
+
+import os
+from abc import ABC, abstractmethod
+
+from elasticdl_tpu.common.constants import ODPSConfig
+from elasticdl_tpu.data.recordio import RecordIOReader
+
+
+class Metadata:
+    def __init__(self, column_names=None):
+        self.column_names = column_names
+
+
+class AbstractDataReader(ABC):
+    def __init__(self, **kwargs):
+        pass
+
+    @abstractmethod
+    def read_records(self, task):
+        """Yield raw records for ``task`` (records [task.start, task.end) of
+        shard ``task.shard_name``)."""
+
+    @abstractmethod
+    def create_shards(self):
+        """Return {shard_name: (start_index, num_records)}."""
+
+    @property
+    def records_output_types(self):
+        """Element type hint for the dataset layer (bytes by default)."""
+        return bytes
+
+    @property
+    def metadata(self):
+        return Metadata()
+
+
+class RecordIODataReader(AbstractDataReader):
+    """Reads EDLR files from ``data_dir``; one shard per file.
+
+    Record indices are file-local, so every shard starts at 0 — same
+    convention as the reference (data_reader.py:79-87).
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        _check_required_kwargs(["data_dir"], kwargs)
+        self._kwargs = kwargs
+        self._readers = {}
+
+    def _reader(self, path):
+        if path not in self._readers:
+            self._readers[path] = RecordIOReader(path)
+        return self._readers[path]
+
+    def read_records(self, task):
+        yield from self._reader(task.shard_name).read_range(
+            task.start, task.end
+        )
+
+    def create_shards(self):
+        data_dir = self._kwargs["data_dir"]
+        shards = {}
+        for f in sorted(os.listdir(data_dir)):
+            p = os.path.join(data_dir, f)
+            with RecordIOReader(p) as r:
+                shards[p] = (0, len(r))
+        return shards
+
+    def close(self):
+        for r in self._readers.values():
+            r.close()
+        self._readers.clear()
+
+
+class ODPSDataReader(AbstractDataReader):
+    """Reads slices of an ODPS (MaxCompute) table.
+
+    Shards are named ``{table}:shard_{i}`` and sized ``records_per_task``
+    (reference data_reader.py:98-165). Requires the odps SDK at use time.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = kwargs
+        self._metadata = Metadata()
+
+    def _get_reader(self, table_name):
+        _check_required_kwargs(
+            ["project", "access_id", "access_key"], self._kwargs
+        )
+        from elasticdl_tpu.data.odps_io import ODPSReader
+
+        return ODPSReader(
+            project=self._kwargs["project"],
+            access_id=self._kwargs["access_id"],
+            access_key=self._kwargs["access_key"],
+            table=table_name,
+            endpoint=self._kwargs.get("endpoint"),
+        )
+
+    @staticmethod
+    def _table_of(shard_name):
+        return shard_name.split(":")[0]
+
+    def read_records(self, task):
+        reader = self._get_reader(self._table_of(task.shard_name))
+        if self._metadata.column_names is None:
+            columns = self._kwargs.get("columns")
+            self._metadata.column_names = (
+                reader.table_schema_names() if columns is None else columns
+            )
+        yield from reader.read_batch(
+            start=task.start,
+            end=task.end,
+            columns=self._metadata.column_names,
+        )
+
+    def create_shards(self):
+        _check_required_kwargs(["table", "records_per_task"], self._kwargs)
+        reader = self._get_reader(self._kwargs["table"])
+        prefix = self._kwargs["table"] + ":shard_"
+        table_size = reader.get_table_size()
+        rpt = self._kwargs["records_per_task"]
+        shards = {}
+        start = 0
+        for shard_id in range(table_size // rpt):
+            shards[prefix + str(shard_id)] = (start, rpt)
+            start += rpt
+        left = table_size % rpt
+        if left:
+            shards[prefix + str(table_size // rpt)] = (start, left)
+        return shards
+
+    @property
+    def metadata(self):
+        return self._metadata
+
+
+def create_data_reader(data_origin, records_per_task=None, **kwargs):
+    """ODPS when its env credentials are set, else RecordIO over a dir.
+
+    Mirrors reference data_reader.py:168-187.
+    """
+    if all(
+        k in os.environ
+        for k in (
+            ODPSConfig.PROJECT_NAME,
+            ODPSConfig.ACCESS_ID,
+            ODPSConfig.ACCESS_KEY,
+        )
+    ):
+        return ODPSDataReader(
+            project=os.environ[ODPSConfig.PROJECT_NAME],
+            access_id=os.environ[ODPSConfig.ACCESS_ID],
+            access_key=os.environ[ODPSConfig.ACCESS_KEY],
+            table=data_origin,
+            endpoint=os.environ.get(ODPSConfig.ENDPOINT),
+            records_per_task=records_per_task,
+            **kwargs,
+        )
+    return RecordIODataReader(data_dir=data_origin)
+
+
+def _check_required_kwargs(required_args, kwargs):
+    missing = [k for k in required_args if k not in kwargs]
+    if missing:
+        raise ValueError(
+            "The following required arguments are missing: %s"
+            % ", ".join(missing)
+        )

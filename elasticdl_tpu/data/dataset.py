@@ -1,0 +1,169 @@
+"""A tf.data-free streaming Dataset.
+
+The reference hands user ``dataset_fn``s a ``tf.data.Dataset`` built from a
+task-record generator (worker/task_data_service.py:126-188,
+data/dataset_utils.py:4-24). This shim preserves the same fluent surface —
+``map / filter / shuffle / batch / repeat / take / prefetch`` — over plain
+Python iterators yielding numpy-structured elements, so model-zoo
+``dataset_fn(dataset, mode, metadata)`` code ports contract-for-contract
+without TensorFlow.
+
+Elements are arbitrary pytrees (dicts/tuples) of np.ndarray-compatible
+leaves; ``batch`` stacks leaf-wise. ``prefetch`` runs the upstream pipeline
+in a daemon thread so host input overlaps TPU steps (the tf.data
+``prefetch(1)`` role in reference worker.py:779).
+"""
+
+import collections
+import queue
+import random as _random
+import threading
+
+import numpy as np
+
+
+def _tree_stack(elements):
+    """Stack a list of same-structure elements leaf-wise."""
+    first = elements[0]
+    if isinstance(first, dict):
+        return {
+            k: _tree_stack([e[k] for e in elements]) for k in first
+        }
+    if isinstance(first, (tuple, list)):
+        stacked = [
+            _tree_stack([e[i] for e in elements]) for i in range(len(first))
+        ]
+        return tuple(stacked) if isinstance(first, tuple) else stacked
+    return np.stack([np.asarray(e) for e in elements])
+
+
+class Dataset:
+    """Lazily-evaluated record stream; each transform returns a new Dataset."""
+
+    def __init__(self, gen_factory):
+        self._gen_factory = gen_factory
+
+    @staticmethod
+    def from_generator(gen_factory):
+        """gen_factory: zero-arg callable returning a fresh iterator."""
+        return Dataset(gen_factory)
+
+    @staticmethod
+    def from_tensors(elements):
+        elements = list(elements)
+        return Dataset(lambda: iter(elements))
+
+    def map(self, fn):
+        def gen():
+            for x in self._gen_factory():
+                yield fn(x)
+
+        return Dataset(gen)
+
+    def filter(self, pred):
+        def gen():
+            for x in self._gen_factory():
+                if pred(x):
+                    yield x
+
+        return Dataset(gen)
+
+    def shuffle(self, buffer_size, seed=None):
+        """Streaming buffer shuffle with tf.data semantics."""
+
+        def gen():
+            rng = _random.Random(seed)
+            buf = []
+            for x in self._gen_factory():
+                buf.append(x)
+                if len(buf) >= buffer_size:
+                    i = rng.randrange(len(buf))
+                    buf[i], buf[-1] = buf[-1], buf[i]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+
+        return Dataset(gen)
+
+    def batch(self, batch_size, drop_remainder=False):
+        def gen():
+            batch = []
+            for x in self._gen_factory():
+                batch.append(x)
+                if len(batch) == batch_size:
+                    yield _tree_stack(batch)
+                    batch = []
+            if batch and not drop_remainder:
+                yield _tree_stack(batch)
+
+        return Dataset(gen)
+
+    def repeat(self, count=None):
+        def gen():
+            n = 0
+            while count is None or n < count:
+                it = self._gen_factory()
+                empty = True
+                for x in it:
+                    empty = False
+                    yield x
+                if empty:
+                    return
+                n += 1
+
+        return Dataset(gen)
+
+    def take(self, n):
+        def gen():
+            for i, x in enumerate(self._gen_factory()):
+                if i >= n:
+                    return
+                yield x
+
+        return Dataset(gen)
+
+    def prefetch(self, buffer_size=1):
+        """Run the upstream pipeline in a background thread."""
+
+        def gen():
+            q = queue.Queue(maxsize=max(1, buffer_size))
+            _END = object()
+
+            def produce():
+                try:
+                    for x in self._gen_factory():
+                        q.put(x)
+                    q.put(_END)
+                except BaseException as e:  # propagate into consumer
+                    q.put(e)
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+
+        return Dataset(gen)
+
+    def __iter__(self):
+        return iter(self._gen_factory())
+
+    def as_numpy_iterator(self):
+        return iter(self)
+
+
+def create_dataset_from_tasks(tasks, data_reader):
+    """Dataset over the records of a fixed task list.
+
+    Parity: reference data/dataset_utils.py:4-24.
+    """
+
+    def gen():
+        for task in tasks:
+            yield from data_reader.read_records(task)
+
+    return Dataset.from_generator(gen)

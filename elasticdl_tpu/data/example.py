@@ -1,0 +1,72 @@
+"""TF-free structured record codec (tf.train.Example equivalent).
+
+The reference's model-zoo ``dataset_fn`` parses ``tf.train.Example`` protos
+with ``tf.io.parse_single_example`` + ``FixedLenFeature`` specs
+(e.g. model_zoo/mnist_functional_api/mnist_functional_api.py:57-75). This
+module provides the same contract without TensorFlow: an example is a dict
+of named ndarrays serialized with the framework tensor codec, and
+``parse_example`` validates/reshapes against ``FixedLenFeature`` specs.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor import (
+    Tensor,
+    deserialize_tensors,
+    serialize_tensors,
+)
+
+
+class FixedLenFeature:
+    """Spec for a fixed-shape feature (tf.io.FixedLenFeature analog)."""
+
+    def __init__(self, shape, dtype, default_value=None):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.default_value = default_value
+
+    def __repr__(self):
+        return "FixedLenFeature(%s, %s)" % (self.shape, self.dtype)
+
+
+def encode_example(features):
+    """Serialize {name: array-like} to bytes."""
+    tensors = []
+    for name in sorted(features):
+        tensors.append(Tensor(name, np.asarray(features[name])))
+    return serialize_tensors(tensors)
+
+
+def decode_example(data):
+    """Deserialize bytes back to {name: ndarray} without a spec."""
+    return {t.name: t.values for t in deserialize_tensors(data)}
+
+
+def parse_example(data, feature_spec):
+    """Parse one serialized example against {name: FixedLenFeature}.
+
+    Returns {name: ndarray} with each value cast + reshaped to its spec.
+    Missing features fall back to ``default_value`` (or raise); extra
+    features in the record are ignored — matching tf.io.parse_single_example
+    behavior.
+    """
+    raw = decode_example(data)
+    out = {}
+    for name, spec in feature_spec.items():
+        if name in raw:
+            arr = np.asarray(raw[name])
+            try:
+                arr = arr.reshape(spec.shape)
+            except ValueError:
+                raise ValueError(
+                    "feature %r has %d elements, spec shape %s"
+                    % (name, arr.size, spec.shape)
+                )
+            out[name] = arr.astype(spec.dtype, copy=False)
+        elif spec.default_value is not None:
+            out[name] = np.full(
+                spec.shape, spec.default_value, dtype=spec.dtype
+            )
+        else:
+            raise KeyError("feature %r missing from example" % name)
+    return out

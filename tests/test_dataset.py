@@ -1,0 +1,65 @@
+"""Dataset shim tests."""
+
+import unittest
+
+import numpy as np
+
+from elasticdl_tpu.data.dataset import Dataset
+
+
+class DatasetTest(unittest.TestCase):
+    def test_map_batch(self):
+        ds = Dataset.from_tensors(range(10)).map(lambda x: x * 2).batch(4)
+        batches = list(ds)
+        self.assertEqual(len(batches), 3)
+        np.testing.assert_array_equal(batches[0], [0, 2, 4, 6])
+        np.testing.assert_array_equal(batches[2], [16, 18])
+
+    def test_batch_drop_remainder(self):
+        ds = Dataset.from_tensors(range(10)).batch(4, drop_remainder=True)
+        self.assertEqual(len(list(ds)), 2)
+
+    def test_batch_nested_structure(self):
+        ds = Dataset.from_tensors(
+            ({"x": np.full((2,), i)}, i) for i in range(4)
+        ).batch(2)
+        (feats, labels) = next(iter(ds))
+        self.assertEqual(feats["x"].shape, (2, 2))
+        np.testing.assert_array_equal(labels, [0, 1])
+
+    def test_shuffle_is_permutation(self):
+        ds = Dataset.from_tensors(range(100)).shuffle(16, seed=0)
+        out = list(ds)
+        self.assertNotEqual(out, list(range(100)))
+        self.assertEqual(sorted(out), list(range(100)))
+
+    def test_repeat_take(self):
+        ds = Dataset.from_tensors(range(3)).repeat().take(7)
+        self.assertEqual(list(ds), [0, 1, 2, 0, 1, 2, 0])
+
+    def test_repeat_count(self):
+        ds = Dataset.from_tensors(range(2)).repeat(2)
+        self.assertEqual(list(ds), [0, 1, 0, 1])
+
+    def test_prefetch_preserves_order_and_errors(self):
+        ds = Dataset.from_tensors(range(50)).prefetch(4)
+        self.assertEqual(list(ds), list(range(50)))
+
+        def bad_gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        with self.assertRaises(RuntimeError):
+            list(Dataset.from_generator(bad_gen).prefetch(2))
+
+    def test_filter(self):
+        ds = Dataset.from_tensors(range(10)).filter(lambda x: x % 2 == 0)
+        self.assertEqual(list(ds), [0, 2, 4, 6, 8])
+
+    def test_reiterable(self):
+        ds = Dataset.from_tensors(range(3)).map(lambda x: x + 1)
+        self.assertEqual(list(ds), list(ds))
+
+
+if __name__ == "__main__":
+    unittest.main()

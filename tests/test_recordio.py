@@ -1,0 +1,126 @@
+"""EDLR format + data reader tests (parity: reference tests/data_reader_test.py)."""
+
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+from elasticdl_tpu.data.example import (
+    FixedLenFeature,
+    decode_example,
+    encode_example,
+    parse_example,
+)
+from elasticdl_tpu.data.data_reader import (
+    RecordIODataReader,
+    create_data_reader,
+)
+from elasticdl_tpu.data.recordio import (
+    RecordIOReader,
+    RecordIOWriter,
+    write_recordio,
+)
+from elasticdl_tpu.master.task_dispatcher import Task
+from elasticdl_tpu.common.constants import TaskType
+
+
+class RecordIOTest(unittest.TestCase):
+    def test_write_read_roundtrip(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.edlr")
+            payloads = [b"rec%d" % i for i in range(100)]
+            n = write_recordio(path, payloads)
+            self.assertEqual(n, 100)
+            with RecordIOReader(path) as r:
+                self.assertEqual(len(r), 100)
+                self.assertEqual(r.read(0), b"rec0")
+                self.assertEqual(r.read(99, validate=True), b"rec99")
+                self.assertEqual(
+                    list(r.read_range(10, 13)), [b"rec10", b"rec11", b"rec12"]
+                )
+                # out-of-range end clamps
+                self.assertEqual(len(list(r.read_range(98, 200))), 2)
+
+    def test_empty_file(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "empty.edlr")
+            with RecordIOWriter(path):
+                pass
+            with RecordIOReader(path) as r:
+                self.assertEqual(len(r), 0)
+
+    def test_truncated_file_rejected(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.edlr")
+            write_recordio(path, [b"abc"] * 5)
+            data = open(path, "rb").read()
+            trunc = os.path.join(d, "t.edlr")
+            with open(trunc, "wb") as f:
+                f.write(data[:-7])
+            with self.assertRaises(ValueError):
+                RecordIOReader(trunc)
+
+    def test_data_reader_shards_and_tasks(self):
+        with tempfile.TemporaryDirectory() as d:
+            for fname, count in (("a.edlr", 7), ("b.edlr", 5)):
+                write_recordio(
+                    os.path.join(d, fname),
+                    [b"%s-%d" % (fname.encode(), i) for i in range(count)],
+                )
+            reader = RecordIODataReader(data_dir=d)
+            shards = reader.create_shards()
+            self.assertEqual(
+                shards,
+                {
+                    os.path.join(d, "a.edlr"): (0, 7),
+                    os.path.join(d, "b.edlr"): (0, 5),
+                },
+            )
+            task = Task(os.path.join(d, "b.edlr"), 1, 4, TaskType.TRAINING)
+            recs = list(reader.read_records(task))
+            self.assertEqual(recs, [b"b.edlr-1", b"b.edlr-2", b"b.edlr-3"])
+            reader.close()
+
+    def test_factory_defaults_to_recordio(self):
+        with tempfile.TemporaryDirectory() as d:
+            r = create_data_reader(d)
+            self.assertIsInstance(r, RecordIODataReader)
+
+
+class ExampleCodecTest(unittest.TestCase):
+    def test_roundtrip_and_parse(self):
+        ex = encode_example(
+            {
+                "image": np.random.rand(28, 28).astype(np.float32),
+                "label": np.array([3], dtype=np.int64),
+            }
+        )
+        raw = decode_example(ex)
+        self.assertEqual(raw["image"].shape, (28, 28))
+        parsed = parse_example(
+            ex,
+            {
+                "image": FixedLenFeature((28, 28), np.float32),
+                "label": FixedLenFeature((1,), np.int32),
+            },
+        )
+        self.assertEqual(parsed["label"].dtype, np.int32)
+
+    def test_parse_missing_feature(self):
+        ex = encode_example({"a": np.zeros(3, np.float32)})
+        with self.assertRaises(KeyError):
+            parse_example(ex, {"b": FixedLenFeature((3,), np.float32)})
+        out = parse_example(
+            ex, {"b": FixedLenFeature((2,), np.float32, default_value=1.0)}
+        )
+        np.testing.assert_array_equal(out["b"], [1.0, 1.0])
+
+    def test_parse_shape_mismatch(self):
+        ex = encode_example({"a": np.zeros(3, np.float32)})
+        with self.assertRaises(ValueError):
+            parse_example(ex, {"a": FixedLenFeature((4,), np.float32)})
+
+
+if __name__ == "__main__":
+    unittest.main()
