@@ -1,0 +1,197 @@
+"""Model-zoo module loading and spec resolution.
+
+Parity: reference common/model_utils.py — dynamic import of a model-zoo
+module by dotted path (model_utils.py:10-54), resolution of the user
+contract ``custom_model/CustomModel``, ``loss``, ``optimizer``,
+``dataset_fn``, ``eval_metrics_fn``, ``PredictionOutputsProcessor`` with
+cross-module spec keys (model_utils.py:57-135), and checkpoint
+save/load (model_utils.py:138-150).
+
+The TPU-native contract differs only in *types*: ``custom_model()`` returns
+a flax ``nn.Module`` (not keras), ``optimizer(lr)`` returns an optax
+``GradientTransformation``, ``loss(output, labels)`` is jnp, and
+``dataset_fn(dataset, mode, metadata)`` receives the framework's tf-free
+Dataset shim (elasticdl_tpu/data/dataset.py).
+"""
+
+import importlib.util
+import os
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.tensor import Tensor, deserialize_tensors, serialize_tensors
+
+
+def load_module(module_file):
+    spec = importlib.util.spec_from_file_location(module_file, module_file)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def get_module_file_path(model_zoo, spec_key):
+    """Dotted spec key -> file path under the model zoo root.
+
+    ``"mnist_functional_api.mnist_functional_api.custom_model"`` maps to
+    ``{zoo}/mnist_functional_api/mnist_functional_api.py`` (the last dotted
+    element is the symbol, the rest the module path) —
+    reference model_utils.py:21-27.
+    """
+    return os.path.join(model_zoo, *spec_key.split(".")[:-1]) + ".py"
+
+
+def get_dict_from_params_str(params_str):
+    """Parse ``"a=1,b='x'"`` into a kwargs dict (model_utils.py:36-44)."""
+    if not params_str:
+        return None
+    kv = {}
+    for kv_str in params_str.split(","):
+        k, _, v = kv_str.partition("=")
+        try:
+            kv[k.strip()] = eval(v)  # noqa: S307 - same trust model as argparse
+        except Exception:
+            kv[k.strip()] = v
+    return kv
+
+
+def load_model_from_module(model_def, model_def_module, model_params):
+    """Instantiate the model: ``custom_model(**params)`` or class ctor.
+
+    Mirrors reference model_utils.py:47-54: if the named symbol is a
+    function it is called with model_params kwargs; if it is a class the
+    class is instantiated.
+    """
+    model_def_name = model_def.split(".")[-1]
+    if model_def_name not in model_def_module:
+        raise ValueError(
+            "Cannot find the model definition %s in the module" % model_def
+        )
+    custom_model = model_def_module[model_def_name]
+    kwargs = get_dict_from_params_str(model_params) or {}
+    return custom_model(**kwargs)
+
+
+def _get_spec_value(spec_key, model_zoo, default_module, required=False):
+    """Resolve a spec key to a symbol, supporting cross-module dotted keys.
+
+    Single-element keys resolve in the model-def module; dotted keys load
+    their own module (reference model_utils.py:57-86).
+    """
+    spec_key_items = spec_key.split(".")
+    spec_key_base = spec_key_items[-1]
+    if len(spec_key_items) == 1:
+        spec_key_module = default_module
+    else:
+        spec_key_module = load_module(
+            get_module_file_path(model_zoo, spec_key)
+        ).__dict__
+    spec_value = spec_key_module.get(spec_key_base)
+    if required and spec_value is None:
+        raise ValueError(
+            "Missing required spec key %s in the module: %s"
+            % (spec_key_base, spec_key)
+        )
+    return spec_value
+
+
+class ModelSpec:
+    """The resolved user contract for one job."""
+
+    def __init__(
+        self,
+        model,
+        dataset_fn,
+        loss,
+        optimizer,
+        eval_metrics_fn,
+        prediction_outputs_processor,
+    ):
+        self.model = model
+        self.dataset_fn = dataset_fn
+        self.loss = loss
+        self.optimizer = optimizer
+        self.eval_metrics_fn = eval_metrics_fn
+        self.prediction_outputs_processor = prediction_outputs_processor
+
+
+def get_model_spec(
+    model_zoo,
+    model_def,
+    model_params=None,
+    dataset_fn="dataset_fn",
+    loss="loss",
+    optimizer="optimizer",
+    eval_metrics_fn="eval_metrics_fn",
+    prediction_outputs_processor="PredictionOutputsProcessor",
+):
+    """Resolve the full model spec (reference model_utils.py:89-135)."""
+    from elasticdl_tpu.worker.prediction_outputs_processor import (
+        BasePredictionOutputsProcessor,
+    )
+
+    model_def_module_file = get_module_file_path(model_zoo, model_def)
+    default_module = load_module(model_def_module_file).__dict__
+    model = load_model_from_module(model_def, default_module, model_params)
+    pop = _get_spec_value(
+        prediction_outputs_processor, model_zoo, default_module
+    )
+    if pop is not None and not isinstance(pop, type):
+        # allow either a class or an instance in the zoo module
+        instance = pop
+    elif pop is not None:
+        instance = pop()
+    else:
+        instance = None
+    if instance is not None and not isinstance(
+        instance, BasePredictionOutputsProcessor
+    ):
+        logger.warning(
+            "prediction_outputs_processor is not inherited from "
+            "BasePredictionOutputsProcessor. Prediction outputs may not "
+            "be processed correctly."
+        )
+    return ModelSpec(
+        model=model,
+        dataset_fn=_get_spec_value(
+            dataset_fn, model_zoo, default_module, required=True
+        ),
+        loss=_get_spec_value(loss, model_zoo, default_module, required=True),
+        optimizer=_get_spec_value(
+            optimizer, model_zoo, default_module, required=True
+        ),
+        eval_metrics_fn=_get_spec_value(
+            eval_metrics_fn, model_zoo, default_module, required=True
+        ),
+        prediction_outputs_processor=instance,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint file codec: {version, named arrays} <-> one .chkpt file.
+# Replaces the reference's protobuf Model message (model_utils.py:138-150,
+# checkpoint_service.py) with the framework tensor-frame codec.
+# ---------------------------------------------------------------------------
+
+import struct
+
+_CKPT_MAGIC = b"EDLC"
+
+
+def save_checkpoint_to_file(named_arrays, version, file_path):
+    payload = serialize_tensors(
+        Tensor(name, values) for name, values in sorted(named_arrays.items())
+    )
+    with open(file_path, "wb") as f:
+        f.write(_CKPT_MAGIC)
+        f.write(struct.pack("<q", int(version)))
+        f.write(payload)
+
+
+def load_from_checkpoint_file(file_path):
+    """Returns (version, {name: ndarray})."""
+    with open(file_path, "rb") as f:
+        data = f.read()
+    if data[:4] != _CKPT_MAGIC:
+        raise ValueError("not an elasticdl_tpu checkpoint: %s" % file_path)
+    (version,) = struct.unpack_from("<q", data, 4)
+    tensors = deserialize_tensors(memoryview(data)[12:])
+    return version, {t.name: t.values for t in tensors}

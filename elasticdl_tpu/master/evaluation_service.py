@@ -1,0 +1,244 @@
+"""Evaluation job management on the master.
+
+Parity: reference master/evaluation_service.py — an ``_EvaluationJob``
+accumulates metrics over worker-reported model outputs + labels for one
+pinned (checkpointed) model version; evaluation tasks are created either on
+a timer thread (``_EvaluationTrigger``) or every ``eval_steps`` model
+versions; the evaluated snapshot is an *eval checkpoint* so training racing
+ahead never contaminates the metrics.
+
+Metric objects come from ``eval_metrics_fn`` of the model-zoo module;
+plain callables are normalized to Mean-aggregated metrics
+(elasticdl_tpu/metrics/as_metric), mirroring keras MeanMetricWrapper.
+"""
+
+import threading
+import time
+from threading import Thread
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import MetricsDictKey, TaskType
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.metrics import Metric, as_metric
+
+
+class _EvaluationJob:
+    """One evaluation round over a pinned model version."""
+
+    def __init__(self, metrics_dict, model_version, total_tasks=-1):
+        self.model_version = model_version
+        self._total_tasks = total_tasks
+        self._completed_tasks = 0
+        self._init_metrics_dict(metrics_dict)
+
+    def _init_metrics_dict(self, metrics_dict):
+        if not metrics_dict:
+            raise ValueError(
+                "Evaluation metrics dictionary must not be empty."
+            )
+        first = next(iter(metrics_dict.values()))
+        if isinstance(first, dict):
+            # multi-output model: {output_name: {metric_name: metric}}
+            self._model_have_multiple_outputs = True
+            self._metrics_dict = metrics_dict
+        else:
+            self._model_have_multiple_outputs = False
+            self._metrics_dict = {MetricsDictKey.MODEL_OUTPUT: metrics_dict}
+        for metrics in self._metrics_dict.values():
+            for name in list(metrics):
+                if not isinstance(metrics[name], Metric):
+                    metrics[name] = as_metric(name, metrics[name])
+
+    def complete_task(self):
+        self._completed_tasks += 1
+
+    def finished(self):
+        return self._completed_tasks >= self._total_tasks
+
+    def report_evaluation_metrics(
+        self, evaluation_version, model_outputs, labels
+    ):
+        """model_outputs: {output_name: ndarray}; labels: ndarray."""
+        if (
+            self.model_version >= 0
+            and evaluation_version != self.model_version
+        ):
+            logger.error(
+                "Drop a wrong version evaluation: request %d, receive %d"
+                % (self.model_version, evaluation_version)
+            )
+            return False
+        labels = np.asarray(labels)
+        for key, outputs in model_outputs.items():
+            metrics = self._metrics_dict.get(key)
+            if not metrics:
+                continue
+            outputs = np.asarray(outputs)
+            for metric_inst in metrics.values():
+                metric_inst.update_state(labels, outputs)
+        return True
+
+    def get_evaluation_summary(self):
+        if self._model_have_multiple_outputs:
+            return {
+                output_name: {
+                    name: metric.result() for name, metric in metrics.items()
+                }
+                for output_name, metrics in self._metrics_dict.items()
+            }
+        return {
+            name: metric.result()
+            for name, metric in self._metrics_dict[
+                MetricsDictKey.MODEL_OUTPUT
+            ].items()
+        }
+
+
+class _EvaluationTrigger(Thread):
+    """Generates time-based evaluation tasks (reference :108-140)."""
+
+    def __init__(self, eval_service, start_delay_secs, throttle_secs):
+        Thread.__init__(self, daemon=True)
+        self._eval_service = eval_service
+        self._stopper = threading.Event()
+        self._throttle_secs = throttle_secs
+        self._eval_min_time = time.time() + start_delay_secs
+
+    def stop(self):
+        self._stopper.set()
+
+    def _wait_enough_time(self, cur_time_secs, previous_round_start_secs):
+        if cur_time_secs < self._eval_min_time:
+            return False
+        if (
+            previous_round_start_secs != -1
+            and cur_time_secs - previous_round_start_secs < self._throttle_secs
+        ):
+            return False
+        return True
+
+    def run(self):
+        previous_round_start_secs = -1
+        while not self._stopper.is_set():
+            time_now = time.time()
+            if self._wait_enough_time(time_now, previous_round_start_secs):
+                self._eval_service.add_evaluation_task(is_time_based_eval=True)
+                previous_round_start_secs = time_now
+            self._stopper.wait(5)
+
+
+class EvaluationService:
+    def __init__(
+        self,
+        checkpoint_service,
+        tensorboard_service,
+        task_d,
+        start_delay_secs,
+        throttle_secs,
+        eval_steps,
+        eval_only,
+        eval_metrics_fn,
+    ):
+        self._checkpoint_service = checkpoint_service
+        self._tensorboard_service = tensorboard_service
+        self._task_d = task_d
+        self._lock = threading.Lock()
+        self._eval_job = None
+        self.trigger = _EvaluationTrigger(
+            self, start_delay_secs, throttle_secs
+        )
+        self._time_based_eval = throttle_secs > 0
+        self._eval_steps = eval_steps
+        self._eval_checkpoint_versions = []
+        self._last_eval_checkpoint_version = -1
+        self._eval_only = eval_only
+        self._eval_metrics_fn = eval_metrics_fn
+        self._master_servicer = None
+
+    def start(self):
+        if self._time_based_eval and not self._eval_only:
+            self.trigger.start()
+
+    def stop(self):
+        if self._time_based_eval and not self._eval_only:
+            self.trigger.stop()
+
+    def set_master_servicer(self, master_servicer):
+        self._master_servicer = master_servicer
+
+    def init_eval_only_job(self, num_task):
+        self._eval_job = _EvaluationJob(self._eval_metrics_fn(), -1, num_task)
+
+    def add_evaluation_task(self, is_time_based_eval, master_locking=True):
+        """Checkpoint the current model and queue an eval round on it."""
+        if is_time_based_eval and self._task_d.finished():
+            return
+        model_version = self._master_servicer.get_model_version()
+        if model_version == self._last_eval_checkpoint_version:
+            return
+
+        checkpoint_version = self._master_servicer.save_eval_checkpoint(
+            locking=master_locking
+        )
+        with self._lock:
+            self._eval_checkpoint_versions.append(checkpoint_version)
+        self._last_eval_checkpoint_version = checkpoint_version
+        self.try_to_create_new_job()
+
+    def try_to_create_new_job(self):
+        """Start the next queued eval round if none is running."""
+        with self._lock:
+            if self._eval_job is None and self._eval_checkpoint_versions:
+                checkpoint_version = self._eval_checkpoint_versions.pop(0)
+                self._task_d.create_tasks(
+                    TaskType.EVALUATION, checkpoint_version
+                )
+                task_count = len(self._task_d._eval_todo)
+                self._eval_job = _EvaluationJob(
+                    self._eval_metrics_fn(), checkpoint_version, task_count
+                )
+                return True
+        return False
+
+    def add_evaluation_task_if_needed(self, master_locking):
+        """Step-based evaluation trigger (reference :223-231)."""
+        model_version = self._master_servicer.get_model_version()
+        if self._eval_steps and model_version % self._eval_steps == 0:
+            self.add_evaluation_task(
+                is_time_based_eval=False, master_locking=master_locking
+            )
+
+    def report_evaluation_metrics(
+        self, evaluation_version, model_outputs, labels
+    ):
+        if self._eval_job is None:
+            return False
+        return self._eval_job.report_evaluation_metrics(
+            evaluation_version, model_outputs, labels
+        )
+
+    def complete_task(self):
+        self._eval_job.complete_task()
+        if not self._eval_job.finished():
+            return
+        evaluation_metrics = self._eval_job.get_evaluation_summary()
+        if self._tensorboard_service and evaluation_metrics:
+            self._tensorboard_service.write_dict_to_summary(
+                evaluation_metrics, version=self._eval_job.model_version
+            )
+        logger.info(
+            "Evaluation metrics[v=%d]: %s"
+            % (
+                self._eval_job.model_version
+                if self._eval_job.model_version >= 0
+                else self._master_servicer.get_model_version(),
+                str(evaluation_metrics),
+            )
+        )
+        if not self._eval_only:
+            self._checkpoint_service.remove_eval_checkpoint(
+                self._eval_job.model_version
+            )
+            self._eval_job = None
+            self.try_to_create_new_job()

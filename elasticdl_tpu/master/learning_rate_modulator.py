@@ -1,0 +1,51 @@
+"""Staleness-aware learning-rate modulation.
+
+Parity: reference master/learning_rate_modulator.py — the optimizer's
+learning rate is multiplied by a per-thread multiplier so concurrent async
+gradient applications each see their own staleness discount
+(servicer.py:428-432 sets multiplier = 1/staleness).
+
+TPU-native form: instead of monkey-patching a Keras optimizer's ``lr``
+attribute with a callable, the optax gradient transformation is wrapped so
+its *updates* are scaled by the thread-local multiplier at apply time —
+mathematically identical for any first-order optimizer whose update is
+linear in the learning rate at the final scale step (true of the optax
+``scale_by_learning_rate`` composition used throughout).
+"""
+
+import threading
+
+import jax
+import optax
+
+
+class LearningRateModulator:
+    """Thread-local multiplicative LR modulation (reference :4-43)."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def set_multiplier(self, multiplier):
+        self._tls.multiplier = multiplier
+
+    def get_multiplier(self):
+        return getattr(self._tls, "multiplier", 1.0)
+
+
+def add_lr_modulation_to_optimizer(optimizer):
+    """Wrap an optax optimizer with thread-local update scaling.
+
+    Returns ``(wrapped_optimizer, modulator)`` — the reference mutates the
+    Keras optimizer in place and returns the modulator
+    (learning_rate_modulator.py:46-60).
+    """
+    modulation = LearningRateModulator()
+
+    def update_fn(updates, state, params=None):
+        updates, state = optimizer.update(updates, state, params)
+        multiplier = modulation.get_multiplier()
+        updates = jax.tree_util.tree_map(lambda u: u * multiplier, updates)
+        return updates, state
+
+    wrapped = optax.GradientTransformation(optimizer.init, update_fn)
+    return wrapped, modulation

@@ -1,0 +1,448 @@
+"""Master control-plane service (PS-mode training coordinator).
+
+Parity: reference master/servicer.py — the six RPC handlers
+(GetTask/GetModel/ReportVariable/ReportGradient/ReportTaskResult/
+ReportEvaluationMetrics), a ``{name: variable}`` model with a version
+counter, sync gradient accumulation until ``grads_to_wait`` then
+average+apply, async apply-immediately with staleness-aware LR modulation,
+and gradient shape/index sanity checks (servicer.py:40-449).
+
+TPU-native deltas:
+- the model is a flat ``{name: np.ndarray}`` pytree and gradients are
+  applied with an **optax** transformation on the master host (this path
+  carries the reference's PS semantics for parity + sparse/async modes; the
+  ALLREDUCE fast path never routes dense tensors through here — gradients
+  stay in HBM and sync via XLA collectives inside the jitted step),
+- transport is method calls: the object is served over the control-plane
+  RPC layer or called directly in-process (the reference test fixture
+  pattern, tests/in_process_master.py).
+"""
+
+import threading
+
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import GetModelMethod, TaskType
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import load_from_checkpoint_file
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.master.learning_rate_modulator import (
+    add_lr_modulation_to_optimizer,
+)
+
+
+class TaskResponse:
+    """The GetTask reply (reference proto Task, elasticdl.proto:24-54)."""
+
+    def __init__(
+        self,
+        task_id=-1,
+        shard_name="",
+        start=0,
+        end=0,
+        type=None,
+        model_version=-1,
+        minibatch_size=0,
+        extended_config=None,
+    ):
+        self.task_id = task_id
+        self.shard_name = shard_name
+        self.start = start
+        self.end = end
+        self.type = type
+        self.model_version = model_version
+        self.minibatch_size = minibatch_size
+        self.extended_config = extended_config or {}
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        grads_to_wait,
+        minibatch_size,
+        optimizer,
+        task_d,
+        init_var=None,
+        checkpoint_filename_for_init=None,
+        checkpoint_service=None,
+        evaluation_service=None,
+        lr_staleness_modulation=False,
+        use_async=False,
+        embedding_gradient_applier=None,
+    ):
+        """``optimizer`` is an optax GradientTransformation (or None for
+        pure task-dispatch mode, e.g. ALLREDUCE jobs where the master only
+        coordinates). ``embedding_gradient_applier`` handles sparse
+        gradients of elastic embedding layers whose tables do not live in
+        ``self._model`` (the OptimizerWrapper role)."""
+        self._task_d = task_d
+        self._lock = threading.Lock()
+        self._gradient_sum = {}
+        self._gradient_sum_indexed = {}
+        self._edl_embedding_gradients = {}
+        self._grad_to_wait = grads_to_wait
+        self._grad_n = 0
+        self._minibatch_size = minibatch_size
+        self._use_async = use_async
+        self._lr_staleness_modulation = lr_staleness_modulation
+
+        self._model = {}  # {name: np.float32 ndarray}
+        self._version = 0
+        self._opt_state = None
+        self._lr_modulation = None
+        self._opt = self._init_optimizer(optimizer)
+        self._embedding_gradient_applier = embedding_gradient_applier
+
+        self._init_model(checkpoint_filename_for_init, init_var)
+
+        self._checkpoint_service = checkpoint_service
+        self._evaluation_service = evaluation_service
+        if evaluation_service:
+            evaluation_service.set_master_servicer(self)
+
+    # -- model init ---------------------------------------------------------
+
+    def set_model_var(self, name, value):
+        """Add or set a model variable (float32 ndarray)."""
+        value = np.asarray(value)
+        if value.dtype != np.float32:
+            raise ValueError("Value should be a float32 numpy array")
+        self._model[name] = value
+        self._opt_state = None  # structure changed; re-init lazily
+
+    def _init_model(self, checkpoint_filename_for_init, init_var):
+        if checkpoint_filename_for_init:
+            version, named = load_from_checkpoint_file(
+                checkpoint_filename_for_init
+            )
+            self._version = version
+            for name, arr in named.items():
+                self.set_model_var(name, arr.astype(np.float32, copy=False))
+        elif init_var:
+            for name, arr in init_var.items():
+                self.set_model_var(name, np.asarray(arr, dtype=np.float32))
+        else:
+            logger.info(
+                "Model is not initialized. It will be initialized by the "
+                "first update from the worker."
+            )
+
+    def _init_optimizer(self, opt):
+        if opt is not None and self._use_async and self._lr_staleness_modulation:
+            opt, self._lr_modulation = add_lr_modulation_to_optimizer(opt)
+        return opt
+
+    def _ensure_opt_state(self):
+        if self._opt_state is None and self._opt is not None:
+            self._opt_state = self._opt.init(self._model)
+
+    # -- RPC handlers -------------------------------------------------------
+
+    def get_task(self, worker_id, task_type=None):
+        """Reference GetTask (servicer.py:127-158). Returns TaskResponse."""
+        res = TaskResponse(
+            model_version=self._version, minibatch_size=self._minibatch_size
+        )
+        if task_type == TaskType.EVALUATION:
+            task_id, task = self._task_d.get_eval_task(worker_id)
+        else:
+            task_id, task = self._task_d.get(worker_id)
+
+        if task:
+            res.task_id = task_id
+            res.shard_name = task.shard_name
+            res.start = task.start
+            res.end = task.end
+            res.type = task.type
+            res.extended_config = dict(task.extended_config)
+            if task.type == TaskType.EVALUATION:
+                res.model_version = task.model_version
+        elif (not self._task_d.finished()) or (
+            self._task_d.invoke_deferred_callback()
+        ):
+            res.type = TaskType.WAIT
+        return res
+
+    def get_model(self, version, method=GetModelMethod.MINIMUM):
+        """Returns (version, {name: ndarray}) (reference servicer.py:160-187)."""
+        if not self._use_async:
+            self._validate_model_version(version)
+        if method == GetModelMethod.MINIMUM or version == self._version:
+            if self._use_async:
+                return self._get_model_no_lock()
+            with self._lock:
+                return self._get_model_no_lock()
+        # FIXED: serve the pinned version from its checkpoint
+        try:
+            return self._checkpoint_service.get_checkpoint_model(version)
+        except Exception:
+            logger.error(
+                "Failed to fetch checkpoint model for model version %s",
+                version,
+            )
+            return self._version, {}
+
+    def report_variable(self, named_arrays):
+        """First-write-wins model init from a worker (servicer.py:293-297)."""
+        with self._lock:
+            if not self._model:
+                for name, arr in named_arrays.items():
+                    self.set_model_var(
+                        name, np.asarray(arr, dtype=np.float32)
+                    )
+
+    def report_gradient(self, gradients, model_version):
+        """Returns (accepted, current_version).
+
+        ``gradients``: iterable of Tensor (dense or indexed) — reference
+        ReportGradient (servicer.py:299-381).
+        """
+        model_version_valid = self._use_async or self._validate_model_version(
+            model_version
+        )
+        if not model_version_valid:
+            logger.warning(
+                "Task result for outdated version %d dropped", model_version
+            )
+            return False, self._version
+
+        non_embedding_gradients = {}
+        indexed_grads = {}
+        edl_embedding_gradients = {}
+        for tensor in gradients:
+            if not isinstance(tensor, Tensor):
+                raise TypeError("gradients must be Tensor objects")
+            name = tensor.name
+            if name not in self._model:
+                if tensor.is_indexed_slices():
+                    # elastic embedding layer: table lives outside the model
+                    edl_embedding_gradients[name] = tensor
+                    continue
+                raise ValueError(
+                    "Gradient key: %s is not part of model" % name
+                )
+            if tensor.is_indexed_slices():
+                if tensor.values.shape[1] != self._model[name].shape[1]:
+                    raise ValueError(
+                        "Gradient key: %s has incompatible indexed slice "
+                        "dimension %d, expected %d"
+                        % (
+                            name,
+                            tensor.values.shape[1],
+                            self._model[name].shape[1],
+                        )
+                    )
+                max_index = int(tensor.indices.max())
+                if max_index >= self._model[name].shape[0]:
+                    raise ValueError(
+                        "Gradient key: %s has wrong indices %d, "
+                        "out of range %d"
+                        % (name, max_index, self._model[name].shape[0] - 1)
+                    )
+                indexed_grads[name] = tensor
+            else:
+                if tensor.values.shape != self._model[name].shape:
+                    raise ValueError(
+                        "Gradient key: %s has incompatible dimension" % name
+                    )
+                non_embedding_gradients[name] = tensor.values
+
+        if not self._use_async:
+            self._lock.acquire()
+        try:
+            self._process_gradients(
+                edl_embedding_gradients,
+                indexed_grads,
+                non_embedding_gradients,
+                model_version,
+            )
+        finally:
+            if not self._use_async:
+                self._lock.release()
+        return True, self._version
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        if err_message:
+            logger.warning("Worker reported error: " + err_message)
+            self._task_d.report(task_id, False)
+        else:
+            self._task_d.report(task_id, True)
+
+    def report_evaluation_metrics(self, model_version, model_outputs, labels):
+        """Returns (accepted, current_version)."""
+        accepted = self._evaluation_service.report_evaluation_metrics(
+            model_version, model_outputs, labels
+        )
+        return accepted, self._version
+
+    # -- gradient application ----------------------------------------------
+
+    def _process_gradients(
+        self, edl_embedding_gradients, indexed_grads, grads, request_version
+    ):
+        if not self._use_async:
+            # sync: accumulate until grads_to_wait reports arrive
+            for k, v in edl_embedding_gradients.items():
+                if k in self._edl_embedding_gradients:
+                    self._edl_embedding_gradients[k] = (
+                        self._edl_embedding_gradients[k] + v
+                    )
+                else:
+                    self._edl_embedding_gradients[k] = v
+            for k, v in indexed_grads.items():
+                if k in self._gradient_sum_indexed:
+                    self._gradient_sum_indexed[k] = (
+                        self._gradient_sum_indexed[k] + v
+                    )
+                else:
+                    self._gradient_sum_indexed[k] = v
+            for k, v in grads.items():
+                if k in self._gradient_sum:
+                    self._gradient_sum[k] = self._gradient_sum[k] + v
+                else:
+                    self._gradient_sum[k] = v
+            self._grad_n += 1
+
+        need_to_update_model = self._use_async
+        if not self._use_async and self._grad_n >= self._grad_to_wait:
+            need_to_update_model = True
+            for k in self._gradient_sum:
+                self._gradient_sum[k] = (
+                    self._gradient_sum[k] / self._grad_to_wait
+                )
+            edl_embedding_gradients = self._edl_embedding_gradients
+            indexed_grads = self._gradient_sum_indexed
+            grads = self._gradient_sum
+        if need_to_update_model:
+            self._update_optimizer(request_version)
+            self._update_model(grads, indexed_grads, edl_embedding_gradients)
+
+    def _update_optimizer(self, request_version):
+        if self._lr_modulation:
+            staleness = max(1, self._version - request_version)
+            self._lr_modulation.set_multiplier(1.0 / staleness)
+
+    def _densify(self, grads, indexed_grads):
+        """Build the full gradient pytree matching the model structure.
+
+        Missing parameters contribute zero gradients; indexed slices
+        scatter-add into dense buffers (duplicate ids accumulate, the
+        IndexedSlices semantics TF optimizers apply).
+        """
+        dense = {}
+        for k, p in self._model.items():
+            if k in grads:
+                dense[k] = np.asarray(grads[k], dtype=np.float32)
+            elif k in indexed_grads:
+                t = indexed_grads[k]
+                g = np.zeros_like(p)
+                np.add.at(g, np.asarray(t.indices), np.asarray(t.values))
+                dense[k] = g
+            else:
+                dense[k] = np.zeros_like(p)
+        return dense
+
+    def _update_model(self, grads, indexed_grads, edl_embedding_gradients):
+        if edl_embedding_gradients:
+            if self._embedding_gradient_applier is None:
+                raise ValueError(
+                    "Received elastic-embedding gradients but no embedding "
+                    "gradient applier is configured"
+                )
+            self._embedding_gradient_applier(edl_embedding_gradients)
+
+        if (grads or indexed_grads) and self._opt is not None:
+            self._ensure_opt_state()
+            dense = self._densify(grads, indexed_grads)
+            updates, self._opt_state = self._opt.update(
+                dense, self._opt_state, self._model
+            )
+            new_params = optax.apply_updates(self._model, updates)
+            self._model = {
+                k: np.asarray(v, dtype=np.float32)
+                for k, v in new_params.items()
+            }
+
+        if self._use_async:
+            self._lock.acquire()
+        try:
+            self._version += 1
+            self._update_evaluation()
+            self._update_checkpoint()
+        finally:
+            if self._use_async:
+                self._lock.release()
+        if not self._use_async:
+            self._gradient_sum.clear()
+            self._gradient_sum_indexed.clear()
+            self._edl_embedding_gradients.clear()
+            self._grad_n = 0
+
+    # -- version/checkpoint helpers ----------------------------------------
+
+    def get_model_version(self):
+        return self._version
+
+    def _get_model_no_lock(self):
+        return self._version, {k: v.copy() for k, v in self._model.items()}
+
+    def _validate_model_version(self, request_model_version):
+        if request_model_version > self._version:
+            err_msg = (
+                "Model version %d not available yet, current version: %d"
+                % (request_model_version, self._version)
+            )
+            logger.warning(err_msg)
+            raise ValueError(err_msg)
+        return request_model_version == self._version
+
+    def _save_checkpoint(self, locking, is_eval_checkpoint):
+        try:
+            logger.info(
+                "Saving checkpoint for model version %d" % self._version
+            )
+            if locking:
+                self._lock.acquire()
+            version, named = self._get_model_no_lock()
+            self._checkpoint_service.save(version, named, is_eval_checkpoint)
+            if locking:
+                self._lock.release()
+            return version
+        except Exception:
+            logger.error(
+                "Failed to save checkpoint file for model version %d"
+                % self._version
+            )
+
+    def save_eval_checkpoint(self, locking=True):
+        return self._save_checkpoint(locking, is_eval_checkpoint=True)
+
+    def save_latest_checkpoint(self, output_path):
+        from elasticdl_tpu.common.file_utils import copy_if_not_exists
+        from elasticdl_tpu.master.checkpoint_service import CheckpointService
+
+        if self._checkpoint_service is None:
+            self._checkpoint_service = CheckpointService(
+                checkpoint_dir="",
+                checkpoint_steps=1,
+                keep_checkpoint_max=1,
+                include_evaluation=False,
+            )
+        self._save_checkpoint(locking=False, is_eval_checkpoint=False)
+        checkpoint_path = self._checkpoint_service.get_checkpoint_path(
+            self._checkpoint_service.get_latest_checkpoint_version()
+        )
+        copy_if_not_exists(checkpoint_path, output_path, is_dir=False)
+
+    def _update_evaluation(self):
+        if self._evaluation_service:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                master_locking=False
+            )
+
+    def _update_checkpoint(self):
+        if self._checkpoint_service and self._checkpoint_service.need_to_checkpoint(
+            self._version
+        ):
+            self._save_checkpoint(locking=False, is_eval_checkpoint=False)

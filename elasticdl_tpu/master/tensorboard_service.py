@@ -1,0 +1,81 @@
+"""Metrics export service.
+
+Parity: reference master/tensorboard_service.py writes eval-metric dicts
+keyed by model version via ``tf.summary`` and spawns a ``tensorboard``
+subprocess (:27-45). Replaced-by: a dependency-free JSONL scalar log
+(``scalars.jsonl`` under ``logdir``) that any dashboard can tail; when the
+``tensorboard`` CLI is installed the same subprocess-spawning behavior is
+available via :meth:`start_tensorboard_service`.
+"""
+
+import json
+import os
+import subprocess
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class TensorboardService:
+    def __init__(self, tensorboard_log_dir, master_ip=None):
+        self._logdir = tensorboard_log_dir
+        self._master_ip = master_ip
+        os.makedirs(self._logdir, exist_ok=True)
+        self._scalars_path = os.path.join(self._logdir, "scalars.jsonl")
+        self._f = open(self._scalars_path, "a")
+        self.tb_process = None
+
+    def write_dict_to_summary(self, dictionary, version):
+        """Append flat scalar records ``{tag, value, step, ts}``.
+
+        Nested dicts (multi-output models) flatten to ``output/metric`` tags,
+        matching the reference's summary naming.
+        """
+        now = time.time()
+
+        def emit(tag, value):
+            self._f.write(
+                json.dumps(
+                    {
+                        "tag": tag,
+                        "value": float(value),
+                        "step": int(version),
+                        "ts": now,
+                    }
+                )
+                + "\n"
+            )
+
+        for key, value in dictionary.items():
+            if isinstance(value, dict):
+                for sub_key, sub_value in value.items():
+                    emit("%s/%s" % (key, sub_key), sub_value)
+            else:
+                emit(key, value)
+        self._f.flush()
+
+    def start(self):
+        """Spawn the tensorboard CLI if present (reference :34-45)."""
+        try:
+            self.tb_process = subprocess.Popen(
+                ["tensorboard", "--logdir", self._logdir, "--host", "0.0.0.0"],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        except FileNotFoundError:
+            logger.info(
+                "tensorboard CLI not installed; scalars logged to %s",
+                self._scalars_path,
+            )
+
+    def is_active(self):
+        return self.tb_process is not None and self.tb_process.poll() is None
+
+    def keep_running(self):
+        while self.is_active():
+            time.sleep(10)
+
+    def close(self):
+        self._f.close()
+        if self.tb_process is not None:
+            self.tb_process.terminate()

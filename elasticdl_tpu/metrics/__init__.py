@@ -1,0 +1,235 @@
+"""Streaming evaluation metrics (tf.keras.metrics equivalents, numpy/JAX).
+
+Parity: the reference aggregates eval metrics on the master with
+``tf.keras.metrics`` objects fed raw model outputs + labels reported by
+workers (evaluation_service.py:38-105). Model-zoo ``eval_metrics_fn`` may
+return either metric *objects* or plain *callables* ``fn(labels,
+predictions) -> per-example values`` (e.g. mnist_functional_api.py:85-91);
+both are supported here. Callables are wrapped in a :class:`Mean`.
+
+All metrics are host-side numpy accumulators: they run on the master's CPU
+over small reported batches, never inside a jitted step, so they impose no
+constraint on XLA compilation.
+"""
+
+import numpy as np
+
+__all__ = [
+    "Metric",
+    "Mean",
+    "Sum",
+    "Accuracy",
+    "BinaryAccuracy",
+    "SparseCategoricalAccuracy",
+    "CategoricalAccuracy",
+    "MeanSquaredError",
+    "AUC",
+    "as_metric",
+]
+
+
+class Metric:
+    """Base streaming metric: update_state / result / reset_states."""
+
+    def __init__(self, name=None):
+        self.name = name or type(self).__name__.lower()
+
+    def update_state(self, labels, predictions):
+        raise NotImplementedError
+
+    def result(self):
+        raise NotImplementedError
+
+    def reset_states(self):
+        raise NotImplementedError
+
+
+class Mean(Metric):
+    """Running mean of whatever values are fed in."""
+
+    def __init__(self, name=None, fn=None):
+        super().__init__(name)
+        self._fn = fn
+        self._total = 0.0
+        self._count = 0
+
+    def update_state(self, labels, predictions=None):
+        if self._fn is not None:
+            values = self._fn(labels, predictions)
+        else:
+            values = labels  # fed values directly
+        values = np.asarray(values, dtype=np.float64).reshape(-1)
+        self._total += float(values.sum())
+        self._count += values.size
+
+    def result(self):
+        return self._total / self._count if self._count else 0.0
+
+    def reset_states(self):
+        self._total = 0.0
+        self._count = 0
+
+
+class Sum(Metric):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._total = 0.0
+
+    def update_state(self, labels, predictions=None):
+        self._total += float(np.asarray(labels, dtype=np.float64).sum())
+
+    def result(self):
+        return self._total
+
+    def reset_states(self):
+        self._total = 0.0
+
+
+class Accuracy(Metric):
+    """Exact-match accuracy of predictions vs labels (keras Accuracy)."""
+
+    def __init__(self, name="accuracy"):
+        super().__init__(name)
+        self._correct = 0
+        self._count = 0
+
+    def update_state(self, labels, predictions):
+        labels = np.asarray(labels).reshape(-1)
+        predictions = np.asarray(predictions).reshape(-1)
+        self._correct += int((labels == predictions).sum())
+        self._count += labels.size
+
+    def result(self):
+        return self._correct / self._count if self._count else 0.0
+
+    def reset_states(self):
+        self._correct = 0
+        self._count = 0
+
+
+class SparseCategoricalAccuracy(Metric):
+    """argmax(logits) == integer label."""
+
+    def __init__(self, name="accuracy"):
+        super().__init__(name)
+        self._correct = 0
+        self._count = 0
+
+    def update_state(self, labels, predictions):
+        labels = np.asarray(labels).reshape(-1)
+        pred = np.argmax(np.asarray(predictions), axis=-1).reshape(-1)
+        self._correct += int((labels == pred).sum())
+        self._count += labels.size
+
+    def result(self):
+        return self._correct / self._count if self._count else 0.0
+
+    def reset_states(self):
+        self._correct = 0
+        self._count = 0
+
+
+class CategoricalAccuracy(SparseCategoricalAccuracy):
+    """argmax(logits) == argmax(one-hot label)."""
+
+    def update_state(self, labels, predictions):
+        labels = np.argmax(np.asarray(labels), axis=-1)
+        super().update_state(labels, predictions)
+
+
+class BinaryAccuracy(Metric):
+    def __init__(self, name="binary_accuracy", threshold=0.5):
+        super().__init__(name)
+        self._threshold = threshold
+        self._correct = 0
+        self._count = 0
+
+    def update_state(self, labels, predictions):
+        labels = np.asarray(labels).reshape(-1)
+        pred = (np.asarray(predictions).reshape(-1) > self._threshold).astype(
+            labels.dtype
+        )
+        self._correct += int((labels == pred).sum())
+        self._count += labels.size
+
+    def result(self):
+        return self._correct / self._count if self._count else 0.0
+
+    def reset_states(self):
+        self._correct = 0
+        self._count = 0
+
+
+class MeanSquaredError(Metric):
+    def __init__(self, name="mse"):
+        super().__init__(name)
+        self._total = 0.0
+        self._count = 0
+
+    def update_state(self, labels, predictions):
+        labels = np.asarray(labels, dtype=np.float64).reshape(-1)
+        pred = np.asarray(predictions, dtype=np.float64).reshape(-1)
+        self._total += float(((labels - pred) ** 2).sum())
+        self._count += labels.size
+
+    def result(self):
+        return self._total / self._count if self._count else 0.0
+
+    def reset_states(self):
+        self._total = 0.0
+        self._count = 0
+
+
+class AUC(Metric):
+    """Streaming ROC AUC via fixed-threshold confusion-count histograms.
+
+    Same approximation scheme as tf.keras.metrics.AUC: bucket scores into
+    ``num_thresholds`` bins, accumulate TP/FP/TN/FN per threshold, integrate
+    TPR over FPR with the trapezoid rule.
+    """
+
+    def __init__(self, name="auc", num_thresholds=200):
+        super().__init__(name)
+        self._n = num_thresholds
+        self._thresholds = np.linspace(0.0, 1.0, num_thresholds)
+        self.reset_states()
+
+    def update_state(self, labels, predictions):
+        labels = np.asarray(labels).reshape(-1).astype(bool)
+        scores = np.asarray(predictions, dtype=np.float64).reshape(-1)
+        # predictions >= threshold counted positive, per threshold bin
+        pred_pos = scores[None, :] >= self._thresholds[:, None]
+        self._tp += (pred_pos & labels[None, :]).sum(axis=1)
+        self._fp += (pred_pos & ~labels[None, :]).sum(axis=1)
+        self._pos += int(labels.sum())
+        self._neg += int((~labels).sum())
+
+    def result(self):
+        if not self._pos or not self._neg:
+            return 0.0
+        tpr = self._tp / self._pos
+        fpr = self._fp / self._neg
+        # thresholds ascend -> fpr descends; integrate in ascending order
+        return float(np.trapz(tpr[::-1], fpr[::-1]))
+
+    def reset_states(self):
+        self._tp = np.zeros(self._n, dtype=np.int64)
+        self._fp = np.zeros(self._n, dtype=np.int64)
+        self._pos = 0
+        self._neg = 0
+
+
+def as_metric(name, value):
+    """Normalize an eval_metrics_fn dict value into a Metric object.
+
+    Plain callables ``fn(labels, predictions)`` become a Mean over their
+    per-example outputs — the contract the reference model zoo relies on
+    (mnist_functional_api.py:85-91 returns an elementwise-equality lambda).
+    """
+    if isinstance(value, Metric):
+        return value
+    if callable(value):
+        return Mean(name=name, fn=value)
+    raise TypeError(
+        "eval metric %r must be a Metric or callable, got %r" % (name, value)
+    )

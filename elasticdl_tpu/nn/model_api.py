@@ -1,0 +1,61 @@
+"""Uniform interface over user flax modules.
+
+The model-zoo contract (reference model_zoo/*, e.g.
+mnist_functional_api.py:8-26) produces a model object; here that object is a
+flax ``nn.Module`` whose ``__call__(features, training=False)`` takes the
+element produced by the user's ``dataset_fn`` (an array or a dict of
+arrays). This module centralizes the variable-collection plumbing so the
+rest of the framework treats a model as two pytrees:
+
+- ``params``  — trainable (differentiated, shipped as gradients)
+- ``state``   — non-trainable collections (batch_stats etc.), updated by
+  the forward pass in training mode
+
+which mirrors the reference's trainable/non-trainable variable split
+(common/model_utils.py:167-183).
+"""
+
+import jax
+
+
+def init_variables(module, rng, features):
+    """One tracing forward pass to create variables.
+
+    Parity: the reference creates variables with a throwaway eager forward
+    pass before reporting them to the master/PS (worker.py:489-526).
+    """
+    params_rng, dropout_rng = jax.random.split(jax.random.PRNGKey(rng) if isinstance(rng, int) else rng)
+    return module.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        features,
+        training=False,
+    )
+
+
+def split_variables(variables):
+    """variables -> (params, state) where state is every other collection."""
+    variables = dict(variables)
+    params = variables.pop("params", {})
+    return params, variables
+
+
+def merge_variables(params, state):
+    return {"params": params, **(state or {})}
+
+
+def apply_model(module, params, state, features, training=False, rng=None):
+    """Forward pass. Returns ``(output, new_state)``.
+
+    In training mode, non-param collections (batch_stats, ...) are mutable
+    and their updated values are returned; dropout draws from ``rng``.
+    """
+    variables = merge_variables(params, state)
+    rngs = {"dropout": rng} if rng is not None else None
+    mutable = list(state.keys()) if (training and state) else False
+    if mutable:
+        output, new_state = module.apply(
+            variables, features, training=training, rngs=rngs, mutable=mutable
+        )
+        return output, dict(new_state)
+    output = module.apply(variables, features, training=training, rngs=rngs)
+    return output, state

@@ -1,0 +1,14 @@
+"""User hook for handling prediction outputs.
+
+Parity: reference worker/prediction_outputs_processor.py:4-22.
+"""
+
+from abc import ABC, abstractmethod
+
+
+class BasePredictionOutputsProcessor(ABC):
+    """Base class for processing prediction outputs on workers."""
+
+    @abstractmethod
+    def process(self, predictions, worker_id):
+        """Process one batch of predictions produced by ``worker_id``."""

@@ -1,0 +1,155 @@
+"""Bridges master task pulls into one continuous record stream.
+
+Parity: reference worker/task_data_service.py — tasks pulled from the
+master are concatenated into a single generator-backed dataset; pending
+tasks are tracked by record count and reported complete once enough records
+were consumed; a warm-up task primes the data reader's metadata; WAIT tasks
+end the current dataset so the worker loop re-polls later; SAVE_MODEL tasks
+are routed aside for the export path.
+"""
+
+import threading
+from collections import deque
+
+from elasticdl_tpu.common.constants import TaskExecCounterKey, TaskType
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.data.data_reader import create_data_reader
+from elasticdl_tpu.data.dataset import Dataset, create_dataset_from_tasks
+
+
+class TaskDataService:
+    def __init__(
+        self, worker, training_with_evaluation, data_reader_params=None
+    ):
+        self._worker = worker
+        self._training_with_evaluation = training_with_evaluation
+        self._lock = threading.Lock()
+        self._pending_dataset = True
+        self._pending_save_model_task = None
+        self._reset()
+        data_reader_params = data_reader_params or {}
+        self.data_reader = create_data_reader(
+            data_origin=data_reader_params.pop("data_origin", None),
+            **data_reader_params,
+        )
+        self._warm_up_task = None
+        self._has_warmed_up = False
+
+    def _reset(self):
+        self._reported_record_count = 0
+        self._failed_record_count = 0
+        self._pending_tasks = deque()
+        self._current_task = None
+
+    def get_current_task(self):
+        return self._current_task
+
+    def _do_report_task(self, task, err_msg=""):
+        if self._failed_record_count != 0:
+            exec_counters = {
+                TaskExecCounterKey.FAIL_COUNT: self._failed_record_count
+            }
+        else:
+            exec_counters = None
+        self._worker.report_task_result(
+            task.task_id, err_msg, exec_counters=exec_counters
+        )
+
+    def _log_fail_records(self, task, err_msg):
+        logger.warning(
+            'records (%d/%d) failure, possible in task_id: %d reason "%s"'
+            % (
+                self._failed_record_count,
+                task.end - task.start,
+                task.task_id,
+                err_msg,
+            )
+        )
+
+    def report_record_done(self, count, err_msg=""):
+        """Report records consumed; completes + reports drained tasks."""
+        self._reported_record_count += count
+        if err_msg:
+            self._failed_record_count += count
+
+        task = self._pending_tasks[0]
+        total_record_num = task.end - task.start
+        if self._reported_record_count >= total_record_num:
+            if err_msg:
+                self._log_fail_records(task, err_msg)
+            # A single batch may span multiple tasks; keep popping while
+            # the consumed count covers the head task.
+            with self._lock:
+                while self._pending_tasks and self._reported_record_count >= (
+                    self._pending_tasks[0].end - self._pending_tasks[0].start
+                ):
+                    task = self._pending_tasks[0]
+                    self._reported_record_count -= task.end - task.start
+                    self._pending_tasks.popleft()
+                    self._do_report_task(task, err_msg)
+                    self._failed_record_count = 0
+                if self._pending_tasks:
+                    self._current_task = self._pending_tasks[0]
+
+    def get_validation_dataset(self, eval_task):
+        """(dataset, model_version, task_id) for one eval task, or None."""
+        if not eval_task:
+            return None
+        return (
+            create_dataset_from_tasks([eval_task], self.data_reader),
+            eval_task.model_version,
+            eval_task.task_id,
+        )
+
+    def get_save_model_task_and_dataset(self):
+        if not self._pending_save_model_task:
+            return None, None
+        task = self._pending_save_model_task
+        self._pending_save_model_task = None
+        return (task, create_dataset_from_tasks([task], self.data_reader))
+
+    def get_dataset(self):
+        """A Dataset over all tasks the master will hand us, or None."""
+        if not self._pending_dataset:
+            return None
+        if self._pending_tasks:
+            logger.error("Cannot get new dataset when there are pending tasks")
+            return None
+        self._reset()
+        # Warm-up task primes reader metadata without consuming records
+        # (reference task_data_service.py:143-148).
+        if self._warm_up_task is None and not self._has_warmed_up:
+            task = self._worker.get_task()
+            if task.shard_name:
+                self._warm_up_task = task
+                for _ in self.data_reader.read_records(task):
+                    break
+            self._has_warmed_up = True
+        ds = Dataset.from_generator(self._gen)
+        self._pending_dataset = False
+        return ds
+
+    def _gen(self):
+        while True:
+            if self._warm_up_task is not None and self._has_warmed_up:
+                task = self._warm_up_task
+                self._warm_up_task = None
+            else:
+                task = self._worker.get_task()
+            if not task.shard_name:
+                if task.type == TaskType.WAIT:
+                    self._pending_dataset = True
+                    logger.info("Finish current dataset, maybe more data later")
+                else:
+                    logger.info("No more task, stopping")
+                break
+            with self._lock:
+                if task.type == TaskType.SAVE_MODEL:
+                    self._pending_save_model_task = task
+                    continue
+                self._pending_tasks.append(task)
+                if len(self._pending_tasks) == 1:
+                    self._current_task = task
+            for data in self.data_reader.read_records(task):
+                if data is not None:
+                    yield data

@@ -1,0 +1,45 @@
+"""In-process master stub: the central test fixture.
+
+Parity: reference tests/in_process_master.py:5-35 — duck-types the worker's
+master stub by calling MasterServicer methods directly, so a *full*
+distributed train/eval job (task dispatch, gradient aggregation, version
+sync, eval, checkpointing) runs single-process. Callbacks fire around
+report calls for fault injection (reference tests/test_call_back.py).
+"""
+
+from tests.test_callbacks import (
+    ON_REPORT_EVALUATION_METRICS_BEGIN,
+    ON_REPORT_GRADIENT_BEGIN,
+)
+
+
+class InProcessMaster:
+    def __init__(self, master, callbacks=None):
+        self._m = master
+        self._callbacks = callbacks or []
+
+    def get_task(self, worker_id, task_type=None):
+        return self._m.get_task(worker_id, task_type)
+
+    def get_model(self, version, method):
+        return self._m.get_model(version, method)
+
+    def report_variable(self, named_arrays):
+        return self._m.report_variable(named_arrays)
+
+    def report_gradient(self, gradients, model_version):
+        for callback in self._callbacks:
+            if ON_REPORT_GRADIENT_BEGIN in callback.call_times:
+                callback()
+        return self._m.report_gradient(gradients, model_version)
+
+    def report_task_result(self, task_id, err_msg="", exec_counters=None):
+        return self._m.report_task_result(task_id, err_msg, exec_counters)
+
+    def report_evaluation_metrics(self, model_version, model_outputs, labels):
+        for callback in self._callbacks:
+            if ON_REPORT_EVALUATION_METRICS_BEGIN in callback.call_times:
+                callback()
+        return self._m.report_evaluation_metrics(
+            model_version, model_outputs, labels
+        )

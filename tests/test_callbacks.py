@@ -1,0 +1,76 @@
+"""Fault-injection callbacks for the in-process master.
+
+Parity: reference tests/test_call_back.py — callbacks fire at named stages
+inside InProcessMaster; used to force gradient rejection/retry and to
+assert worker/master weight sync at boundaries (reference
+tests/worker_test.py:46-101).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+
+ON_REPORT_GRADIENT_BEGIN = "on_report_gradient_begin"
+ON_REPORT_EVALUATION_METRICS_BEGIN = "on_report_evaluation_metrics_begin"
+
+
+class BaseCallback:
+    """A callback invoked at given stages of master RPC processing."""
+
+    def __init__(self, master, worker, call_times=None):
+        self._master = master
+        self._worker = worker
+        self.call_times = call_times or []
+
+    def __call__(self):
+        raise NotImplementedError
+
+
+class CheckRetryCallback(BaseCallback):
+    """Bumps the master version mid-flight to force rejection + retry.
+
+    Parity: reference tests/worker_test.py:46-66.
+    """
+
+    def __init__(self, master, worker):
+        super().__init__(
+            master, worker, call_times=[ON_REPORT_GRADIENT_BEGIN]
+        )
+        self._retry_injected = False
+
+    def __call__(self):
+        if not self._retry_injected and self._master._version >= 2:
+            self._retry_injected = True
+            self._master._version += 1
+
+
+class CheckWorkerModelCallback(BaseCallback):
+    """Asserts worker-local weights equal master weights at sync points.
+
+    Parity: reference tests/worker_test.py:69-101.
+    """
+
+    def __init__(self, master, worker):
+        super().__init__(
+            master,
+            worker,
+            call_times=[ON_REPORT_EVALUATION_METRICS_BEGIN],
+        )
+        self.checks_run = 0
+
+    def __call__(self):
+        if self._worker._model_version != self._master._version:
+            # worker evaluates a pinned (checkpointed) snapshot; only
+            # compare when it is in sync with the live model
+            return
+        _, master_named = self._master._get_model_no_lock()
+        worker_named = pytree_to_named_arrays(self._worker._params)
+        assert set(master_named) == set(worker_named)
+        for name in master_named:
+            np.testing.assert_allclose(
+                master_named[name],
+                np.asarray(worker_named[name]),
+                rtol=1e-5,
+                atol=1e-5,
+            )
+        self.checks_run += 1

@@ -1,0 +1,214 @@
+"""Shared test fixtures.
+
+Parity: reference tests/test_utils.py — the EDLR fixture generator for 4
+dataset schemas (:54-124) and ``distributed_train_and_evaluate`` (:127-269),
+which runs a *full* distributed train/eval job in one process against the
+in-process master stub and returns the final model version.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import JobType, TaskType
+from elasticdl_tpu.common.model_utils import (
+    get_module_file_path,
+    load_module,
+)
+from elasticdl_tpu.data.example import encode_example
+from elasticdl_tpu.data.recordio import RecordIOWriter
+from elasticdl_tpu.master.checkpoint_service import CheckpointService
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.worker.worker import Worker
+from tests.in_process_master import InProcessMaster
+
+MODEL_ZOO_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "model_zoo"
+)
+
+
+class PserverArgs:
+    """Stub args object for parameter-server tests (reference :25-44)."""
+
+    def __init__(
+        self,
+        grads_to_wait=8,
+        lr_staleness_modulation=0,
+        use_async=False,
+        model_zoo=None,
+        model_def=None,
+        optimizer="optimizer",
+        port=9999,
+        log_level="INFO",
+    ):
+        self.grads_to_wait = grads_to_wait
+        self.lr_staleness_modulation = lr_staleness_modulation
+        self.use_async = use_async
+        self.model_zoo = model_zoo
+        self.model_def = model_def
+        self.optimizer = optimizer
+        self.port = port
+        self.log_level = log_level
+
+
+class DatasetName:
+    IMAGENET = "imagenet1"
+    FRAPPE = "frappe1"
+    TEST_MODULE = "test_module1"
+    IMAGE_DEFAULT = "image_default1"
+
+
+def create_recordio_file(size, dataset_name, shape, temp_dir=None, seed=None):
+    """Write ``size`` synthetic examples of a schema to an EDLR file."""
+    rng = np.random.default_rng(seed)
+    temp_file = tempfile.NamedTemporaryFile(delete=False, dir=temp_dir)
+    with RecordIOWriter(temp_file.name) as f:
+        for _ in range(size):
+            if dataset_name == DatasetName.IMAGENET:
+                # raw uint8 image instead of a JPEG payload: the TPU input
+                # pipeline feeds decoded arrays
+                example = {
+                    "image": rng.integers(
+                        255, size=shape, dtype=np.int64
+                    ).astype(np.uint8),
+                    "label": np.array(
+                        [rng.integers(1, 11)], dtype=np.int64
+                    ),
+                }
+            elif dataset_name == DatasetName.FRAPPE:
+                example = {
+                    "feature": rng.integers(
+                        5383, size=(shape,), dtype=np.int64
+                    ),
+                    "label": np.array(
+                        [rng.integers(2)], dtype=np.int64
+                    ),
+                }
+            elif dataset_name == DatasetName.TEST_MODULE:
+                x = rng.random(shape, dtype=np.float32)
+                example = {"x": x, "y": 2 * x + 1}
+            elif dataset_name == DatasetName.IMAGE_DEFAULT:
+                example = {
+                    "image": rng.random(
+                        int(np.prod(shape)), dtype=np.float32
+                    )
+                    * 255.0,
+                    "label": np.array(
+                        [rng.integers(0, 10)], dtype=np.int64
+                    ),
+                }
+            else:
+                raise ValueError("Unknown dataset name %s." % dataset_name)
+            f.write(encode_example(example))
+    return temp_file.name
+
+
+def distributed_train_and_evaluate(
+    feature_shape,
+    model_zoo_path,
+    model_def,
+    model_params="",
+    eval_metrics_fn="eval_metrics_fn",
+    training=True,
+    dataset_name=DatasetName.IMAGE_DEFAULT,
+    callback_classes=(),
+    use_async=False,
+    get_model_steps=1,
+):
+    """Run a full train/eval job in-process; returns the final version."""
+    job_type = (
+        JobType.TRAINING_WITH_EVALUATION
+        if training
+        else JobType.EVALUATION_ONLY
+    )
+    batch_size = 8 if dataset_name == DatasetName.IMAGENET else 16
+    worker = Worker(
+        worker_id=1,
+        job_type=job_type,
+        minibatch_size=batch_size,
+        model_zoo=model_zoo_path,
+        model_def=model_def,
+        model_params=model_params,
+        eval_metrics_fn=eval_metrics_fn,
+        get_model_steps=get_model_steps,
+    )
+
+    if dataset_name in [DatasetName.IMAGENET, DatasetName.FRAPPE]:
+        record_num = batch_size
+    else:
+        record_num = 128
+    shards = {
+        create_recordio_file(record_num, dataset_name, feature_shape): (
+            0,
+            record_num,
+        )
+    }
+    if training:
+        training_shards = shards
+        evaluation_shards = shards
+    else:
+        training_shards = {}
+        evaluation_shards = shards
+    task_d = TaskDispatcher(
+        training_shards,
+        evaluation_shards,
+        {},
+        records_per_task=64,
+        num_epochs=1,
+    )
+
+    model_module = load_module(
+        get_module_file_path(model_zoo_path, model_def)
+    ).__dict__
+    checkpoint_service = CheckpointService("", 0, 0, True)
+    if training:
+        evaluation_service = EvaluationService(
+            checkpoint_service,
+            None,
+            task_d,
+            0,
+            0,
+            1,
+            False,
+            model_module[eval_metrics_fn],
+        )
+    else:
+        evaluation_service = EvaluationService(
+            checkpoint_service,
+            None,
+            task_d,
+            0,
+            0,
+            0,
+            True,
+            model_module[eval_metrics_fn],
+        )
+    task_d.set_evaluation_service(evaluation_service)
+    grads_to_wait = 1 if use_async else 2
+    master = MasterServicer(
+        grads_to_wait,
+        batch_size,
+        worker._opt_fn(),
+        task_d,
+        init_var=None,
+        checkpoint_filename_for_init=None,
+        checkpoint_service=checkpoint_service,
+        evaluation_service=evaluation_service,
+        use_async=use_async,
+    )
+    callbacks = [
+        callback_class(master, worker) for callback_class in callback_classes
+    ]
+    worker._stub = InProcessMaster(master, callbacks)
+
+    worker.run()
+
+    task = master.get_task(1)
+    if task.shard_name:
+        raise RuntimeError(
+            "There are some tasks unfinished after worker exits."
+        )
+    return master._version

@@ -1,0 +1,90 @@
+"""End-to-end in-process distributed training tests.
+
+Parity: reference tests/worker_test.py + example_test.py — full train/eval
+jobs against the in-process master, gradient-rejection retry, SSP local
+updates, and the sync/async version invariant (async final version is 2x
+the sync version for grads_to_wait=2 over identical data,
+example_test.py:63-65).
+"""
+
+from tests.test_callbacks import CheckRetryCallback, CheckWorkerModelCallback
+from tests.test_utils import (
+    MODEL_ZOO_PATH,
+    DatasetName,
+    distributed_train_and_evaluate,
+)
+
+MNIST_MODEL_DEF = "mnist_functional_api.mnist_functional_api.custom_model"
+
+
+def test_distributed_train_tf_example():
+    version = distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=True,
+    )
+    # 128 records / batch 16 = 8 reports; sync applies every 2 -> 4 versions
+    assert version == 4
+
+
+def test_distributed_evaluate_tf_example():
+    version = distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=False,
+    )
+    assert version == 0
+
+
+def test_async_versions_double_sync():
+    sync_version = distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=True,
+        use_async=False,
+    )
+    async_version = distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=True,
+        use_async=True,
+    )
+    assert async_version == 2 * sync_version
+
+
+def test_worker_gradient_retry():
+    version = distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=True,
+        callback_classes=[CheckRetryCallback],
+    )
+    # the injected version bump adds one phantom version
+    assert version >= 4
+
+
+def test_worker_model_sync_with_master():
+    distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=True,
+        callback_classes=[CheckWorkerModelCallback],
+    )
+
+
+def test_ssp_local_updates():
+    version = distributed_train_and_evaluate(
+        (28, 28),
+        MODEL_ZOO_PATH,
+        MNIST_MODEL_DEF,
+        training=True,
+        use_async=True,
+        get_model_steps=2,
+    )
+    assert version == 8
