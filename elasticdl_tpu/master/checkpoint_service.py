@@ -38,8 +38,6 @@ class CheckpointService:
         )
         self._steps = checkpoint_steps
         self._max_versions = keep_checkpoint_max
-        if self._steps:
-            os.makedirs(self._directory, exist_ok=True)
         self._checkpoint_list = []
         self._include_evaluation = include_evaluation
         self._eval_checkpoint_dir = (
@@ -62,6 +60,10 @@ class CheckpointService:
 
     def save(self, version, named_arrays, is_eval_checkpoint):
         """Write {name: ndarray} at ``version``; ring-evict old ones."""
+        if not is_eval_checkpoint:
+            # created on demand (not in __init__) so one-shot exports work
+            # even when periodic checkpointing (checkpoint_steps=0) is off
+            os.makedirs(self._directory, exist_ok=True)
         file = self._get_checkpoint_file(version, is_eval_checkpoint)
         save_checkpoint_to_file(named_arrays, version, file)
         if not is_eval_checkpoint:

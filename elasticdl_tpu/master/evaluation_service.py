@@ -181,6 +181,9 @@ class EvaluationService:
         checkpoint_version = self._master_servicer.save_eval_checkpoint(
             locking=master_locking
         )
+        if checkpoint_version is None:
+            # checkpoint write failed; do not queue an eval round on it
+            return
         with self._lock:
             self._eval_checkpoint_versions.append(checkpoint_version)
         self._last_eval_checkpoint_version = checkpoint_version
@@ -191,12 +194,16 @@ class EvaluationService:
         with self._lock:
             if self._eval_job is None and self._eval_checkpoint_versions:
                 checkpoint_version = self._eval_checkpoint_versions.pop(0)
-                self._task_d.create_tasks(
-                    TaskType.EVALUATION, checkpoint_version
-                )
-                task_count = len(self._task_d._eval_todo)
+                # create the job BEFORE publishing tasks so a fast worker
+                # can never complete a task while _eval_job is None, and
+                # count tasks from create_tasks' return (reading _eval_todo
+                # after publication is racy with concurrent get_eval_task)
+                task_count = self._task_d.count_tasks(TaskType.EVALUATION)
                 self._eval_job = _EvaluationJob(
                     self._eval_metrics_fn(), checkpoint_version, task_count
+                )
+                self._task_d.create_tasks(
+                    TaskType.EVALUATION, checkpoint_version
                 )
                 return True
         return False
@@ -219,6 +226,8 @@ class EvaluationService:
         )
 
     def complete_task(self):
+        if self._eval_job is None:
+            return
         self._eval_job.complete_task()
         if not self._eval_job.finished():
             return

@@ -398,22 +398,22 @@ class MasterServicer:
         return request_model_version == self._version
 
     def _save_checkpoint(self, locking, is_eval_checkpoint):
+        logger.info("Saving checkpoint for model version %d" % self._version)
+        if locking:
+            self._lock.acquire()
         try:
-            logger.info(
-                "Saving checkpoint for model version %d" % self._version
-            )
-            if locking:
-                self._lock.acquire()
             version, named = self._get_model_no_lock()
             self._checkpoint_service.save(version, named, is_eval_checkpoint)
-            if locking:
-                self._lock.release()
             return version
         except Exception:
             logger.error(
                 "Failed to save checkpoint file for model version %d"
                 % self._version
             )
+            return None
+        finally:
+            if locking:
+                self._lock.release()
 
     def save_eval_checkpoint(self, locking=True):
         return self._save_checkpoint(locking, is_eval_checkpoint=True)

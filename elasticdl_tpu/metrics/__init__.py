@@ -210,7 +210,8 @@ class AUC(Metric):
         tpr = self._tp / self._pos
         fpr = self._fp / self._neg
         # thresholds ascend -> fpr descends; integrate in ascending order
-        return float(np.trapz(tpr[::-1], fpr[::-1]))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        return float(trapezoid(tpr[::-1], fpr[::-1]))
 
     def reset_states(self):
         self._tp = np.zeros(self._n, dtype=np.int64)

@@ -103,6 +103,7 @@ class Worker:
         self._state = {}  # non-trainable collections
         self._model_version = -1
         self._var_created = False
+        self._step_count = 0
 
         self._grad_fn = make_grad_fn(self._model, self._loss)
         self._forward_fn = make_forward_fn(self._model)
@@ -214,9 +215,13 @@ class Worker:
     # -- compute ------------------------------------------------------------
 
     def training_process(self, features, labels):
+        # fresh dropout mask per step per worker: fold in a local step
+        # counter (the model version alone repeats within a sync round and
+        # across workers)
+        self._step_count += 1
         rng = jax.random.fold_in(
-            jax.random.PRNGKey(self._seed),
-            max(self._model_version, 0),
+            jax.random.PRNGKey(self._seed * 100003 + self._worker_id),
+            self._step_count,
         )
         loss, grads, new_state, _ = self._grad_fn(
             self._params, self._state, features, labels, rng
@@ -358,12 +363,13 @@ class Worker:
             if data_err_msg:
                 err_msg = data_err_msg
                 break
-        accepted, _ = self.report_evaluation_metrics(
-            self._evaluation_result[MetricsDictKey.MODEL_OUTPUT],
-            self._evaluation_result[MetricsDictKey.LABEL],
-        )
-        if not accepted:
-            raise RuntimeError("Report evaluation metric failed!")
+        if MetricsDictKey.MODEL_OUTPUT in self._evaluation_result:
+            accepted, _ = self.report_evaluation_metrics(
+                self._evaluation_result[MetricsDictKey.MODEL_OUTPUT],
+                self._evaluation_result[MetricsDictKey.LABEL],
+            )
+            if not accepted:
+                raise RuntimeError("Report evaluation metric failed!")
         self.report_task_result(task_id, err_msg)
         self._evaluation_result = {}
 

@@ -1,0 +1,114 @@
+"""CIFAR10 VGG-style CNN — functional-style model-zoo module.
+
+Parity: reference model_zoo/cifar10_functional_api/cifar10_functional_api.py
+— conv pairs (32, 64, 128) each followed by norm/relu, max-pool and
+dropout (0.2/0.3/0.4), then Dense(10); same dataset_fn/loss/optimizer/
+eval-metric contract, plus a PredictionOutputsProcessor that writes to an
+ODPS table when credentials are present (reference :152-187). GroupNorm
+replaces BatchNormalization (elasticity-invariant, no cross-replica sync).
+"""
+
+import os
+
+import flax.linen as nn
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode, ODPSConfig
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.data.example import FixedLenFeature, parse_example
+from elasticdl_tpu.worker.prediction_outputs_processor import (
+    BasePredictionOutputsProcessor,
+)
+
+
+class Cifar10Model(nn.Module):
+    @nn.compact
+    def __call__(self, features, training=False):
+        x = features["image"]  # (B, 32, 32, 3)
+        for filters, dropout_rate in ((32, 0.2), (64, 0.3), (128, 0.4)):
+            for _ in range(2):
+                x = nn.Conv(filters, (3, 3), padding="SAME", use_bias=True)(x)
+                x = nn.GroupNorm(num_groups=8, epsilon=1e-6)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(dropout_rate, deterministic=not training)(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(10)(x)
+
+
+def custom_model():
+    return Cifar10Model()
+
+
+def loss(output, labels):
+    labels = labels.reshape(-1)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        output, labels
+    ).mean()
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    feature_spec = {"image": FixedLenFeature([32, 32, 3], np.float32)}
+    if mode != Mode.PREDICTION:
+        feature_spec["label"] = FixedLenFeature([1], np.int64)
+
+    def _parse_data(record):
+        r = parse_example(record, feature_spec)
+        features = {"image": (r["image"] / 255.0).astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, r["label"].astype(np.int32)
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: np.equal(
+            np.argmax(predictions, axis=1).astype(np.int32),
+            np.asarray(labels).reshape(-1).astype(np.int32),
+        )
+    }
+
+
+class PredictionOutputsProcessor(BasePredictionOutputsProcessor):
+    """Writes predictions to ODPS when credentials are configured."""
+
+    def __init__(self):
+        if all(
+            k in os.environ
+            for k in (
+                ODPSConfig.PROJECT_NAME,
+                ODPSConfig.ACCESS_ID,
+                ODPSConfig.ACCESS_KEY,
+            )
+        ):
+            from elasticdl_tpu.data.odps_io import ODPSWriter
+
+            self.odps_writer = ODPSWriter(
+                os.environ[ODPSConfig.PROJECT_NAME],
+                os.environ[ODPSConfig.ACCESS_ID],
+                os.environ[ODPSConfig.ACCESS_KEY],
+                os.environ.get(ODPSConfig.ENDPOINT),
+                "cifar10_prediction_outputs",
+                columns=["f" + str(i) for i in range(10)],
+                column_types=["double"] * 10,
+            )
+        else:
+            self.odps_writer = None
+
+    def process(self, predictions, worker_id):
+        if self.odps_writer:
+            self.odps_writer.from_iterator(
+                iter(np.asarray(predictions).tolist()), worker_id
+            )
+        else:
+            logger.info("Predictions: %s", np.asarray(predictions))

@@ -1,0 +1,65 @@
+"""CIFAR10 CNN — subclass-style model-zoo module.
+
+Parity: reference model_zoo/cifar10_subclass/cifar10_subclass.py — the same
+network as cifar10_functional_api defined as a ``CustomModel`` class.
+"""
+
+import flax.linen as nn
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example import FixedLenFeature, parse_example
+
+
+class CustomModel(nn.Module):
+    @nn.compact
+    def __call__(self, inputs, training=False):
+        x = inputs["image"]
+        for filters, dropout_rate in ((32, 0.2), (64, 0.3), (128, 0.4)):
+            for _ in range(2):
+                x = nn.Conv(filters, (3, 3), padding="SAME", use_bias=True)(x)
+                x = nn.GroupNorm(num_groups=8, epsilon=1e-6)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(dropout_rate, deterministic=not training)(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(10)(x)
+
+
+def loss(output, labels):
+    labels = labels.reshape(-1)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        output, labels
+    ).mean()
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    feature_spec = {"image": FixedLenFeature([32, 32, 3], np.float32)}
+    if mode != Mode.PREDICTION:
+        feature_spec["label"] = FixedLenFeature([1], np.int64)
+
+    def _parse_data(record):
+        r = parse_example(record, feature_spec)
+        features = {"image": (r["image"] / 255.0).astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, r["label"].astype(np.int32)
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: np.equal(
+            np.argmax(predictions, axis=1).astype(np.int32),
+            np.asarray(labels).reshape(-1).astype(np.int32),
+        )
+    }

@@ -1,0 +1,75 @@
+"""MNIST CNN — subclass-style model-zoo module.
+
+Parity: reference model_zoo/mnist_subclass/mnist_subclass.py — the same
+network as mnist_functional_api but defined as a model *class*
+(``CustomModel``) resolved through the class path of the zoo contract
+(common/model_utils.py load_model_from_module). GroupNorm replaces
+BatchNormalization (batch-size invariant under elasticity; no cross-replica
+stat sync in the jitted step).
+"""
+
+import flax.linen as nn
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example import FixedLenFeature, parse_example
+
+
+class CustomModel(nn.Module):
+    channel_last: bool = True
+
+    @nn.compact
+    def __call__(self, inputs, training=False):
+        x = inputs["image"]
+        x = (
+            x[..., None]
+            if self.channel_last
+            else x[:, None, :, :].transpose(0, 2, 3, 1)
+        )
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if training:
+            x = nn.Dropout(0.25, deterministic=False)(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(10)(x)
+
+
+def loss(output, labels):
+    labels = labels.reshape(-1)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        output, labels
+    ).mean()
+
+
+def optimizer(lr=0.01):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    feature_spec = {"image": FixedLenFeature([28, 28], np.float32)}
+    if mode != Mode.PREDICTION:
+        feature_spec["label"] = FixedLenFeature([1], np.int64)
+
+    def _parse_data(record):
+        r = parse_example(record, feature_spec)
+        features = {"image": (r["image"] / 255.0).astype(np.float32)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, r["label"].astype(np.int32)
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: np.equal(
+            np.argmax(predictions, axis=1).astype(np.int32),
+            np.asarray(labels).reshape(-1).astype(np.int32),
+        )
+    }

@@ -1,0 +1,77 @@
+"""Iris DNN over ODPS table rows.
+
+Parity: reference model_zoo/odps_iris_dnn_model/odps_iris_dnn_model.py —
+a Dense(3) classifier over 4 numeric columns; ``dataset_fn`` consumes raw
+table rows (sequences of column values) and uses ``metadata.column_names``
+to split out the label column, exactly the reference's contract for the
+ODPS reader path.
+"""
+
+import flax.linen as nn
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+
+
+class IrisModel(nn.Module):
+    @nn.compact
+    def __call__(self, inputs, training=False):
+        x = inputs.reshape((inputs.shape[0], -1))
+        return nn.Dense(3)(x)
+
+
+def custom_model():
+    return IrisModel()
+
+
+def loss(output, labels):
+    labels = labels.reshape(-1).astype(np.int32)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        output, labels
+    ).mean()
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    label_col_name = "class"
+
+    def _parse_data(record):
+        record = np.asarray(record, dtype=np.float32)
+
+        def _features_without_label(label_col_ind):
+            features = np.concatenate(
+                [record[:label_col_ind], record[label_col_ind + 1 :]]
+            )
+            return features.reshape((4, 1))
+
+        if mode != Mode.PREDICTION:
+            if label_col_name not in metadata.column_names:
+                raise ValueError(
+                    "Missing the label column '%s' in the retrieved "
+                    "ODPS table." % label_col_name
+                )
+            label_col_ind = metadata.column_names.index(label_col_name)
+            labels = record[label_col_ind].reshape((1,))
+            return _features_without_label(label_col_ind), labels
+        if label_col_name in metadata.column_names:
+            label_col_ind = metadata.column_names.index(label_col_name)
+            return _features_without_label(label_col_ind)
+        return record.reshape((4, 1))
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=200)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: np.equal(
+            np.argmax(predictions, axis=1).astype(np.int32),
+            np.asarray(labels).reshape(-1).astype(np.int32),
+        )
+    }

@@ -1,0 +1,94 @@
+"""ResNet-50 building blocks in flax.
+
+Parity: reference model_zoo/resnet50_subclass/resnet50_model.py
+(IdentityBlock / ConvBlock keras layers) rebuilt as flax bottleneck blocks.
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU), BatchNorm
+with zero-init on the last block norm (standard large-batch recipe),
+configurable compute dtype so the conv/matmul path can run bfloat16 on the
+MXU while parameters stay float32.
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with optional projection shortcut."""
+
+    filters: int
+    strides: int = 1
+    projection: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not training,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), strides=(self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if self.projection:
+            residual = conv(
+                self.filters * 4,
+                (1, 1),
+                strides=(self.strides, self.strides),
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    """ResNet-50 body: 3-4-6-3 bottleneck stages, softmax head."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        if isinstance(x, dict):
+            x = x["image"]
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64,
+            (7, 7),
+            strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not training,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, (filters, blocks) in enumerate(
+            ((64, 3), (128, 4), (256, 6), (512, 3))
+        ):
+            strides = 1 if i == 0 else 2
+            x = BottleneckBlock(
+                filters, strides=strides, projection=True, dtype=self.dtype
+            )(x, training=training)
+            for _ in range(blocks - 1):
+                x = BottleneckBlock(filters, dtype=self.dtype)(
+                    x, training=training
+                )
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        return nn.softmax(x)

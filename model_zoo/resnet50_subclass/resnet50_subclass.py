@@ -1,0 +1,64 @@
+"""ResNet-50 — subclass-style model-zoo module.
+
+Parity: reference model_zoo/resnet50_subclass/resnet50_subclass.py —
+``CustomModel(num_classes=10, dtype=...)``, softmax output, sparse
+categorical cross-entropy on probabilities, SGD(0.02), raw-image
+dataset_fn. Images arrive as decoded uint8 arrays (the TPU input pipeline
+feeds fixed-shape decoded tensors; JPEG decode/resize happens at data-prep
+time, see tests/test_utils.py IMAGENET schema).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example import decode_example
+
+try:
+    from resnet50_subclass.resnet50_model import ResNet50
+except ImportError:
+    from model_zoo.resnet50_subclass.resnet50_model import ResNet50
+
+
+def CustomModel(num_classes=10, dtype="float32"):
+    return ResNet50(num_classes=num_classes, dtype=jnp.dtype(dtype))
+
+
+def loss(output, labels):
+    labels = labels.reshape(-1)
+    probs = jnp.clip(output, 1e-7, 1.0)
+    nll = -jnp.log(
+        jnp.take_along_axis(probs, labels[:, None], axis=1)[:, 0]
+    )
+    return nll.mean()
+
+
+def optimizer(lr=0.02):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse_data(record):
+        r = decode_example(record)
+        features = {
+            "image": (r["image"].astype(np.float32) / 255.0)
+        }
+        if mode == Mode.PREDICTION:
+            return features
+        # reference labels are 1-based (resnet50_subclass.py:199)
+        return features, (r["label"].astype(np.int32) - 1).reshape(-1)
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: np.equal(
+            np.argmax(predictions, axis=1).astype(np.int32),
+            np.asarray(labels).reshape(-1).astype(np.int32),
+        )
+    }
