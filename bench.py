@@ -1,0 +1,110 @@
+"""Benchmark harness: ResNet-50/ImageNet examples/sec/chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} as required
+by the driver (BASELINE.md). Measures the fused jitted train step (forward
++ backward + SGD update, bfloat16 compute on the MXU, params f32) on the
+locally visible accelerator with on-device synthetic data, so the number
+is the compute-path ceiling the input pipeline must keep fed.
+
+``vs_baseline`` compares against the value recorded in BASELINE.json under
+``published["resnet50_examples_per_sec_per_chip"]`` when present (the
+reference publishes no numbers — BASELINE.md; this repo's own first
+measurement seeds the ratchet), else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    quick = "--quick" in argv
+
+    import jax
+
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+    from elasticdl_tpu.training.step import TrainState, make_train_step
+    from model_zoo.imagenet_resnet50 import imagenet_resnet50 as zoo
+
+    batch = 32 if quick else 128
+    image = 64 if quick else 224
+    steps = 3 if quick else 20
+
+    model = zoo.custom_model()
+    rng = np.random.default_rng(0)
+    features = {
+        "image": rng.random((batch, image, image, 3), dtype=np.float32)
+    }
+    labels = rng.integers(0, 1000, size=(batch, 1)).astype(np.int32)
+
+    variables = init_variables(
+        model, jax.random.PRNGKey(0), {"image": features["image"][:1]}
+    )
+    params, state = split_variables(variables)
+    optimizer = zoo.optimizer()
+    ts = TrainState.create(params, state, optimizer)
+    step_fn = make_train_step(model, zoo.loss, optimizer)
+
+    dev_features = jax.device_put(features)
+    dev_labels = jax.device_put(labels)
+    step_rng = jax.random.PRNGKey(1)
+
+    # warmup/compile. Synchronize with a host scalar fetch, not
+    # block_until_ready: some remote-execution transports (the axon dev
+    # tunnel) return from block_until_ready before compute completes, and
+    # only a device->host read forces full execution.
+    for _ in range(2):
+        ts, loss = step_fn(ts, dev_features, dev_labels, step_rng)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts, loss = step_fn(ts, dev_features, dev_labels, step_rng)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    if not np.isfinite(final_loss):
+        print(json.dumps({"error": "non-finite loss in benchmark"}))
+        return 1
+
+    examples_per_sec = batch * steps / dt
+
+    baseline = None
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BASELINE.json"
+    )
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)["published"].get(
+                "resnet50_examples_per_sec_per_chip"
+            )
+    except Exception:
+        pass
+
+    result = {
+        "metric": "resnet50_examples_per_sec_per_chip",
+        "value": round(examples_per_sec, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(examples_per_sec / baseline, 3)
+        if baseline
+        else 1.0,
+    }
+    print(json.dumps(result))
+
+    if "--update-baseline" in argv and not quick:
+        # persist the ratchet value bench reads back next run
+        with open(baseline_path) as f:
+            data = json.load(f)
+        data.setdefault("published", {})[
+            "resnet50_examples_per_sec_per_chip"
+        ] = result["value"]
+        with open(baseline_path, "w") as f:
+            json.dump(data, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

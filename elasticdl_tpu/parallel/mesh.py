@@ -1,0 +1,83 @@
+"""Device-mesh construction for elastic SPMD training.
+
+The reference scales by adding/removing worker *pods* whose gradients meet
+at a PS/master over gRPC (SURVEY.md §2.3). The TPU-native equivalent keeps
+parameters and gradients in device HBM and lets XLA insert collectives over
+ICI; the "cluster" is a ``jax.sharding.Mesh``. Elasticity = rebuilding the
+mesh over the currently-usable device set and re-placing state (see
+parallel/trainer.py); the task dispatcher above is unchanged.
+
+Axis convention (the seam where tp/sp/ep land without touching the elastic
+scheduler, SURVEY.md §5.7):
+
+- ``data``  — data parallelism (gradient psum rides ICI)
+- ``model`` — tensor parallelism for large layers
+- ``seq``   — sequence/context parallelism (ring attention)
+"""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def create_mesh(mesh_shape=None, axis_names=None, devices=None):
+    """Build a Mesh.
+
+    ``mesh_shape``: dict {axis_name: size} or None for all devices on one
+    ``data`` axis. Sizes must multiply to the device count used; pass
+    ``devices`` to build a mesh over a subset (elastic shrink).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = {a: 1 for a in (axis_names or ())} or {
+            "data": len(devices)
+        }
+        if axis_names:
+            mesh_shape[axis_names[0]] = len(devices)
+    if axis_names is None:
+        axis_names = tuple(mesh_shape.keys())
+    if set(axis_names) != set(mesh_shape):
+        raise ValueError(
+            "axis_names %s do not match mesh_shape keys %s"
+            % (axis_names, tuple(mesh_shape))
+        )
+    sizes = tuple(mesh_shape[a] for a in axis_names)
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(
+            "mesh needs %d devices, only %d available" % (n, len(devices))
+        )
+    dev_array = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, axis_names)
+
+
+def replicated(mesh):
+    """Sharding for state replicated across the whole mesh."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh, axis="data"):
+    """Sharding for a batch split on its leading dim over ``axis``."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def shard_batch(mesh, batch, axis="data"):
+    """Place a host batch onto the mesh, leading dim split over ``axis``.
+
+    The axis size must divide the global batch size; the elastic trainer
+    sizes global batches as (per-chip batch) x (axis size) so this holds
+    across resizes.
+    """
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch
+    )
+
+
+def replicate(mesh, tree):
+    """Place a pytree fully-replicated onto the mesh."""
+    sharding = replicated(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree
+    )

@@ -1,0 +1,124 @@
+"""Elastic on-device data-parallel trainer (the ALLREDUCE strategy).
+
+Replaces the reference's dense-gradient RPC plane (GetModel/ReportGradient
+full-tensor round trips, SURVEY.md §3.3) with a single jitted train step
+over a ``jax.sharding.Mesh``: parameters live replicated in HBM, the global
+batch is split over the ``data`` axis, and XLA inserts the gradient
+reduction over ICI — the ``grads_to_wait`` barrier *is* the collective.
+
+Elasticity: ``resize(devices)`` rebuilds the mesh over the surviving/new
+device set and re-places the train state. Compiled steps are cached per
+(mesh shape, batch shape) so repeated membership changes between the same
+world sizes pay compilation once (SURVEY.md §7.3 amortization note). The
+task dispatcher above is untouched: a resize looks like "some workers'
+tasks were recovered" plus a barrier.
+"""
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.nn.model_api import init_variables, split_variables
+from elasticdl_tpu.parallel.mesh import (
+    create_mesh,
+    replicate,
+    shard_batch,
+)
+from elasticdl_tpu.training.step import TrainState, make_train_step
+
+
+class AllReduceTrainer:
+    def __init__(
+        self,
+        module,
+        loss_fn,
+        optimizer,
+        devices=None,
+        batch_axis="data",
+        seed=0,
+    ):
+        self._module = module
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._batch_axis = batch_axis
+        self._seed = seed
+        self._step_fn = make_train_step(module, loss_fn, optimizer)
+        self._mesh = create_mesh(devices=devices)
+        self._ts = None
+        self._host_step = 0
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def num_devices(self):
+        return self._mesh.devices.size
+
+    @property
+    def train_state(self):
+        return self._ts
+
+    @property
+    def version(self):
+        return int(self._ts.version) if self._ts is not None else -1
+
+    def init_from_batch(self, global_batch):
+        """Create + replicate train state from one example batch."""
+        features = (
+            global_batch[0]
+            if isinstance(global_batch, tuple)
+            else global_batch
+        )
+        host_features = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[:1], features
+        )
+        variables = init_variables(
+            self._module, jax.random.PRNGKey(self._seed), host_features
+        )
+        params, state = split_variables(variables)
+        ts = TrainState.create(params, state, self._optimizer)
+        self._ts = replicate(self._mesh, ts)
+        return self._ts
+
+    def load_state(self, ts):
+        """Adopt an existing host/device train state (checkpoint restore)."""
+        self._ts = replicate(self._mesh, ts)
+
+    def train_step(self, features, labels):
+        """One global step. Batch leading dim must divide the data axis."""
+        if self._ts is None:
+            self.init_from_batch((features, labels))
+        features = shard_batch(self._mesh, features, self._batch_axis)
+        labels = shard_batch(self._mesh, labels, self._batch_axis)
+        self._host_step += 1
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed), self._host_step
+        )
+        with self._mesh:
+            self._ts, loss = self._step_fn(self._ts, features, labels, rng)
+        return loss
+
+    def resize(self, devices):
+        """Membership change: rebuild the mesh and re-place state.
+
+        Survivor state is the source of truth (replaces the reference's
+        re-push-from-workers PS re-init, ps/servicer.py:70-79): parameters
+        are pulled to host from the old placement and re-replicated onto
+        the new mesh.
+        """
+        if self._ts is not None:
+            host_ts = jax.tree_util.tree_map(np.asarray, self._ts)
+        else:
+            host_ts = None
+        self._mesh = create_mesh(devices=devices)
+        logger.info(
+            "membership epoch: mesh re-formed over %d devices",
+            self.num_devices,
+        )
+        if host_ts is not None:
+            self._ts = replicate(self._mesh, host_ts)
+
+    def get_host_state(self):
+        """Pull the train state to host memory (for checkpointing)."""
+        return jax.tree_util.tree_map(np.asarray, self._ts)

@@ -4,14 +4,38 @@ Multi-chip hardware is unavailable in CI; all sharding/collective tests run
 against ``--xla_force_host_platform_device_count=8`` CPU devices, mirroring
 the reference's "fake the cluster in one process" test strategy
 (reference tests/in_process_master.py).
+
+Env vars alone are not enough here: a sitecustomize may pre-register an
+accelerator PJRT plugin and pin ``jax_platforms`` via jax.config at
+interpreter startup, so we override through jax.config and drop any
+already-initialized backends before the first test touches a device.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+except ImportError:
+    clear_backends = getattr(jax, "clear_backends", None)
+if clear_backends is not None:
+    clear_backends()
+
+# Fail fast (not deep inside a sharding test) if the virtual mesh did not
+# come up — e.g. a CPU client predating this file already latched XLA_FLAGS.
+_n = len(jax.devices())
+if _n < 8:
+    raise RuntimeError(
+        "test bootstrap expected >=8 virtual CPU devices, got %d; a JAX "
+        "backend was initialized before conftest could apply XLA_FLAGS" % _n
+    )
