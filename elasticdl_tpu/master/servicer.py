@@ -92,7 +92,24 @@ class MasterServicer:
         self._opt_state = None
         self._lr_modulation = None
         self._opt = self._init_optimizer(optimizer)
-        self._embedding_gradient_applier = embedding_gradient_applier
+        # master-central elastic-embedding store (replaces the reference's
+        # external Redis EmbeddingService, master/embedding_service.py):
+        # tables + optimizer slots live in a host Parameters store, updated
+        # by the structure-generic OptimizerWrapper
+        from elasticdl_tpu.ps.optimizer_wrapper import OptimizerWrapper
+        from elasticdl_tpu.ps.parameters import Parameters
+
+        self._embedding_store = Parameters()
+        self._embedding_store.initialized = True
+        if embedding_gradient_applier is not None:
+            self._embedding_gradient_applier = embedding_gradient_applier
+        elif self._opt is not None:
+            wrapper = OptimizerWrapper(self._opt, self._embedding_store)
+            self._embedding_gradient_applier = (
+                lambda grads: wrapper.apply_gradients(embedding_grads=grads)
+            )
+        else:
+            self._embedding_gradient_applier = None
 
         self._init_model(checkpoint_filename_for_init, init_var)
 
@@ -216,7 +233,10 @@ class MasterServicer:
             name = tensor.name
             if name not in self._model:
                 if tensor.is_indexed_slices():
-                    # elastic embedding layer: table lives outside the model
+                    # elastic embedding layer: table lives outside the
+                    # model; validate against the embedding store (name
+                    # registered via push_embedding_info + dim match)
+                    self._embedding_store.check_grad(tensor)
                     edl_embedding_gradients[name] = tensor
                     continue
                 raise ValueError(
@@ -261,6 +281,16 @@ class MasterServicer:
             if not self._use_async:
                 self._lock.release()
         return True, self._version
+
+    def push_embedding_info(self, embedding_infos):
+        """Register elastic embedding tables (proto EmbeddingTableInfo
+        analog, elasticdl.proto:76-80)."""
+        with self._lock:
+            self._embedding_store.init_embedding_params(embedding_infos)
+
+    def pull_embedding_vectors(self, layer_name, ids):
+        """Rows for ``ids`` from the master-central store (lazy init)."""
+        return self._embedding_store.get_embedding_param(layer_name, ids)
 
     def report_task_result(self, task_id, err_message="", exec_counters=None):
         if err_message:

@@ -1,0 +1,171 @@
+"""Elastic embedding layer (unbounded vocab, externally stored rows).
+
+Parity: reference elasticdl/layers/embedding.py — a layer whose table
+lives outside the worker (sharded PS / master KV), pulling only the rows a
+batch touches and pushing sparse row gradients back; supports mask_zero
+and sum/mean/sqrtn combiners.
+
+TPU-native redesign: the reference escapes the graph with
+``tf.py_function(lookup)`` per call (embedding.py:234-236), which would
+defeat jit/XLA. Here the lookup is *hoisted out of the compiled step*:
+
+1. the worker captures each elastic layer's ids on host with a flax
+   method interceptor (:func:`capture_embedding_ids`) — no RPC, no real
+   compute needed (the layer is short-circuited to zeros),
+2. unique rows are pulled from the store and padded to a power-of-two
+   bucket (bounds XLA recompiles across varying unique-id counts),
+3. the jitted step receives rows via the ``edl_embedding`` collection and
+   position indices via ``edl_embedding_idx``; inside the graph the layer
+   is a pure static-shape gather — MXU/VPU friendly, nothing leaves HBM,
+4. gradients w.r.t. the rows collection come out of ``value_and_grad``
+   batched per layer — the BET (batch-embedding-tensor) analog
+   (reference worker.py:358-377) — and ship as IndexedSlices frames.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+ROWS_COLLECTION = "edl_embedding"
+IDX_COLLECTION = "edl_embedding_idx"
+
+
+class Embedding(nn.Module):
+    """Elastic embedding: rows are per-batch inputs, not parameters.
+
+    ``output_dim`` is the embedding dimension; the vocabulary is unbounded
+    (rows materialize lazily in the store, ps/embedding_table.py).
+    """
+
+    output_dim: int
+    embedding_initializer: str = "uniform"
+    mask_zero: bool = False
+    input_length: int = None
+    combiner: str = None
+
+    @nn.compact
+    def __call__(self, ids, training=False):
+        ids = jnp.asarray(ids).astype(jnp.int32)
+        rows = self.variable(
+            ROWS_COLLECTION,
+            "rows",
+            lambda: jnp.zeros((1, self.output_dim), jnp.float32),
+        ).value
+        idx = self.variable(
+            IDX_COLLECTION,
+            "idx",
+            lambda: jnp.zeros(ids.shape, jnp.int32),
+        ).value
+        emb = jnp.take(rows, idx, axis=0)  # ids.shape + (dim,)
+        if self.mask_zero:
+            emb = emb * (ids != 0).astype(emb.dtype)[..., None]
+        if self.combiner is not None:
+            if self.mask_zero:
+                counts = jnp.maximum(
+                    (ids != 0).sum(axis=-1, keepdims=True), 1
+                ).astype(emb.dtype)
+            else:
+                counts = jnp.full((ids.shape[0], 1), ids.shape[-1], emb.dtype)
+            total = emb.sum(axis=-2)
+            if self.combiner == "sum":
+                emb = total
+            elif self.combiner == "mean":
+                emb = total / counts
+            elif self.combiner == "sqrtn":
+                emb = total / jnp.sqrt(counts)
+            else:
+                raise ValueError("Unknown combiner %r" % self.combiner)
+        return emb
+
+
+class _CaptureDone(Exception):
+    """Internal: aborts the capture forward once all layers reported."""
+
+
+def capture_embedding_ids(module, variables, features, expected_count=None):
+    """Run one short-circuited host forward; returns {path: ids ndarray}.
+
+    ``path`` is the module path tuple of each elastic Embedding call —
+    the key under which its rows/idx live in the variable collections.
+    The layer body is skipped (returns zeros), so no rows are needed; when
+    ``expected_count`` is given the forward aborts as soon as every layer
+    has reported, so post-embedding layers never execute on host.
+    """
+    captured = {}
+
+    def interceptor(next_fun, args, kwargs, context):
+        if (
+            isinstance(context.module, Embedding)
+            and context.method_name == "__call__"
+        ):
+            ids = np.asarray(args[0])
+            path = context.module.path
+            if path in captured:
+                raise NotImplementedError(
+                    "elastic Embedding %r called more than once per forward"
+                    " is not supported (the reference trains such models "
+                    "eagerly, worker.py:514-524)" % (path,)
+                )
+            captured[path] = ids
+            if (
+                expected_count is not None
+                and len(captured) >= expected_count
+            ):
+                raise _CaptureDone()
+            mod = context.module
+            out_shape = ids.shape + (mod.output_dim,)
+            if mod.combiner is not None:
+                out_shape = ids.shape[:-1] + (mod.output_dim,)
+            return jnp.zeros(out_shape, jnp.float32)
+        return next_fun(*args, **kwargs)
+
+    try:
+        with nn.intercept_methods(interceptor):
+            module.apply(variables, features, training=False)
+    except _CaptureDone:
+        pass
+    return captured
+
+
+def plan_lookup(ids, bucket_min=8):
+    """unique ids + per-element positions, padded to a pow2 bucket.
+
+    Returns (unique_ids (k,), idx ids.shape int32, bucket_size).
+    Static bucket sizes keep the jitted step's shapes stable across
+    batches with different unique-id counts.
+    """
+    flat = np.asarray(ids).reshape(-1).astype(np.int64)
+    unique, inverse = np.unique(flat, return_inverse=True)
+    k = len(unique)
+    bucket = bucket_min
+    while bucket < k:
+        bucket *= 2
+    idx = inverse.reshape(np.asarray(ids).shape).astype(np.int32)
+    return unique, idx, bucket
+
+
+def path_name(path):
+    """Collection path tuple -> the store's table/layer name."""
+    return "/".join(str(p) for p in path)
+
+
+def flatten_collection(tree, leaf_name, prefix=()):
+    """Nested collection dict -> {path_tuple: array} for ``leaf_name``."""
+    out = {}
+    for key, value in tree.items():
+        if key == leaf_name and not isinstance(value, dict):
+            out[prefix] = value
+        elif isinstance(value, dict):
+            out.update(flatten_collection(value, leaf_name, prefix + (key,)))
+    return out
+
+
+def build_collection(arrays_by_path, leaf_name):
+    """{path_tuple: array} -> nested collection dict with ``leaf_name``."""
+    tree = {}
+    for path, arr in arrays_by_path.items():
+        node = tree
+        for part in path:
+            node = node.setdefault(part, {})
+        node[leaf_name] = arr
+    return tree

@@ -1,0 +1,86 @@
+"""Host-side embedding table with lazy row initialization.
+
+Parity: reference ps/embedding_table.py — rows materialize on first get
+using a named initializer; slot tables (optimizer state rows) use a
+constant initializer; slot-table naming is ``"{layer}-{slot}"``.
+
+This is the PS-mode (host HBM) store for tables too large to replicate.
+The TPU-native fast path keeps tables sharded in device HBM instead
+(parallel/embedding_sharding.py); both share the same naming/layout so
+checkpoints interoperate.
+"""
+
+import threading
+
+import numpy as np
+
+
+def _make_initializer(name, seed=0):
+    rng = np.random.default_rng(seed)
+    name = (name or "uniform").lower()
+
+    if name in ("uniform", "random_uniform"):
+        return lambda dim: rng.uniform(-0.05, 0.05, size=dim).astype(
+            np.float32
+        )
+    if name in ("normal", "random_normal"):
+        return lambda dim: rng.normal(0.0, 0.05, size=dim).astype(np.float32)
+    if name.startswith("zero"):
+        return lambda dim: np.zeros(dim, dtype=np.float32)
+    if name.startswith("ones"):
+        return lambda dim: np.ones(dim, dtype=np.float32)
+    try:
+        const = float(name)
+        return lambda dim: np.full(dim, const, dtype=np.float32)
+    except ValueError:
+        raise ValueError("Unknown embedding initializer %r" % name)
+
+
+class EmbeddingTable:
+    def __init__(self, name, dim=None, initializer=None, is_slot=False):
+        """``initializer``: name string; slot tables pass the constant
+        value as a string (reference embedding_table.py:31-33)."""
+        self.name = name
+        self.dim = dim
+        self.initializer_name = initializer
+        self.is_slot = is_slot
+        self._initializer = _make_initializer(initializer)
+        self._lock = threading.Lock()
+        self.embedding_vectors = {}
+
+    def get(self, indices):
+        """Rows for ``indices`` (lazy-init missing ones). -> (n, dim)."""
+        if len(indices) == 0:
+            return None
+        values = []
+        with self._lock:
+            for i in indices:
+                i = int(i)
+                value = self.embedding_vectors.get(i)
+                if value is None:
+                    value = self._initializer(self.dim)
+                    self.embedding_vectors[i] = value
+                values.append(value)
+        return np.stack(values)
+
+    def set(self, indices, values):
+        values = np.asarray(values)
+        with self._lock:
+            for pos, i in enumerate(indices):
+                self.embedding_vectors[int(i)] = values[pos].copy()
+
+    def clear(self):
+        with self._lock:
+            self.embedding_vectors.clear()
+
+    def __len__(self):
+        return len(self.embedding_vectors)
+
+
+def create_embedding_table(name, dim, initializer="uniform"):
+    return EmbeddingTable(name, dim, initializer)
+
+
+def get_slot_table_name(layer_name, slot_name):
+    """Reference embedding_table.py:68-69."""
+    return layer_name + "-" + slot_name

@@ -1,0 +1,166 @@
+"""Sparse-aware optimizer wrapper for PS-mode training.
+
+Parity: reference master/optimizer_wrapper.py — for embedding-layer
+gradients it looks up the touched rows *and their optimizer-slot rows*
+from the store, applies the optimizer to just those rows, and writes rows +
+slots back; duplicate ids in one gradient are combined first; slot tables
+are named ``"{layer}-{slot}"``.
+
+TPU-native improvement over the reference's per-optimizer slot registry
+(SGD/Adam/Adamax/Nadam/Adadelta/Adagrad/Ftrl/RMSprop hand-tables,
+optimizer_wrapper.py:159-192): optax optimizer *state is introspected
+structurally*. Any state leaf shaped like the parameter rows is a slot
+table (keyed by its pytree path); anything else (step counters etc.) is
+kept whole per layer. Fresh rows get slot values from ``opt.init`` on a
+zero row, so accumulator-style initializers (adagrad/adadelta) are exact.
+This works for every optax transformation, present or future, with no
+registry to maintain.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.tensor import _join_path as _path_str
+from elasticdl_tpu.ps.embedding_table import get_slot_table_name
+
+
+class OptimizerWrapper:
+    def __init__(self, optimizer, parameters=None, use_async=False):
+        """``optimizer``: optax GradientTransformation. ``parameters``:
+        a ps.Parameters store holding the embedding tables (and the dense
+        params in PS mode)."""
+        self._opt = optimizer
+        self._params = parameters
+        self._use_async = use_async
+        self._lock = threading.Lock()
+        # per embedding layer: pytree paths of row-shaped state leaves and
+        # the non-row residue of the optimizer state
+        self._non_row_state = {}
+        self._dense_opt_state = None
+        self._template_cache = {}  # dim -> (state, treedef, row_paths)
+
+    # -- dense path ---------------------------------------------------------
+
+    def apply_dense_gradients(self, grads):
+        """Full optax update over the store's dense params."""
+        store = self._params
+        with self._lock:
+            params = store.non_embedding_params
+            full = {}
+            for name, p in params.items():
+                g = grads.get(name)
+                full[name] = (
+                    np.asarray(g, dtype=np.float32)
+                    if g is not None
+                    else np.zeros_like(p)
+                )
+            if self._dense_opt_state is None:
+                self._dense_opt_state = self._opt.init(params)
+            updates, self._dense_opt_state = self._opt.update(
+                full, self._dense_opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            store.non_embedding_params = {
+                k: np.asarray(v, dtype=np.float32)
+                for k, v in new_params.items()
+            }
+
+    # -- sparse path --------------------------------------------------------
+
+    @staticmethod
+    def combine_duplicate_ids(indices, values):
+        """Sum rows of duplicate ids (reference merges IndexedSlices)."""
+        indices = np.asarray(indices, dtype=np.int64)
+        values = np.asarray(values, dtype=np.float32)
+        unique, inverse = np.unique(indices, return_inverse=True)
+        combined = np.zeros((len(unique), values.shape[1]), dtype=np.float32)
+        np.add.at(combined, inverse, values)
+        return unique, combined
+
+    def _row_state_template(self, dim):
+        """opt.init on a single zero row: slot layout + fresh-row values.
+
+        Memoized per dim (it is structural, not data-dependent) so the
+        async hot path pays no repeated opt.init/tree traversal.
+        """
+        cached = self._template_cache.get(dim)
+        if cached is not None:
+            return cached
+        template_row = np.zeros((1, dim), dtype=np.float32)
+        state = self._opt.init(template_row)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+        row_paths = {}
+        for path, leaf in leaves:
+            if hasattr(leaf, "shape") and tuple(np.shape(leaf)) == (1, dim):
+                row_paths[_path_str(path)] = np.asarray(leaf)[0]
+        self._template_cache[dim] = (state, treedef, row_paths)
+        return self._template_cache[dim]
+
+    def apply_sparse_gradients(self, layer_name, indices, values):
+        """Apply one embedding layer's sparse gradient to its rows."""
+        store = self._params
+        table = store.embedding_params[layer_name]
+        dim = table.dim
+        unique_ids, grad_rows = self.combine_duplicate_ids(indices, values)
+
+        with self._lock:
+            rows = table.get(unique_ids)  # (k, dim), lazy init
+            state_template, treedef, row_slot_init = self._row_state_template(
+                dim
+            )
+
+            # gather slot rows (create slot tables lazily with exact init)
+            slot_rows = {}
+            for slot_path, fresh_row in row_slot_init.items():
+                slot_table_name = get_slot_table_name(layer_name, slot_path)
+                if slot_table_name not in store.embedding_params:
+                    store.create_slot_params(
+                        [slot_path], {slot_path: float(fresh_row.flat[0])}
+                    )
+                slot_rows[slot_path] = store.embedding_params[
+                    slot_table_name
+                ].get(unique_ids)
+
+            non_row = self._non_row_state.setdefault(layer_name, {})
+
+            # rebuild the optimizer state pytree for these k rows
+            leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(
+                state_template
+            )
+            rebuilt = []
+            for path, leaf in leaves_with_path:
+                key = _path_str(path)
+                if key in slot_rows:
+                    rebuilt.append(slot_rows[key])
+                elif key in non_row:
+                    rebuilt.append(non_row[key])
+                else:
+                    rebuilt.append(leaf)
+            state = jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+            updates, new_state = self._opt.update(grad_rows, state, rows)
+            new_rows = optax.apply_updates(rows, updates)
+
+            # scatter back rows, slot rows, and non-row state
+            table.set(unique_ids, np.asarray(new_rows))
+            new_leaves, _ = jax.tree_util.tree_flatten_with_path(new_state)
+            for path, leaf in new_leaves:
+                key = _path_str(path)
+                if key in slot_rows:
+                    store.embedding_params[
+                        get_slot_table_name(layer_name, key)
+                    ].set(unique_ids, np.asarray(leaf))
+                else:
+                    non_row[key] = np.asarray(leaf)
+
+    def apply_gradients(self, dense_grads=None, embedding_grads=None):
+        """Combined apply: {name: ndarray} dense + {layer: Tensor} sparse."""
+        if dense_grads:
+            self.apply_dense_gradients(dense_grads)
+        for layer_name, tensor in (embedding_grads or {}).items():
+            self.apply_sparse_gradients(
+                layer_name, tensor.indices, tensor.values
+            )

@@ -1,0 +1,154 @@
+"""PS parameter store: dense params + embedding tables + slot tables.
+
+Parity: reference ps/parameters.py — ``non_embedding_params`` as a
+``{name: array}`` dict, ``embedding_params`` as ``{layer: EmbeddingTable}``,
+init-once semantics from a pushed model payload, gradient shape/index
+validation, and slot-table creation named ``"{layer}-{slot}"``.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.ps.embedding_table import (
+    EmbeddingTable,
+    get_slot_table_name,
+)
+
+
+class EmbeddingTableInfo:
+    """Metadata a worker pushes before using an elastic embedding layer.
+
+    Parity: proto EmbeddingTableInfo (elasticdl.proto:76-80).
+    """
+
+    def __init__(self, name, dim, initializer="uniform"):
+        self.name = name
+        self.dim = dim
+        self.initializer = initializer
+
+
+class Parameters:
+    def __init__(self):
+        self.version = 0
+        self.initialized = False
+        self.non_embedding_params = {}
+        self.embedding_params = {}
+        self._lock = threading.Lock()
+
+    def get_non_embedding_param(self, name, default=None):
+        return self.non_embedding_params.get(name, default)
+
+    def get_embedding_param(self, name, indices):
+        if name not in self.embedding_params:
+            raise ValueError(
+                "Please initialize embedding param %s first!" % name
+            )
+        return self.embedding_params[name].get(indices)
+
+    def set_embedding_param(self, name, indices, values):
+        if name not in self.embedding_params:
+            raise ValueError(
+                "Please initialize embedding param %s first!" % name
+            )
+        self.embedding_params[name].set(indices, values)
+
+    def check_grad(self, grad):
+        """Validate a Tensor gradient against the stored parameter.
+
+        Parity: reference parameters.py:47-102.
+        """
+        name = grad.name
+        param = self.get_non_embedding_param(name)
+        if param is None:
+            if name in self.embedding_params:
+                if grad.indices is None:
+                    raise ValueError(
+                        "Embedding gradient %s must be indexed" % name
+                    )
+                if grad.values.shape[1] != self.embedding_params[name].dim:
+                    raise ValueError(
+                        "Incompatible embedding dimension for %s: %d vs %d"
+                        % (
+                            name,
+                            grad.values.shape[1],
+                            self.embedding_params[name].dim,
+                        )
+                    )
+                return True
+            raise ValueError("Name error: %s is not in Parameters" % name)
+        if grad.indices is not None:
+            if grad.values.shape[1] != param.shape[1]:
+                raise ValueError(
+                    "Incompatible indexed slice dimension for %s" % name
+                )
+            if int(np.max(grad.indices)) >= param.shape[0]:
+                raise ValueError(
+                    "Grad indices out of range for %s" % name
+                )
+        elif grad.values.shape != param.shape:
+            raise ValueError("Incompatible gradient dimension for %s" % name)
+        return True
+
+    def init_from_model(self, version, dense_params, embedding_infos):
+        """First-write-wins init from a worker's pushed model.
+
+        ``dense_params``: {name: ndarray}; ``embedding_infos``: iterable of
+        EmbeddingTableInfo. Returns True if this call initialized.
+        Parity: reference parameters.py:104-124, ps/servicer.py:70-79.
+        """
+        with self._lock:
+            if self.initialized:
+                # embedding infos may still arrive later (new layers after
+                # a PS restart re-push)
+                self.init_embedding_params(embedding_infos)
+                return False
+            for name, arr in dense_params.items():
+                self.non_embedding_params[name] = np.asarray(
+                    arr, dtype=np.float32
+                ).copy()
+            self.init_embedding_params(embedding_infos)
+            self.version = max(0, int(version))
+            self.initialized = True
+            return True
+
+    def init_embedding_params(self, embedding_infos):
+        for info in embedding_infos or ():
+            if info.name not in self.embedding_params:
+                self.embedding_params[info.name] = EmbeddingTable(
+                    info.name, info.dim, info.initializer
+                )
+
+    def has_embedding_params(self):
+        return len(self.embedding_params) > 0
+
+    def create_slot_params(self, slot_names, init_values):
+        """Create co-located slot tables for every embedding table.
+
+        ``init_values``: {slot_name: constant}. Parity: reference
+        parameters.py:145-159.
+        """
+        embedding_dims = {
+            name: table.dim
+            for name, table in self.embedding_params.items()
+            if not table.is_slot
+        }
+        for layer_name, dim in embedding_dims.items():
+            for slot_name in slot_names:
+                key = get_slot_table_name(layer_name, slot_name)
+                if key not in self.embedding_params:
+                    table = EmbeddingTable(
+                        key,
+                        dim,
+                        initializer=str(init_values.get(slot_name, 0.0)),
+                        is_slot=True,
+                    )
+                    self.embedding_params[key] = table
+
+    def to_named_arrays(self):
+        """Dense params snapshot (for pull_variable / checkpoint)."""
+        return {
+            name: arr.copy()
+            for name, arr in self.non_embedding_params.items()
+        }

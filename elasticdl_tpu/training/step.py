@@ -111,6 +111,72 @@ def make_train_step(module, loss_fn, optimizer, pmean_axis=None):
     return jax.jit(step, donate_argnums=(0,))
 
 
+def make_embedding_grad_fn(module, loss_fn):
+    """Jitted grad step for models with elastic embedding layers.
+
+    ``(params, rows_tree, state, idx_tree, features, labels, rng) ->
+    (loss, param_grads, row_grads, new_state, output)``
+
+    ``rows_tree``/``idx_tree`` are the ``edl_embedding`` /
+    ``edl_embedding_idx`` collections built per batch by the worker
+    (nn/embedding.py). Differentiating w.r.t. the rows collection yields
+    the per-layer batch-embedding-tensor gradients the reference captures
+    with ``tape.watch`` (reference layers/embedding.py:200-214).
+    """
+    from elasticdl_tpu.nn.embedding import IDX_COLLECTION, ROWS_COLLECTION
+
+    def step(params, rows_tree, state, idx_tree, features, labels, rng):
+        def loss_of(p, rows):
+            variables = {
+                "params": p,
+                ROWS_COLLECTION: rows,
+                IDX_COLLECTION: idx_tree,
+                **state,
+            }
+            mutable = list(state.keys()) if state else False
+            rngs = {"dropout": rng}
+            if mutable:
+                output, new_state = module.apply(
+                    variables,
+                    features,
+                    training=True,
+                    rngs=rngs,
+                    mutable=mutable,
+                )
+                new_state = dict(new_state)
+            else:
+                output = module.apply(
+                    variables, features, training=True, rngs=rngs
+                )
+                new_state = state
+            return loss_fn(output, labels), (output, new_state)
+
+        (loss, (output, new_state)), (param_grads, row_grads) = (
+            jax.value_and_grad(loss_of, argnums=(0, 1), has_aux=True)(
+                params, rows_tree
+            )
+        )
+        return loss, param_grads, row_grads, new_state, output
+
+    return jax.jit(step)
+
+
+def make_embedding_forward_fn(module):
+    """Jitted inference forward for elastic-embedding models."""
+    from elasticdl_tpu.nn.embedding import IDX_COLLECTION, ROWS_COLLECTION
+
+    def fwd(params, rows_tree, state, idx_tree, features):
+        variables = {
+            "params": params,
+            ROWS_COLLECTION: rows_tree,
+            IDX_COLLECTION: idx_tree,
+            **state,
+        }
+        return module.apply(variables, features, training=False)
+
+    return jax.jit(fwd)
+
+
 def make_forward_fn(module):
     """Jitted inference forward ``(params, state, features) -> output``."""
 

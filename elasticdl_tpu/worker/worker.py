@@ -45,8 +45,23 @@ from elasticdl_tpu.common.tensor import (
     named_arrays_to_pytree,
     pytree_to_named_arrays,
 )
+from elasticdl_tpu.nn.embedding import (
+    IDX_COLLECTION,
+    ROWS_COLLECTION,
+    build_collection,
+    capture_embedding_ids,
+    flatten_collection,
+    path_name,
+    plan_lookup,
+)
 from elasticdl_tpu.nn.model_api import init_variables, split_variables
-from elasticdl_tpu.training.step import make_forward_fn, make_grad_fn
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+from elasticdl_tpu.training.step import (
+    make_embedding_forward_fn,
+    make_embedding_grad_fn,
+    make_forward_fn,
+    make_grad_fn,
+)
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
 
@@ -107,6 +122,10 @@ class Worker:
 
         self._grad_fn = make_grad_fn(self._model, self._loss)
         self._forward_fn = make_forward_fn(self._model)
+        # elastic embedding layers (populated at variable creation)
+        self._embedding_dims = {}  # {path_tuple: dim}
+        self._emb_grad_fn = None
+        self._emb_forward_fn = None
 
         # local optimizer for SSP local updates (reference worker.py:122-126)
         self._local_opt = None
@@ -150,10 +169,11 @@ class Worker:
     def report_variable(self):
         self._stub.report_variable(pytree_to_named_arrays(self._params))
 
-    def report_gradient(self, grads):
-        """Ship the gradient pytree as named dense tensors."""
+    def report_gradient(self, grads, sparse_tensors=None):
+        """Ship dense grads as named tensors (+ sparse embedding grads)."""
         named = pytree_to_named_arrays(grads)
         tensors = [Tensor(name, values) for name, values in named.items()]
+        tensors.extend(sparse_tensors or ())
         return self._stub.report_gradient(tensors, self._model_version)
 
     def report_evaluation_metrics(self, model_outputs, labels):
@@ -191,7 +211,28 @@ class Worker:
                 self._model, jax.random.PRNGKey(self._seed), features
             )
             self._params, self._state = split_variables(variables)
+            # elastic embedding collections are per-batch inputs, not state
+            rows_template = self._state.pop(ROWS_COLLECTION, None)
+            self._state.pop(IDX_COLLECTION, None)
+            if rows_template:
+                self._embedding_dims = {
+                    path: int(arr.shape[-1])
+                    for path, arr in flatten_collection(
+                        rows_template, "rows"
+                    ).items()
+                }
+                self._emb_grad_fn = make_embedding_grad_fn(
+                    self._model, self._loss
+                )
+                self._emb_forward_fn = make_embedding_forward_fn(self._model)
         if not self._var_created:
+            if self._embedding_dims:
+                self._stub.push_embedding_info(
+                    [
+                        EmbeddingTableInfo(path_name(path), dim)
+                        for path, dim in self._embedding_dims.items()
+                    ]
+                )
             self.report_variable()
             self._var_created = True
 
@@ -212,6 +253,56 @@ class Worker:
         self._params = optax.apply_updates(self._params, updates)
         self._non_embed_grads = None
 
+    # -- elastic embedding plumbing ----------------------------------------
+
+    def _prepare_embedding_batch(self, features):
+        """Capture ids, pull + pad rows; returns (rows, idx, plan).
+
+        ``plan``: {path: (unique_ids, k)} for stripping padded gradients.
+        This is the hoisted-out-of-jit equivalent of the reference's
+        in-graph py_function lookup (layers/embedding.py:216-253).
+        """
+        variables = {"params": self._params, **self._state}
+        captured = capture_embedding_ids(
+            self._model,
+            variables,
+            features,
+            expected_count=len(self._embedding_dims),
+        )
+        rows_by_path, idx_by_path, plan = {}, {}, {}
+        for path, ids in captured.items():
+            unique, idx, bucket = plan_lookup(ids)
+            rows = self._stub.pull_embedding_vectors(
+                path_name(path), unique
+            )
+            rows = np.asarray(rows, dtype=np.float32)
+            if rows.shape[0] < bucket:
+                rows = np.concatenate(
+                    [
+                        rows,
+                        np.zeros(
+                            (bucket - rows.shape[0], rows.shape[1]),
+                            np.float32,
+                        ),
+                    ]
+                )
+            rows_by_path[path] = rows
+            idx_by_path[path] = idx
+            plan[path] = (unique, len(unique))
+        return (
+            build_collection(rows_by_path, "rows"),
+            build_collection(idx_by_path, "idx"),
+            plan,
+        )
+
+    def _sparse_grad_tensors(self, row_grads, plan):
+        grads_by_path = flatten_collection(row_grads, "rows")
+        tensors = []
+        for path, (unique, k) in plan.items():
+            g = np.asarray(grads_by_path[path])[:k]
+            tensors.append(Tensor(path_name(path), g, indices=unique))
+        return tensors
+
     # -- compute ------------------------------------------------------------
 
     def training_process(self, features, labels):
@@ -223,18 +314,32 @@ class Worker:
             jax.random.PRNGKey(self._seed * 100003 + self._worker_id),
             self._step_count,
         )
+        if self._embedding_dims:
+            rows, idx, plan = self._prepare_embedding_batch(features)
+            loss, grads, row_grads, new_state, _ = self._emb_grad_fn(
+                self._params, rows, self._state, idx, features, labels, rng
+            )
+            self._state = new_state
+            return loss, grads, self._sparse_grad_tensors(row_grads, plan)
         loss, grads, new_state, _ = self._grad_fn(
             self._params, self._state, features, labels, rng
         )
         self._state = new_state
-        return loss, grads
+        return loss, grads, None
 
     def forward_process(self, features):
+        if self._embedding_dims:
+            rows, idx, _ = self._prepare_embedding_batch(features)
+            return self._emb_forward_fn(
+                self._params, rows, self._state, idx, features
+            )
         return self._forward_fn(self._params, self._state, features)
 
     def _run_training_task(self, features, labels):
-        loss, grads = self.training_process(features, labels)
-        accepted, min_model_version = self.report_gradient(grads)
+        loss, grads, sparse_grads = self.training_process(features, labels)
+        accepted, min_model_version = self.report_gradient(
+            grads, sparse_grads
+        )
         if accepted and self._get_model_steps > 1:
             self._non_embed_grads = grads
         return accepted, min_model_version, loss

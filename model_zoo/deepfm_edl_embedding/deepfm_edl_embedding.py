@@ -1,0 +1,104 @@
+"""DeepFM with elastic (externally-stored) embedding tables.
+
+Parity: reference model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py —
+the same DeepFM architecture as deepfm_functional_api but with
+``elasticdl.layers.Embedding`` (unbounded vocab, rows pulled on demand,
+sparse gradients pushed back). Here the layers are
+``elasticdl_tpu.nn.embedding.Embedding``: the table lives in the
+master/PS store; the jitted step sees only the rows the batch touches
+(nn/embedding.py module docstring describes the hoisted-lookup design).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example import FixedLenFeature, parse_example
+from elasticdl_tpu.metrics import AUC
+from elasticdl_tpu.nn.embedding import Embedding
+
+
+class DeepFMEdl(nn.Module):
+    embedding_dim: int = 64
+    input_length: int = 10
+    fc_unit: int = 64
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = features["feature"].astype(jnp.int32)  # (B, L)
+        mask = (ids != 0).astype(jnp.float32)[..., None]
+
+        embeddings = Embedding(
+            output_dim=self.embedding_dim, mask_zero=True, name="embedding"
+        )(ids)
+        embeddings = embeddings * mask
+
+        emb_sum = embeddings.sum(axis=1)
+        second_order = 0.5 * (
+            jnp.square(emb_sum) - jnp.square(embeddings).sum(axis=1)
+        ).sum(axis=1)
+
+        id_bias = Embedding(output_dim=1, mask_zero=True, name="id_bias")(
+            ids
+        )
+        id_bias = id_bias * mask
+        first_order = id_bias.sum(axis=(1, 2))
+        fm_output = first_order + second_order
+
+        nn_input = embeddings.reshape((embeddings.shape[0], -1))
+        deep_output = nn.Dense(1)(nn.Dense(self.fc_unit)(nn_input))
+        deep_output = deep_output.reshape(-1)
+
+        logits = fm_output + deep_output
+        probs = nn.sigmoid(logits).reshape((-1, 1))
+        return {"logits": logits, "probs": probs}
+
+
+def custom_model(embedding_dim=64, input_length=10, fc_unit=64):
+    return DeepFMEdl(
+        embedding_dim=embedding_dim,
+        input_length=input_length,
+        fc_unit=fc_unit,
+    )
+
+
+def loss(output, labels):
+    logits = output["logits"].reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    feature_spec = {"feature": FixedLenFeature([10], np.int64)}
+    if mode != Mode.PREDICTION:
+        feature_spec["label"] = FixedLenFeature([1], np.int64)
+
+    def _parse_data(record):
+        r = parse_example(record, feature_spec)
+        features = {"feature": r["feature"].astype(np.int64)}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, r["label"].astype(np.int32)
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "logits": {
+            "accuracy": lambda labels, predictions: np.equal(
+                (np.asarray(predictions).reshape(-1) > 0.0).astype(np.int32),
+                np.asarray(labels).reshape(-1).astype(np.int32),
+            )
+        },
+        "probs": {"auc": AUC()},
+    }

@@ -27,6 +27,12 @@ class InProcessMaster:
     def report_variable(self, named_arrays):
         return self._m.report_variable(named_arrays)
 
+    def push_embedding_info(self, embedding_infos):
+        return self._m.push_embedding_info(embedding_infos)
+
+    def pull_embedding_vectors(self, layer_name, ids):
+        return self._m.pull_embedding_vectors(layer_name, ids)
+
     def report_gradient(self, gradients, model_version):
         for callback in self._callbacks:
             if ON_REPORT_GRADIENT_BEGIN in callback.call_times:

@@ -58,6 +58,21 @@ def test_deepfm_functional_train():
     assert version == 1
 
 
+def test_deepfm_edl_embedding_train():
+    """Elastic-embedding DeepFM: rows pulled from the master store, sparse
+    gradients applied by the OptimizerWrapper (reference
+    example_test.py deepfm_edl flavour)."""
+    version = distributed_train_and_evaluate(
+        10,
+        MODEL_ZOO_PATH,
+        "deepfm_edl_embedding.deepfm_edl_embedding.custom_model",
+        training=True,
+        dataset_name=DatasetName.FRAPPE,
+        use_async=True,
+    )
+    assert version == 1
+
+
 @pytest.mark.slow
 def test_resnet50_subclass_train():
     version = distributed_train_and_evaluate(
