@@ -1,0 +1,344 @@
+"""Argument/flag system for all four roles.
+
+Parity: reference common/args.py (643 lines) — shared parameter groups,
+role-specific parsers (client/train/evaluate/predict, master, PS, worker),
+cross-flag validation (async forces ``grads_to_wait=1``, sync forces
+``get_model_steps=1``, args.py:547-556), the ``--envs k=v,...`` parser, and
+``build_arguments_from_parsed_result`` which re-serializes parsed args back
+into CLI flags so config flows client -> master pod -> worker/PS pods
+entirely via argv (args.py:622-643).
+"""
+
+import argparse
+
+
+def pos_int(arg):
+    res = int(arg)
+    if res <= 0:
+        raise ValueError("Positive integer argument required. Got %s" % res)
+    return res
+
+
+def non_neg_int(arg):
+    res = int(arg)
+    if res < 0:
+        raise ValueError(
+            "Non-negative integer argument required. Got %s" % res
+        )
+    return res
+
+
+def parse_envs(arg):
+    """Parse ``key1=val1,key2=val2`` into a dict (reference args.py:61-86)."""
+    env_dict = {}
+    if not arg:
+        return env_dict
+    for pair in arg.split(","):
+        key, _, value = pair.partition("=")
+        env_dict[key.strip()] = value.strip()
+    return env_dict
+
+
+def print_args(args, exclude_args=(), groups=None):
+    from elasticdl_tpu.common.log_utils import default_logger as logger
+
+    for key, value in sorted(vars(args).items()):
+        if key not in exclude_args:
+            logger.info("%s = %s", key, value)
+
+
+# -- shared groups ----------------------------------------------------------
+
+
+def add_bool_param(parser, name, default, help):
+    parser.add_argument(
+        name,
+        nargs="?",
+        const=not default,
+        default=default,
+        type=lambda x: x.lower() in ["true", "yes", "t", "y"],
+        help=help,
+    )
+
+
+def add_common_params(parser):
+    """Client-common params (reference args.py:100-209)."""
+    add_common_args_between_master_and_worker(parser)
+    parser.add_argument(
+        "--docker_image_repository",
+        default="",
+        help="Image repository for the job images",
+    )
+    parser.add_argument("--image_base", default="", help="Base docker image")
+    parser.add_argument("--job_name", help="Job name", required=True)
+    parser.add_argument(
+        "--master_resource_request",
+        default="cpu=0.1,memory=1024Mi",
+        help="Master resource request",
+    )
+    parser.add_argument(
+        "--master_resource_limit",
+        default="",
+        help="Master resource limit; defaults to the request",
+    )
+    parser.add_argument(
+        "--num_workers", type=int, default=0, help="Number of workers"
+    )
+    parser.add_argument(
+        "--worker_resource_request",
+        default="cpu=1,memory=4096Mi",
+        help="Worker resource request (a TPU worker requests tpu=N here)",
+    )
+    parser.add_argument(
+        "--worker_resource_limit", default="", help="Worker resource limit"
+    )
+    parser.add_argument(
+        "--master_pod_priority", default="", help="Master pod priority"
+    )
+    parser.add_argument(
+        "--worker_pod_priority", default="", help="Worker pod priority"
+    )
+    parser.add_argument(
+        "--volume",
+        default="",
+        help='Volume spec, e.g. "claim_name=c1,mount_path=/path1"',
+    )
+    parser.add_argument(
+        "--image_pull_policy",
+        default="Always",
+        help="Image pull policy of the job pods",
+    )
+    parser.add_argument(
+        "--restart_policy", default="Never", help="Pod restart policy"
+    )
+    parser.add_argument(
+        "--envs",
+        default="",
+        help="Env vars for the job pods, e.g. 'a=b,c=d'",
+    )
+    parser.add_argument(
+        "--extra_pypi_index", default="", help="Extra pypi index url"
+    )
+    parser.add_argument(
+        "--namespace",
+        default="default",
+        help="Kubernetes namespace for the job pods",
+    )
+    parser.add_argument(
+        "--num_minibatches_per_task",
+        type=pos_int,
+        default=2,
+        help="Number of minibatches per task",
+    )
+    parser.add_argument(
+        "--cluster_spec",
+        default="",
+        help="Python module rewriting pod/service specs for private clouds",
+    )
+    parser.add_argument("--docker_base_url", default="unix://var/run/docker.sock")
+    parser.add_argument("--docker_tlscert", default="")
+    parser.add_argument("--docker_tlskey", default="")
+    parser.add_argument(
+        "--num_ps_pods", type=int, default=1, help="Number of PS pods"
+    )
+    parser.add_argument(
+        "--ps_resource_request",
+        default="cpu=1,memory=4096Mi",
+        help="PS resource request",
+    )
+    parser.add_argument(
+        "--ps_resource_limit", default="", help="PS resource limit"
+    )
+    parser.add_argument("--ps_pod_priority", default="")
+
+
+def add_train_params(parser):
+    """Training params (reference args.py:212-330)."""
+    parser.add_argument(
+        "--tensorboard_log_dir",
+        default="",
+        help="Directory for scalar summaries",
+    )
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument(
+        "--grads_to_wait",
+        type=pos_int,
+        default=1,
+        help="Gradients to accumulate before a sync update",
+    )
+    parser.add_argument("--training_data", default="", required=True)
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument(
+        "--evaluation_steps",
+        type=non_neg_int,
+        default=0,
+        help="Evaluate every this many model versions",
+    )
+    parser.add_argument(
+        "--evaluation_start_delay_secs", type=non_neg_int, default=100
+    )
+    parser.add_argument(
+        "--evaluation_throttle_secs", type=non_neg_int, default=0
+    )
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument(
+        "--keep_checkpoint_max", type=non_neg_int, default=0
+    )
+    parser.add_argument("--checkpoint_filename_for_init", default="")
+    parser.add_argument(
+        "--output", default="", help="Trained-model export path"
+    )
+    add_bool_param(
+        parser,
+        "--use_async",
+        False,
+        "Apply gradients asynchronously (host-PS mode only; the ALLREDUCE "
+        "strategy is always synchronous in-step)",
+    )
+    add_bool_param(
+        parser,
+        "--lr_staleness_modulation",
+        False,
+        "Modulate learning rate by 1/staleness in async mode",
+    )
+
+
+def add_evaluate_params(parser):
+    parser.add_argument("--validation_data", default="", required=True)
+    parser.add_argument("--checkpoint_filename_for_init", required=True)
+    parser.add_argument(
+        "--evaluation_steps", type=non_neg_int, default=0
+    )
+
+
+def add_predict_params(parser):
+    parser.add_argument("--prediction_data", default="", required=True)
+    parser.add_argument("--prediction_outputs_processor", default="PredictionOutputsProcessor")
+    parser.add_argument("--checkpoint_filename_for_init", required=True)
+
+
+def add_clean_params(parser):
+    parser.add_argument("--docker_image_repository", default="")
+    add_bool_param(parser, "--all", False, "Remove all local images")
+    parser.add_argument("--docker_base_url", default="unix://var/run/docker.sock")
+    parser.add_argument("--docker_tlscert", default="")
+    parser.add_argument("--docker_tlskey", default="")
+
+
+def add_common_args_between_master_and_worker(parser):
+    """Shared master/worker params (reference args.py:418-500)."""
+    parser.add_argument("--minibatch_size", type=pos_int, required=True)
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument(
+        "--log_level",
+        default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
+    )
+    parser.add_argument("--dataset_fn", default="dataset_fn")
+    parser.add_argument("--loss", default="loss")
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--eval_metrics_fn", default="eval_metrics_fn")
+    parser.add_argument("--model_def", required=True)
+    parser.add_argument("--model_params", default="")
+    parser.add_argument(
+        "--get_model_steps",
+        type=pos_int,
+        default=1,
+        help="Pull the model every this many steps (SSP local updates)",
+    )
+    parser.add_argument("--data_reader_params", default="")
+    parser.add_argument(
+        "--distribution_strategy",
+        default="ParameterServerStrategy",
+        choices=["ParameterServerStrategy", "AllreduceStrategy", "Local"],
+        help="ParameterServerStrategy keeps the reference's host-PS "
+        "semantics; AllreduceStrategy is the TPU-native in-step XLA "
+        "collective path",
+    )
+
+
+def parse_master_args(master_args=None):
+    parser = argparse.ArgumentParser(description="ElasticDL TPU Master")
+    parser.add_argument("--port", type=pos_int, default=50001)
+    parser.add_argument("--worker_image", default="")
+    parser.add_argument("--prediction_data", default="")
+    add_common_params(parser)
+    add_train_params(parser)
+    args, unknown = parser.parse_known_args(args=master_args)
+    _validate(args)
+    return args
+
+
+def parse_ps_args(ps_args=None):
+    parser = argparse.ArgumentParser(description="ElasticDL TPU PS")
+    parser.add_argument("--ps_id", type=non_neg_int, required=True)
+    parser.add_argument("--port", type=pos_int, required=True)
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", required=True)
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--grads_to_wait", type=pos_int, default=1)
+    add_bool_param(parser, "--use_async", False, "")
+    add_bool_param(parser, "--lr_staleness_modulation", False, "")
+    parser.add_argument(
+        "--log_level",
+        default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
+    )
+    args, unknown = parser.parse_known_args(args=ps_args)
+    return args
+
+
+def parse_worker_args(worker_args=None):
+    parser = argparse.ArgumentParser(description="ElasticDL TPU Worker")
+    parser.add_argument("--worker_id", type=int, required=True)
+    parser.add_argument("--job_type", required=True)
+    parser.add_argument("--master_addr", default="")
+    parser.add_argument("--ps_addrs", default="", help="Comma-separated")
+    parser.add_argument(
+        "--prediction_outputs_processor",
+        default="PredictionOutputsProcessor",
+    )
+    add_common_args_between_master_and_worker(parser)
+    args, unknown = parser.parse_known_args(args=worker_args)
+    return args
+
+
+def _validate(args):
+    """Cross-flag validation (reference args.py:547-556)."""
+    if getattr(args, "use_async", False) and args.grads_to_wait > 1:
+        args.grads_to_wait = 1
+        from elasticdl_tpu.common.log_utils import default_logger as logger
+
+        logger.warning(
+            "grads_to_wait is forced to 1 for async SGD"
+        )
+    if not getattr(args, "use_async", False):
+        if getattr(args, "get_model_steps", 1) > 1:
+            args.get_model_steps = 1
+            from elasticdl_tpu.common.log_utils import (
+                default_logger as logger,
+            )
+
+            logger.warning(
+                "get_model_steps is forced to 1 for sync SGD"
+            )
+
+
+def build_arguments_from_parsed_result(args, filter_args=None):
+    """Reconstruct CLI flags from parsed args to forward to child pods.
+
+    Reference args.py:622-643 — the master re-serializes its own args into
+    the worker/PS command lines, so config flows purely via argv.
+    """
+    items = vars(args).items()
+    if filter_args:
+        items = [(k, v) for k, v in items if k not in filter_args]
+    arguments = []
+    for key, value in items:
+        if value is None:
+            continue
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        arguments.extend(["--" + key, str(value)])
+    return arguments

@@ -32,6 +32,11 @@ from elasticdl_tpu.master.learning_rate_modulator import (
 )
 
 
+# checkpoint keys carrying elastic-embedding tables (ids + rows + slots);
+# they resume the master-central store and are filtered out of worker pulls
+_EMBEDDING_EXPORT_PREFIX = "edl_embedding:"
+
+
 class TaskResponse:
     """The GetTask reply (reference proto Task, elasticdl.proto:24-54)."""
 
@@ -128,12 +133,60 @@ class MasterServicer:
         self._model[name] = value
         self._opt_state = None  # structure changed; re-init lazily
 
+    def _export_embedding_tables(self):
+        """Embedding tables (+slots) as checkpointable named arrays.
+
+        The reference left tables in external Redis that outlived the
+        master (embedding tables were NOT checkpointed — TODO at reference
+        model_handler.py:208-216); here the store is in-master, so the
+        checkpoint is the persistence and must include them.
+        """
+        out = {}
+        for name, table in self._embedding_store.embedding_params.items():
+            if not table.embedding_vectors:
+                continue
+            ids = np.fromiter(
+                table.embedding_vectors.keys(), dtype=np.int64
+            )
+            rows = np.stack(
+                [table.embedding_vectors[int(i)] for i in ids]
+            ).astype(np.float32)
+            out[_EMBEDDING_EXPORT_PREFIX + name + ":ids"] = ids
+            out[_EMBEDDING_EXPORT_PREFIX + name + ":rows"] = rows
+        return out
+
+    def _import_embedding_tables(self, named):
+        """Split embedding-export keys out of a checkpoint; returns the
+        remaining dense params."""
+        from elasticdl_tpu.ps.embedding_table import EmbeddingTable
+
+        dense = {}
+        tables = {}
+        for key, arr in named.items():
+            if not key.startswith(_EMBEDDING_EXPORT_PREFIX):
+                dense[key] = arr
+                continue
+            body = key[len(_EMBEDDING_EXPORT_PREFIX) :]
+            table_name, _, kind = body.rpartition(":")
+            tables.setdefault(table_name, {})[kind] = arr
+        for table_name, parts in tables.items():
+            ids = parts["ids"].astype(np.int64)
+            rows = parts["rows"]
+            table = EmbeddingTable(
+                table_name, int(rows.shape[1]), "uniform",
+                is_slot="-" in table_name,
+            )
+            table.set(ids, rows)
+            self._embedding_store.embedding_params[table_name] = table
+        return dense
+
     def _init_model(self, checkpoint_filename_for_init, init_var):
         if checkpoint_filename_for_init:
             version, named = load_from_checkpoint_file(
                 checkpoint_filename_for_init
             )
             self._version = version
+            named = self._import_embedding_tables(named)
             for name, arr in named.items():
                 self.set_model_var(name, arr.astype(np.float32, copy=False))
         elif init_var:
@@ -192,7 +245,15 @@ class MasterServicer:
                 return self._get_model_no_lock()
         # FIXED: serve the pinned version from its checkpoint
         try:
-            return self._checkpoint_service.get_checkpoint_model(version)
+            ckpt_version, named = (
+                self._checkpoint_service.get_checkpoint_model(version)
+            )
+            named = {
+                k: v
+                for k, v in named.items()
+                if not k.startswith(_EMBEDDING_EXPORT_PREFIX)
+            }
+            return ckpt_version, named
         except Exception:
             logger.error(
                 "Failed to fetch checkpoint model for model version %s",
@@ -433,6 +494,7 @@ class MasterServicer:
             self._lock.acquire()
         try:
             version, named = self._get_model_no_lock()
+            named.update(self._export_embedding_tables())
             self._checkpoint_service.save(version, named, is_eval_checkpoint)
             return version
         except Exception:

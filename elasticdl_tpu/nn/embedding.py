@@ -82,14 +82,20 @@ class _CaptureDone(Exception):
     """Internal: aborts the capture forward once all layers reported."""
 
 
-def capture_embedding_ids(module, variables, features, expected_count=None):
+def capture_embedding_ids(
+    module, variables, features, expected_count=None, layer_info=None
+):
     """Run one short-circuited host forward; returns {path: ids ndarray}.
 
     ``path`` is the module path tuple of each elastic Embedding call —
     the key under which its rows/idx live in the variable collections.
     The layer body is skipped (returns zeros), so no rows are needed; when
     ``expected_count`` is given the forward aborts as soon as every layer
-    has reported, so post-embedding layers never execute on host.
+    has reported, so post-embedding layers never execute on host. When a
+    dict is passed as ``layer_info`` it is filled with
+    {path: (output_dim, embedding_initializer)} so callers can register
+    tables with the layer-declared initializer (the reference forwards it
+    in EmbeddingTableInfo, elasticdl.proto:76-80).
     """
     captured = {}
 
@@ -107,6 +113,11 @@ def capture_embedding_ids(module, variables, features, expected_count=None):
                     "eagerly, worker.py:514-524)" % (path,)
                 )
             captured[path] = ids
+            if layer_info is not None:
+                layer_info[path] = (
+                    context.module.output_dim,
+                    context.module.embedding_initializer,
+                )
             if (
                 expected_count is not None
                 and len(captured) >= expected_count

@@ -28,13 +28,15 @@ from elasticdl_tpu.ps.embedding_table import get_slot_table_name
 
 
 class OptimizerWrapper:
-    def __init__(self, optimizer, parameters=None, use_async=False):
+    def __init__(self, optimizer, parameters=None):
         """``optimizer``: optax GradientTransformation. ``parameters``:
         a ps.Parameters store holding the embedding tables (and the dense
-        params in PS mode)."""
+        params in PS mode). Thread safety is uniform: every apply holds
+        the wrapper lock (async mode differs only upstream, in when
+        applies happen — reference uses thread-local temp vars instead,
+        optimizer_wrapper.py:154-156)."""
         self._opt = optimizer
         self._params = parameters
-        self._use_async = use_async
         self._lock = threading.Lock()
         # per embedding layer: pytree paths of row-shaped state leaves and
         # the non-row residue of the optimizer state

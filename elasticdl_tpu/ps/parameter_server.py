@@ -1,0 +1,69 @@
+"""Parameter-server process entry.
+
+Parity: reference ps/parameter_server.py + ps/main.py — loads the
+optimizer from the model-zoo module, serves the Pserver RPCs on a 64-thread
+gRPC server, then sleeps forever (the master relaunches dead PS pods with
+the same id/service DNS so workers re-resolve transparently).
+"""
+
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import (
+    get_module_file_path,
+    load_module,
+)
+from elasticdl_tpu.ps.parameters import Parameters
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.rpc.core import serve
+
+
+class ParameterServer:
+    def __init__(self, args):
+        self._args = args
+        self._server = None
+        module = load_module(
+            get_module_file_path(args.model_zoo, args.model_def)
+        ).__dict__
+        self._optimizer = module[args.optimizer]()
+        self.parameters = Parameters()
+        self.servicer = PserverServicer(
+            self.parameters,
+            args.grads_to_wait,
+            self._optimizer,
+            lr_staleness_modulation=bool(args.lr_staleness_modulation),
+            use_async=args.use_async,
+        )
+
+    def prepare(self):
+        self._server = serve(self.servicer.rpc_methods(), self._args.port)
+        logger.info(
+            "RPC server started on port %d", self._server._edl_port
+        )
+
+    def run(self):
+        try:
+            while True:
+                time.sleep(60)
+        except KeyboardInterrupt:
+            logger.warning("Server stopping")
+        finally:
+            self.stop()
+
+    def stop(self):
+        if self._server:
+            self._server.stop(grace=None)
+            self._server = None
+
+
+def main():
+    from elasticdl_tpu.common.args import parse_ps_args
+
+    args = parse_ps_args()
+    server = ParameterServer(args)
+    server.prepare()
+    server.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Parameter-server RPC servicer.
+
+Parity: reference ps/servicer.py — five RPCs over the PS store:
+``pull_variable`` (all dense params + init status), ``pull_embedding_vector``
+(lazy-init row lookup), ``push_model`` (first-write-wins init),
+``push_embedding_info``, and ``push_gradient`` (async: apply immediately,
+version++; sync: reject stale versions, accumulate until ``grads_to_wait``,
+average dense / concat sparse, apply, version++).
+
+Methods take/return plain dicts (the rpc.core message model) so the same
+object serves real gRPC or in-process tests unchanged.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.master.learning_rate_modulator import (
+    add_lr_modulation_to_optimizer,
+)
+from elasticdl_tpu.ps.optimizer_wrapper import OptimizerWrapper
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+
+
+class PserverServicer:
+    def __init__(
+        self,
+        parameters,
+        grads_to_wait,
+        optimizer,
+        lr_staleness_modulation=False,
+        use_async=False,
+    ):
+        self._parameters = parameters
+        self._grads_to_wait = grads_to_wait
+        self._lock = threading.Lock()
+        self._use_async = use_async
+        self._version_lock = threading.Lock()
+        self._lr_modulation = None
+        if use_async and lr_staleness_modulation and optimizer is not None:
+            optimizer, self._lr_modulation = add_lr_modulation_to_optimizer(
+                optimizer
+            )
+        self._optimizer = OptimizerWrapper(optimizer, parameters)
+        self._dense_sum = {}
+        self._indexed_sum = {}
+        self._grad_n = 0
+
+    # -- RPC methods --------------------------------------------------------
+
+    def pull_variable(self, req):
+        """All non-embedding params + init status (reference :36-57)."""
+        if not self._parameters.initialized:
+            return {"model_init_status": False, "version": -1}
+        named = self._parameters.to_named_arrays()
+        return {
+            "model_init_status": True,
+            "version": self._parameters.version,
+            "params": [Tensor(n, v) for n, v in sorted(named.items())],
+        }
+
+    def pull_embedding_vector(self, req):
+        """Rows for req['ids'] of table req['name'] (lazy init)."""
+        ids = np.asarray(req["ids"], dtype=np.int64)
+        if ids.size == 0:
+            return {"rows": np.zeros((0, 0), np.float32)}
+        rows = self._parameters.get_embedding_param(req["name"], ids)
+        return {"rows": rows}
+
+    def push_model(self, req):
+        """First-write-wins model init (reference :70-79)."""
+        dense = {t.name: t.values for t in req.get("params", [])}
+        infos = [
+            EmbeddingTableInfo(i["name"], i["dim"], i.get("initializer", "uniform"))
+            for i in req.get("embedding_infos", [])
+        ]
+        with self._lock:
+            self._parameters.init_from_model(
+                req.get("version", 0), dense, infos
+            )
+        return {}
+
+    def push_embedding_info(self, req):
+        with self._lock:
+            self._parameters.init_embedding_params(
+                EmbeddingTableInfo(
+                    i["name"], i["dim"], i.get("initializer", "uniform")
+                )
+                for i in req.get("embedding_infos", [])
+            )
+        return {}
+
+    def push_gradient(self, req):
+        """Sync/async gradient apply (reference :88-150)."""
+        version = int(req.get("model_version", -1))
+        gradients = req.get("gradients", [])
+        if self._use_async:
+            self._apply(gradients, version)
+            return {"accepted": True, "version": self._parameters.version}
+
+        with self._lock:
+            if version < self._parameters.version:
+                logger.warning(
+                    "Dropping stale gradient for version %d (current %d)",
+                    version,
+                    self._parameters.version,
+                )
+                return {
+                    "accepted": False,
+                    "version": self._parameters.version,
+                }
+            for t in gradients:
+                self._parameters.check_grad(t)
+                if t.is_indexed_slices():
+                    if t.name in self._indexed_sum:
+                        self._indexed_sum[t.name] = (
+                            self._indexed_sum[t.name] + t
+                        )
+                    else:
+                        self._indexed_sum[t.name] = t
+                else:
+                    if t.name in self._dense_sum:
+                        self._dense_sum[t.name] = (
+                            self._dense_sum[t.name] + t.values
+                        )
+                    else:
+                        self._dense_sum[t.name] = t.values.copy()
+            self._grad_n += 1
+            if self._grad_n >= self._grads_to_wait:
+                dense = {
+                    k: v / self._grads_to_wait
+                    for k, v in self._dense_sum.items()
+                }
+                self._optimizer.apply_gradients(
+                    dense_grads=dense, embedding_grads=self._indexed_sum
+                )
+                self._parameters.version += 1
+                self._dense_sum.clear()
+                self._indexed_sum.clear()
+                self._grad_n = 0
+            return {"accepted": True, "version": self._parameters.version}
+
+    def _apply(self, gradients, request_version):
+        if self._lr_modulation:
+            staleness = max(1, self._parameters.version - request_version)
+            self._lr_modulation.set_multiplier(1.0 / staleness)
+        dense, sparse = {}, {}
+        for t in gradients:
+            self._parameters.check_grad(t)
+            if t.is_indexed_slices():
+                sparse[t.name] = t
+            else:
+                dense[t.name] = t.values
+        self._optimizer.apply_gradients(
+            dense_grads=dense, embedding_grads=sparse
+        )
+        with self._version_lock:
+            self._parameters.version += 1
+
+    # -- rpc.core wiring ----------------------------------------------------
+
+    def rpc_methods(self):
+        """{method_name: fn} map for rpc.core.serve."""
+        return {
+            "pull_variable": self.pull_variable,
+            "pull_embedding_vector": self.pull_embedding_vector,
+            "push_model": self.push_model,
+            "push_embedding_info": self.push_embedding_info,
+            "push_gradient": self.push_gradient,
+        }

@@ -1,0 +1,180 @@
+"""Control-plane RPC: gRPC transport without protoc codegen.
+
+Parity: the reference's wire layer is gRPC + generated protobuf stubs
+(elasticdl/proto, Makefile codegen). Here the transport is still gRPC
+(C-core — the native substrate the reference relies on, SURVEY.md §2.4)
+but messages are self-describing frames from the framework codec
+(common/tensor.py), served through generic bytes-in/bytes-out handlers —
+no .proto build step, same 256 MB caps. Only control-plane and host-PS
+traffic rides this; the ALLREDUCE tensor plane never leaves device HBM.
+
+Message model: a dict whose values are JSON scalars/lists, np.ndarrays,
+``Tensor`` objects, or lists of Tensors.
+"""
+
+import json
+import struct
+from concurrent import futures
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common.tensor import (
+    Tensor,
+    deserialize_tensor,
+    serialize_tensor,
+)
+
+_SERVICE = "elasticdl_tpu.Rpc"
+
+
+def pack_message(msg):
+    """dict -> bytes. Arrays/Tensors ride as codec frames."""
+    header = {}
+    segments = []
+
+    def add_segment(data):
+        segments.append(data)
+        return len(segments) - 1
+
+    for key, value in msg.items():
+        if isinstance(value, Tensor):
+            header[key] = {"t": "tensor", "i": add_segment(value.to_bytes())}
+        elif isinstance(value, np.ndarray):
+            header[key] = {
+                "t": "array",
+                "i": add_segment(serialize_tensor(Tensor(key, value))),
+            }
+        elif (
+            isinstance(value, (list, tuple))
+            and value
+            and isinstance(value[0], Tensor)
+        ):
+            idxs = [add_segment(t.to_bytes()) for t in value]
+            header[key] = {"t": "tensors", "i": idxs}
+        elif isinstance(value, (bytes, bytearray)):
+            header[key] = {"t": "bytes", "i": add_segment(bytes(value))}
+        else:
+            header[key] = {"t": "json", "v": value}
+    hdr = json.dumps(header).encode("utf-8")
+    out = [struct.pack("<I", len(hdr)), hdr, struct.pack("<I", len(segments))]
+    for seg in segments:
+        out.append(struct.pack("<Q", len(seg)))
+        out.append(seg)
+    return b"".join(out)
+
+
+def unpack_message(data):
+    view = memoryview(data)
+    (hlen,) = struct.unpack_from("<I", view, 0)
+    header = json.loads(bytes(view[4 : 4 + hlen]).decode("utf-8"))
+    off = 4 + hlen
+    (nseg,) = struct.unpack_from("<I", view, off)
+    off += 4
+    segments = []
+    for _ in range(nseg):
+        (slen,) = struct.unpack_from("<Q", view, off)
+        off += 8
+        segments.append(bytes(view[off : off + slen]))
+        off += slen
+    msg = {}
+    for key, spec in header.items():
+        kind = spec["t"]
+        if kind == "json":
+            msg[key] = spec["v"]
+        elif kind == "bytes":
+            msg[key] = segments[spec["i"]]
+        elif kind == "tensor":
+            msg[key] = deserialize_tensor(segments[spec["i"]])
+        elif kind == "array":
+            msg[key] = deserialize_tensor(segments[spec["i"]]).values
+        elif kind == "tensors":
+            msg[key] = [deserialize_tensor(segments[i]) for i in spec["i"]]
+        else:
+            raise ValueError("unknown field kind %r" % kind)
+    return msg
+
+
+class _GenericHandler:
+    def __init__(self, methods):
+        import grpc
+
+        self._grpc = grpc
+        self._methods = methods
+
+    def service(self, handler_call_details):
+        name = handler_call_details.method.rsplit("/", 1)[-1]
+        fn = self._methods.get(name)
+        if fn is None:
+            return None
+
+        def handler(request_bytes, context):
+            reply = fn(unpack_message(request_bytes))
+            return pack_message(reply if reply is not None else {})
+
+        return self._grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+
+def serve(methods, port, max_workers=64):
+    """Start a gRPC server exposing ``methods`` {name: fn(dict)->dict}.
+
+    Returns the started server (64 threads like the reference PS,
+    ps/parameter_server.py:33-56).
+    """
+    import grpc
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+            (
+                "grpc.max_receive_message_length",
+                GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+            ),
+        ],
+        handlers=(_GenericHandler(methods),),
+    )
+    chosen = server.add_insecure_port("[::]:%d" % port)
+    server.start()
+    server._edl_port = chosen
+    return server
+
+
+class Client:
+    """Bytes-frame RPC client: ``client.call("method", **fields)``."""
+
+    def __init__(self, addr):
+        import grpc
+
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[
+                (
+                    "grpc.max_send_message_length",
+                    GRPC.MAX_SEND_MESSAGE_LENGTH,
+                ),
+                (
+                    "grpc.max_receive_message_length",
+                    GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+                ),
+            ],
+        )
+        self._stubs = {}
+
+    def call(self, method, **fields):
+        stub = self._stubs.get(method)
+        if stub is None:
+            stub = self._channel.unary_unary(
+                "/%s/%s" % (_SERVICE, method),
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            self._stubs[method] = stub
+        return unpack_message(stub(pack_message(fields)))
+
+    def close(self):
+        self._channel.close()

@@ -124,6 +124,7 @@ class Worker:
         self._forward_fn = make_forward_fn(self._model)
         # elastic embedding layers (populated at variable creation)
         self._embedding_dims = {}  # {path_tuple: dim}
+        self._embedding_initializers = {}  # {path_tuple: initializer name}
         self._emb_grad_fn = None
         self._emb_forward_fn = None
 
@@ -148,7 +149,27 @@ class Worker:
         return self._stub.report_task_result(task_id, err_msg, exec_counters)
 
     def get_model(self, version, method=GetModelMethod.MINIMUM):
-        """Pull parameters >= ``version`` (MINIMUM) or exactly (FIXED)."""
+        """Pull parameters >= ``version`` (MINIMUM) or exactly (FIXED).
+
+        In sharded-PS mode the pull merges every shard's partition
+        (reference worker.py:189-227); eval pinning to checkpointed
+        versions is a master-mode feature, PS serves latest.
+        """
+        if self._ps_client is not None:
+            initialized, got_version, named = self._ps_client.pull_dense()
+            if not initialized and self._params is not None:
+                # a relaunched PS shard lost its state: re-push our model
+                # (init-once per shard; reference ps/servicer.py:70-79 +
+                # k8s_instance_manager.py:229-231 relaunch-same-id design)
+                self.report_variable()
+                initialized, got_version, named = (
+                    self._ps_client.pull_dense()
+                )
+            if not initialized:
+                return
+            self._params = named_arrays_to_pytree(named, self._params)
+            self._model_version = got_version
+            return
         got_version, named = self._stub.get_model(version, method)
         if not named:
             return
@@ -167,11 +188,27 @@ class Worker:
         self._model_version = got_version
 
     def report_variable(self):
-        self._stub.report_variable(pytree_to_named_arrays(self._params))
+        named = pytree_to_named_arrays(self._params)
+        if self._ps_client is not None:
+            infos = [
+                EmbeddingTableInfo(
+                    path_name(path),
+                    dim,
+                    self._embedding_initializers.get(path, "uniform"),
+                )
+                for path, dim in self._embedding_dims.items()
+            ]
+            self._ps_client.push_model(named, infos)
+        else:
+            self._stub.report_variable(named)
 
     def report_gradient(self, grads, sparse_tensors=None):
         """Ship dense grads as named tensors (+ sparse embedding grads)."""
         named = pytree_to_named_arrays(grads)
+        if self._ps_client is not None:
+            return self._ps_client.push_gradient(
+                named, sparse_tensors, self._model_version
+            )
         tensors = [Tensor(name, values) for name, values in named.items()]
         tensors.extend(sparse_tensors or ())
         return self._stub.report_gradient(tensors, self._model_version)
@@ -221,15 +258,34 @@ class Worker:
                         rows_template, "rows"
                     ).items()
                 }
+                # one capture pass to learn each layer's declared
+                # initializer (forwarded in EmbeddingTableInfo)
+                layer_info = {}
+                capture_embedding_ids(
+                    self._model,
+                    {"params": self._params, **self._state},
+                    features,
+                    expected_count=len(self._embedding_dims),
+                    layer_info=layer_info,
+                )
+                self._embedding_initializers = {
+                    path: info[1] for path, info in layer_info.items()
+                }
                 self._emb_grad_fn = make_embedding_grad_fn(
                     self._model, self._loss
                 )
                 self._emb_forward_fn = make_embedding_forward_fn(self._model)
         if not self._var_created:
-            if self._embedding_dims:
+            if self._embedding_dims and self._ps_client is None:
                 self._stub.push_embedding_info(
                     [
-                        EmbeddingTableInfo(path_name(path), dim)
+                        EmbeddingTableInfo(
+                            path_name(path),
+                            dim,
+                            self._embedding_initializers.get(
+                                path, "uniform"
+                            ),
+                        )
                         for path, dim in self._embedding_dims.items()
                     ]
                 )
@@ -272,9 +328,14 @@ class Worker:
         rows_by_path, idx_by_path, plan = {}, {}, {}
         for path, ids in captured.items():
             unique, idx, bucket = plan_lookup(ids)
-            rows = self._stub.pull_embedding_vectors(
-                path_name(path), unique
-            )
+            if self._ps_client is not None:
+                rows = self._ps_client.pull_embedding_vectors(
+                    path_name(path), unique
+                )
+            else:
+                rows = self._stub.pull_embedding_vectors(
+                    path_name(path), unique
+                )
             rows = np.asarray(rows, dtype=np.float32)
             if rows.shape[0] < bucket:
                 rows = np.concatenate(
