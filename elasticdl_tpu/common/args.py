@@ -260,7 +260,9 @@ def add_common_args_between_master_and_worker(parser):
 
 def parse_master_args(master_args=None):
     parser = argparse.ArgumentParser(description="ElasticDL TPU Master")
-    parser.add_argument("--port", type=pos_int, default=50001)
+    # port 0 = pick a free port (the chosen one is exposed as Master.port);
+    # None = "not set": cluster mode uses 50001, local mode uses 0
+    parser.add_argument("--port", type=non_neg_int, default=None)
     parser.add_argument("--worker_image", default="")
     parser.add_argument("--prediction_data", default="")
     add_common_params(parser)

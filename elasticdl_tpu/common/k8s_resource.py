@@ -1,0 +1,54 @@
+"""Resource-string parser.
+
+Parity: reference common/k8s_resource.py:38-80 — parse
+``"cpu=1,memory=4096Mi,tpu=8"`` into a dict with validation. The TPU
+resource name maps to the google.com/tpu extended resource at pod-spec
+render time (k8s_client.py).
+"""
+
+_ALLOWED = {
+    "cpu",
+    "memory",
+    "disk",
+    "gpu",
+    "tpu",
+    "ephemeral-storage",
+    "ephemeral_storage",
+}
+
+
+def parse_resource(resource_str):
+    """Resource string -> dict; validates names and formats."""
+    kvs = {}
+    if not resource_str:
+        return kvs
+    for pair in resource_str.split(","):
+        key, _, value = pair.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if not key or not value:
+            raise ValueError(
+                "invalid resource spec %r in %r" % (pair, resource_str)
+            )
+        base = key.split("/")[-1]
+        if base not in _ALLOWED and "/" not in key:
+            raise ValueError(
+                "resource name %r must be one of %s or a fully-qualified "
+                "extended resource" % (key, sorted(_ALLOWED))
+            )
+        if base == "cpu":
+            # cpu may be fractional or milli-cpu
+            v = value[:-1] if value.endswith("m") else value
+            float(v)  # raises if malformed
+        elif base == "memory" or base.startswith("ephemeral"):
+            if not any(
+                value.endswith(suffix)
+                for suffix in ("Ki", "Mi", "Gi", "Ti", "K", "M", "G", "T")
+            ) and not value.isdigit():
+                raise ValueError("invalid quantity %r for %s" % (value, key))
+        elif base in ("gpu", "tpu"):
+            int(value)
+        if key in kvs:
+            raise ValueError("duplicate resource name %r" % key)
+        kvs[key] = value
+    return kvs

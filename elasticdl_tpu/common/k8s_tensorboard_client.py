@@ -1,0 +1,84 @@
+"""TensorBoard service exposure on Kubernetes.
+
+Parity: reference common/k8s_tensorboard_client.py:20-53 — creates a
+LoadBalancer Service targeting the master pod's TensorBoard port and polls
+for its external ingress IP.
+"""
+
+import time
+
+from elasticdl_tpu.common.k8s_client import (
+    ELASTICDL_JOB_KEY,
+    ELASTICDL_REPLICA_INDEX_KEY,
+    ELASTICDL_REPLICA_TYPE_KEY,
+    Client,
+    _require_k8s,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class TensorBoardClient:
+    def __init__(self, **kwargs):
+        self._k8s_client = Client(**kwargs)
+
+    def _get_tensorboard_service_name(self):
+        return "tensorboard-" + self._k8s_client.job_name
+
+    def create_tensorboard_service(
+        self, port=80, target_port=6006, service_type="LoadBalancer"
+    ):
+        k8s_client, _, _ = _require_k8s()
+        service = k8s_client.V1Service(
+            metadata=k8s_client.V1ObjectMeta(
+                name=self._get_tensorboard_service_name(),
+                labels={
+                    "app": "elasticdl",
+                    ELASTICDL_JOB_KEY: self._k8s_client.job_name,
+                },
+                owner_references=Client.create_owner_reference(
+                    self._k8s_client.get_master_pod()
+                ),
+                namespace=self._k8s_client.namespace,
+            ),
+            spec=k8s_client.V1ServiceSpec(
+                ports=[
+                    k8s_client.V1ServicePort(
+                        port=port, target_port=target_port
+                    )
+                ],
+                selector={
+                    ELASTICDL_JOB_KEY: self._k8s_client.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: "master",
+                    ELASTICDL_REPLICA_INDEX_KEY: "0",
+                },
+                type=service_type,
+            ),
+        )
+        return self._k8s_client.client.create_namespaced_service(
+            self._k8s_client.namespace, service
+        )
+
+    def _get_tensorboard_service(self):
+        k8s_client, _, _ = _require_k8s()
+        try:
+            return self._k8s_client.client.read_namespaced_service(
+                name=self._get_tensorboard_service_name(),
+                namespace=self._k8s_client.namespace,
+            )
+        except k8s_client.api_client.ApiException as e:
+            logger.warning(
+                "Exception when reading TensorBoard service: %s" % e
+            )
+            return None
+
+    def get_tensorboard_external_ip(self, check_interval=5, wait_secs=120):
+        for _ in range(wait_secs // check_interval):
+            service = self._get_tensorboard_service()
+            if (
+                service
+                and service.status.load_balancer.ingress
+                and service.status.load_balancer.ingress[0].ip
+            ):
+                return service.status.load_balancer.ingress[0].ip
+            time.sleep(check_interval)
+        return None

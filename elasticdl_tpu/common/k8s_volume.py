@@ -1,0 +1,38 @@
+"""Volume-string parser.
+
+Parity: reference common/k8s_volume.py:6-45 — parse
+``"claim_name=c1,mount_path=/p"`` (or ``host_path=...``) into volume +
+mount specs. Returns plain dicts; the k8s client renders them into
+V1Volume/V1VolumeMount when the kubernetes package is present.
+"""
+
+
+def parse_volume(volume_str):
+    """Volume string -> (volume_dict, mount_dict) or None if empty."""
+    if not volume_str:
+        return None
+    kvs = {}
+    for pair in volume_str.split(","):
+        key, _, value = pair.partition("=")
+        kvs[key.strip()] = value.strip()
+    if "mount_path" not in kvs:
+        raise ValueError("volume spec %r needs mount_path" % volume_str)
+    mount = {"name": "edl-volume", "mount_path": kvs["mount_path"]}
+    if "claim_name" in kvs:
+        volume = {
+            "name": "edl-volume",
+            "persistent_volume_claim": {"claim_name": kvs["claim_name"]},
+        }
+    elif "host_path" in kvs:
+        volume = {
+            "name": "edl-volume",
+            "host_path": {
+                "path": kvs["host_path"],
+                "type": kvs.get("type", "Directory"),
+            },
+        }
+    else:
+        raise ValueError(
+            "volume spec %r needs claim_name or host_path" % volume_str
+        )
+    return volume, mount

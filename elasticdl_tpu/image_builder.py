@@ -1,0 +1,122 @@
+"""Job-image builder.
+
+Parity: reference elasticdl/image_builder.py — generate a Dockerfile that
+embeds the framework + the user's model zoo (+ optional cluster spec),
+build it with the docker SDK and push to the job repository. The docker
+SDK is imported lazily; local-mode jobs (api.py) never need it.
+"""
+
+import os
+import shutil
+import tempfile
+import uuid
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+_DOCKERFILE_TEMPLATE = """\
+FROM {base_image}
+WORKDIR /
+COPY framework /elasticdl_tpu_pkg
+RUN pip install --no-cache-dir /elasticdl_tpu_pkg {extra_index}
+COPY model_zoo /model_zoo
+{cluster_spec_copy}
+ENV PYTHONUNBUFFERED=1
+"""
+
+
+def _generate_dockerfile(base_image, extra_pypi_index="", cluster_spec=""):
+    return _DOCKERFILE_TEMPLATE.format(
+        base_image=base_image or "python:3.11",
+        extra_index=(
+            "--extra-index-url " + extra_pypi_index
+            if extra_pypi_index
+            else ""
+        ),
+        cluster_spec_copy=(
+            "COPY cluster_spec /cluster_spec" if cluster_spec else ""
+        ),
+    )
+
+
+def build_and_push_docker_image(
+    model_zoo,
+    docker_image_repository,
+    base_image="",
+    extra_pypi="",
+    cluster_spec="",
+    docker_base_url="unix://var/run/docker.sock",
+    docker_tlscert="",
+    docker_tlskey="",
+):
+    """Build + push the job image; returns the pushed image name."""
+    import docker
+
+    with tempfile.TemporaryDirectory() as ctx:
+        # framework sources
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        shutil.copytree(
+            os.path.join(pkg_root, "elasticdl_tpu"),
+            os.path.join(ctx, "framework", "elasticdl_tpu"),
+        )
+        shutil.copy(
+            os.path.join(pkg_root, "pyproject.toml"),
+            os.path.join(ctx, "framework", "pyproject.toml"),
+        )
+        shutil.copytree(model_zoo, os.path.join(ctx, "model_zoo"))
+        if cluster_spec:
+            os.makedirs(os.path.join(ctx, "cluster_spec"))
+            shutil.copy(cluster_spec, os.path.join(ctx, "cluster_spec"))
+        with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+            f.write(
+                _generate_dockerfile(base_image, extra_pypi, cluster_spec)
+            )
+
+        image_name = "%s:%s" % (
+            docker_image_repository.rstrip("/") + "/elasticdl",
+            uuid.uuid4().hex[:12],
+        )
+        if docker_tlscert and docker_tlskey:
+            tls_config = docker.tls.TLSConfig(
+                client_cert=(docker_tlscert, docker_tlskey)
+            )
+            client = docker.APIClient(
+                base_url=docker_base_url, tls=tls_config
+            )
+        else:
+            client = docker.APIClient(base_url=docker_base_url)
+        logger.info("Building image %s", image_name)
+        for line in client.build(
+            path=ctx, tag=image_name, decode=True, rm=True
+        ):
+            if "stream" in line:
+                logger.info(line["stream"].rstrip())
+            if "error" in line:
+                raise RuntimeError("docker build failed: %s" % line["error"])
+        logger.info("Pushing image %s", image_name)
+        for line in client.push(image_name, stream=True, decode=True):
+            if "error" in line:
+                raise RuntimeError("docker push failed: %s" % line["error"])
+        return image_name
+
+
+def remove_images(docker_image_repository="", all_images=False, **docker_kw):
+    """Remove job images (reference image_builder.remove_images)."""
+    import docker
+
+    client = docker.APIClient(
+        base_url=docker_kw.get(
+            "docker_base_url", "unix://var/run/docker.sock"
+        )
+    )
+    prefix = (
+        docker_image_repository.rstrip("/") + "/elasticdl"
+        if docker_image_repository
+        else "elasticdl"
+    )
+    removed = []
+    for image in client.images():
+        for tag in image.get("RepoTags") or ():
+            if all_images or tag.startswith(prefix):
+                client.remove_image(tag, force=True)
+                removed.append(tag)
+    return removed

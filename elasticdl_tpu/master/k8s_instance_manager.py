@@ -1,0 +1,223 @@
+"""Kubernetes instance manager — pod-level elasticity.
+
+Parity: reference master/k8s_instance_manager.py — starts N worker and M
+PS pods, tracks ``{id: (pod_name, phase)}`` maps, and reacts to the pod
+watch stream: a DELETED worker pod re-queues its in-flight tasks
+(``task_d.recover_tasks``) and is relaunched with a fresh monotonically
+increasing id unless it Succeeded; a DELETED PS pod is relaunched with the
+*same* id so its stable Service DNS keeps resolving; the master pod's
+``status`` label mirrors the job status for external pollers.
+
+The process-level analog with the same callback contract (usable without
+k8s, and what the elastic tests exercise) is
+master/local_instance_manager.py.
+"""
+
+import itertools
+import threading
+from collections import Counter
+
+from elasticdl_tpu.common import k8s_client as k8s
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class InstanceManager:
+    def __init__(
+        self,
+        task_d,
+        num_workers=1,
+        worker_command=None,
+        worker_args=None,
+        worker_resource_request="cpu=1,memory=4096Mi",
+        worker_resource_limit="",
+        worker_pod_priority="",
+        num_ps=0,
+        ps_command=None,
+        ps_args=None,
+        ps_resource_request="cpu=1,memory=4096Mi",
+        ps_resource_limit="",
+        ps_pod_priority="",
+        volume="",
+        image_pull_policy="Always",
+        restart_policy="Never",
+        envs=None,
+        **kwargs,
+    ):
+        self._num_workers = num_workers
+        self._worker_command = worker_command
+        self._worker_args = worker_args or []
+        self._worker_resource_request = worker_resource_request
+        self._worker_resource_limit = worker_resource_limit
+        self._worker_pod_priority = worker_pod_priority
+
+        self._num_ps = num_ps
+        self._ps_command = ps_command
+        self._ps_args = ps_args or []
+        self._ps_resource_request = ps_resource_request
+        self._ps_resource_limit = ps_resource_limit
+        self._ps_pod_priority = ps_pod_priority
+
+        self._restart_policy = restart_policy
+        self._volume = volume
+        self._image_pull_policy = image_pull_policy
+        self._envs = envs
+        self._task_d = task_d
+        self._next_worker_id = itertools.count().__next__
+
+        self._lock = threading.Lock()
+        self._worker_pods_phase = {}
+        self._worker_pod_name_to_id = {}
+        self._relaunch_deleted_live_worker = True
+        self._ps_pods_phase = {}
+        self._ps_pod_name_to_id = {}
+        self._relaunch_deleted_live_ps = True
+
+        self._k8s_client = k8s.Client(
+            event_callback=self._event_cb, **kwargs
+        )
+        self._ps_addrs = self._get_ps_addrs()
+
+    # -- launches -----------------------------------------------------------
+
+    def _start_worker(self, worker_id):
+        logger.info("Starting worker: %d" % worker_id)
+        with self._lock:
+            pod = self._k8s_client.create_worker(
+                worker_id=worker_id,
+                resource_requests=self._worker_resource_request,
+                resource_limits=self._worker_resource_limit,
+                pod_priority=self._worker_pod_priority,
+                volume=self._volume,
+                image_pull_policy=self._image_pull_policy,
+                command=self._worker_command,
+                args=self._worker_args
+                + ["--worker_id", str(worker_id)]
+                + ["--ps_addrs", self._ps_addrs],
+                restart_policy=self._restart_policy,
+                envs=self._envs,
+            )
+            name = pod.metadata.name
+            self._worker_pod_name_to_id[name] = worker_id
+            self._worker_pods_phase[worker_id] = (name, None)
+
+    def _start_ps(self, ps_id):
+        logger.info("Starting PS: %d" % ps_id)
+        with self._lock:
+            pod = self._k8s_client.create_ps(
+                ps_id=ps_id,
+                resource_requests=self._ps_resource_request,
+                resource_limits=self._ps_resource_limit,
+                pod_priority=self._ps_pod_priority,
+                volume=self._volume,
+                image_pull_policy=self._image_pull_policy,
+                command=self._ps_command,
+                args=self._ps_args + ["--ps_id", str(ps_id)],
+                restart_policy=self._restart_policy,
+                envs=self._envs,
+            )
+            name = pod.metadata.name
+            self._ps_pod_name_to_id[name] = ps_id
+            self._ps_pods_phase[ps_id] = (name, None)
+            self._k8s_client.create_ps_service(ps_id)
+
+    def _get_ps_addrs(self):
+        return ",".join(
+            self._k8s_client.get_ps_service_address(ps_id)
+            for ps_id in range(self._num_ps)
+        )
+
+    def update_status(self, status):
+        """Job status exported as a master pod label (reference :124-128)."""
+        self._k8s_client.patch_labels_to_pod(
+            self._k8s_client.get_master_pod_name(),
+            labels_dict={"status": status},
+        )
+
+    def start_workers(self):
+        for _ in range(self._num_workers):
+            self._start_worker(self._next_worker_id())
+
+    def start_all_ps(self):
+        for i in range(self._num_ps):
+            self._start_ps(i)
+
+    # -- teardown -----------------------------------------------------------
+
+    def stop_relaunch_and_remove_workers(self):
+        with self._lock:
+            self._relaunch_deleted_live_worker = False
+            for worker_id in self._worker_pods_phase:
+                self._k8s_client.delete_worker(worker_id)
+
+    def stop_relaunch_and_remove_all_ps(self):
+        with self._lock:
+            self._relaunch_deleted_live_ps = False
+            for ps_id in self._ps_pods_phase:
+                self._k8s_client.delete_ps(ps_id)
+
+    def stop_relaunch_and_remove_all_pods(self):
+        self.stop_relaunch_and_remove_workers()
+        self.stop_relaunch_and_remove_all_ps()
+
+    def get_worker_counter(self):
+        with self._lock:
+            return Counter(
+                [v for _, v in self._worker_pods_phase.values()]
+            )
+
+    def get_ps_counter(self):
+        with self._lock:
+            return Counter([v for _, v in self._ps_pods_phase.values()])
+
+    # -- the elasticity loop ------------------------------------------------
+
+    def _event_cb(self, event):
+        evt_obj = event.get("object")
+        evt_type = event.get("type")
+        if not evt_obj or not evt_type:
+            logger.error("Event doesn't have object or type: %s" % event)
+            return
+        if evt_obj.kind != "Pod":
+            return
+        pod_name = evt_obj.metadata.name
+        phase = evt_obj.status.phase
+        logger.info(
+            "Got event %s, phase %s for pod: %s"
+            % (evt_type, phase, pod_name)
+        )
+        if pod_name == self._k8s_client.get_master_pod_name():
+            return
+
+        relaunch_worker = False
+        relaunch_ps = False
+        ps_id = -1
+        with self._lock:
+            if pod_name in self._worker_pod_name_to_id:
+                worker_id = self._worker_pod_name_to_id.get(pod_name)
+                self._worker_pods_phase[worker_id] = (pod_name, phase)
+                if evt_type == "DELETED":
+                    del self._worker_pods_phase[worker_id]
+                    del self._worker_pod_name_to_id[pod_name]
+                    # dead worker's in-flight tasks -> back on todo
+                    self._task_d.recover_tasks(worker_id)
+                    relaunch_worker = (
+                        self._relaunch_deleted_live_worker
+                        and phase != "Succeeded"
+                    )
+            elif pod_name in self._ps_pod_name_to_id:
+                ps_id = self._ps_pod_name_to_id.get(pod_name)
+                self._ps_pods_phase[ps_id] = (pod_name, phase)
+                if evt_type == "DELETED":
+                    del self._ps_pods_phase[ps_id]
+                    del self._ps_pod_name_to_id[pod_name]
+                    relaunch_ps = self._relaunch_deleted_live_ps
+            else:
+                logger.error("Unknown worker pod name: %s" % pod_name)
+                return
+
+        if relaunch_worker:
+            logger.info("Relaunching worker.")
+            self._start_worker(self._next_worker_id())
+        elif relaunch_ps:
+            logger.info("Relaunching ps.")
+            self._start_ps(ps_id)

@@ -1,0 +1,154 @@
+"""Process-level instance manager: elastic workers without Kubernetes.
+
+The reference's elasticity loop is: watch instances, and when one dies
+re-queue its in-flight tasks and relaunch it
+(k8s_instance_manager.py:177-231). This manager implements the same loop
+over local subprocesses — the single-host analog used for elastic tests
+(reference rung 2, SURVEY.md §4.3) and for multi-process jobs on one TPU
+host. The k8s-backed manager (k8s_instance_manager.py here) shares the
+same callback contract.
+"""
+
+import subprocess
+import sys
+import threading
+
+from elasticdl_tpu.common.constants import InstanceManagerStatus
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class LocalInstanceManager:
+    def __init__(
+        self,
+        task_d,
+        num_workers,
+        worker_command,
+        num_ps=0,
+        ps_command=None,
+        restart_policy="Always",
+        max_relaunches=3,
+        env=None,
+    ):
+        """``worker_command(worker_id) -> argv``; ``ps_command(ps_id) ->
+        argv``. Worker ids grow monotonically across relaunches like the
+        reference's next_worker_id counter; PS relaunches keep their id
+        (reference k8s_instance_manager.py:229-231)."""
+        self._task_d = task_d
+        self._num_workers = num_workers
+        self._worker_command = worker_command
+        self._num_ps = num_ps
+        self._ps_command = ps_command
+        self._restart_policy = restart_policy
+        self._max_relaunches = max_relaunches
+        self._env = env
+
+        self._lock = threading.Lock()
+        self._procs = {}  # instance key -> Popen
+        self._next_worker_id = 0
+        self._relaunches = 0
+        self._stopping = False
+        self._watchers = []
+        self.status = InstanceManagerStatus.PENDING
+
+    def _spawn(self, key, argv):
+        proc = subprocess.Popen(argv, env=self._env)
+        with self._lock:
+            self._procs[key] = proc
+        watcher = threading.Thread(
+            target=self._watch, args=(key, proc), daemon=True
+        )
+        watcher.start()
+        self._watchers.append(watcher)
+        return proc
+
+    def start_all_ps(self):
+        for ps_id in range(self._num_ps):
+            self._spawn(("ps", ps_id), self._ps_command(ps_id))
+
+    def start_workers(self):
+        for _ in range(self._num_workers):
+            self._start_worker()
+        self.status = InstanceManagerStatus.RUNNING
+
+    def _start_worker(self):
+        with self._lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+        self._spawn(("worker", worker_id), self._worker_command(worker_id))
+        return worker_id
+
+    # -- the elasticity loop ------------------------------------------------
+
+    def _watch(self, key, proc):
+        returncode = proc.wait()
+        with self._lock:
+            if self._procs.get(key) is not proc or self._stopping:
+                return
+            del self._procs[key]
+        kind, instance_id = key
+        if kind == "worker":
+            # reference k8s_instance_manager.py:207 — a dead worker's
+            # in-flight tasks go back on the todo queue
+            self._task_d.recover_tasks(instance_id)
+            if returncode == 0:
+                logger.info("Worker %d completed", instance_id)
+                return
+            logger.warning(
+                "Worker %d exited with %d; recovering tasks",
+                instance_id,
+                returncode,
+            )
+            if (
+                self._restart_policy != "Never"
+                and self._relaunches < self._max_relaunches
+            ):
+                self._relaunches += 1
+                new_id = self._start_worker()
+                logger.info("Relaunched worker as id %d", new_id)
+        else:
+            logger.warning(
+                "PS %d exited with %d; relaunching same id",
+                instance_id,
+                returncode,
+            )
+            if not self._stopping and self._relaunches < self._max_relaunches:
+                self._relaunches += 1
+                self._spawn(key, self._ps_command(instance_id))
+
+    # -- control ------------------------------------------------------------
+
+    def kill_worker(self, worker_id):
+        """Fault injection: kill one live worker process."""
+        with self._lock:
+            proc = self._procs.get(("worker", worker_id))
+        if proc:
+            proc.kill()
+
+    def live_workers(self):
+        with self._lock:
+            return [
+                k[1]
+                for k, p in self._procs.items()
+                if k[0] == "worker" and p.poll() is None
+            ]
+
+    def wait(self, timeout=None):
+        """Block until every instance process has exited."""
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return False
+        return True
+
+    def stop_relaunch_and_remove_all_pods(self):
+        self._stopping = True
+        self.status = InstanceManagerStatus.FINISHED
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()

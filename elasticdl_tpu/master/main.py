@@ -1,0 +1,8 @@
+"""Master process entry (reference master/main.py:5-9)."""
+
+import sys
+
+from elasticdl_tpu.master.master import main
+
+if __name__ == "__main__":
+    sys.exit(main())

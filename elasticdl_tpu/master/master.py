@@ -1,0 +1,334 @@
+"""Master orchestrator: wires dispatcher, model, services, RPC, instances.
+
+Parity: reference master/master.py — builds the task dispatcher from the
+data reader's shards (:38-65), infers the job type from the data args
+(:227-256), instantiates checkpoint/evaluation/tensorboard services and
+the MasterServicer (:68-147), starts the RPC server and the instance
+manager (:149-176), and polls ``task_d.finished()`` every 30 s (:178-195).
+
+TPU-native deltas: the servicer optimizer exists only for
+ParameterServerStrategy with master-central storage; AllreduceStrategy jobs
+keep parameters in worker HBM and the master is pure control plane.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common.constants import (
+    DistributionStrategy,
+    JobType,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import (
+    get_model_spec,
+    get_module_file_path,
+    load_module,
+)
+from elasticdl_tpu.data.data_reader import create_data_reader
+from elasticdl_tpu.master.checkpoint_service import CheckpointService
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.rpc_service import MasterRpcService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master.tensorboard_service import TensorboardService
+
+
+def _make_task_dispatcher(
+    training_data,
+    validation_data,
+    prediction_data,
+    records_per_task,
+    num_epochs,
+    data_reader_params=None,
+):
+    """Reference master.py:38-65."""
+
+    def _shards(origin):
+        if not origin:
+            return {}
+        reader = create_data_reader(
+            data_origin=origin,
+            records_per_task=records_per_task,
+            **(data_reader_params or {}),
+        )
+        return reader.create_shards()
+
+    prediction_f_records = _shards(prediction_data)
+    return TaskDispatcher(
+        _shards(training_data),
+        _shards(validation_data),
+        prediction_f_records,
+        records_per_task,
+        num_epochs,
+    )
+
+
+class Master:
+    def __init__(self, args):
+        self.logger = logger
+        self.args = args
+        self.job_type = Master._get_job_type(args)
+
+        records_per_task = (
+            args.minibatch_size * args.num_minibatches_per_task
+        )
+        from elasticdl_tpu.common.model_utils import (
+            get_dict_from_params_str,
+        )
+
+        self.task_d = _make_task_dispatcher(
+            getattr(args, "training_data", ""),
+            getattr(args, "validation_data", ""),
+            getattr(args, "prediction_data", ""),
+            records_per_task,
+            args.num_epochs,
+            get_dict_from_params_str(
+                getattr(args, "data_reader_params", "")
+            ),
+        )
+
+        model_module = load_module(
+            get_module_file_path(args.model_zoo, args.model_def)
+        ).__dict__
+        self.model_module = model_module
+        self.optimizer = model_module[args.optimizer]()
+
+        # services
+        self.checkpoint_service = self._create_checkpoint_service(args)
+        self.tb_service = self._create_tensorboard_service(args)
+        self.evaluation_service = self._create_evaluation_service(args)
+        if self.evaluation_service:
+            self.task_d.set_evaluation_service(self.evaluation_service)
+
+        # deferred SavedModel-equivalent export task
+        if getattr(args, "output", "") and self._job_has_training():
+            self.task_d.add_deferred_callback_create_save_model_task(
+                args.output
+            )
+
+        strategy = getattr(
+            args,
+            "distribution_strategy",
+            DistributionStrategy.PARAMETER_SERVER,
+        )
+        master_holds_model = (
+            strategy == DistributionStrategy.PARAMETER_SERVER
+            and getattr(args, "num_ps_pods", 0) <= 0
+        ) or strategy == DistributionStrategy.LOCAL
+        self.master_servicer = MasterServicer(
+            args.grads_to_wait,
+            args.minibatch_size,
+            self.optimizer if master_holds_model else None,
+            self.task_d,
+            checkpoint_filename_for_init=getattr(
+                args, "checkpoint_filename_for_init", ""
+            )
+            or None,
+            checkpoint_service=self.checkpoint_service,
+            evaluation_service=self.evaluation_service,
+            lr_staleness_modulation=getattr(
+                args, "lr_staleness_modulation", False
+            ),
+            use_async=getattr(args, "use_async", False),
+        )
+        self._server = None
+        self.instance_manager = self._create_instance_manager(args)
+        self._stop_requested = threading.Event()
+
+    @staticmethod
+    def _get_job_type(args):
+        """Reference master.py:227-256."""
+        has_training = bool(getattr(args, "training_data", ""))
+        has_validation = bool(getattr(args, "validation_data", ""))
+        has_prediction = bool(getattr(args, "prediction_data", ""))
+        has_eval_trigger = bool(
+            getattr(args, "evaluation_steps", 0)
+            or getattr(args, "evaluation_throttle_secs", 0)
+        )
+        if has_prediction and not has_training:
+            return JobType.PREDICTION_ONLY
+        if has_validation and not has_training:
+            return JobType.EVALUATION_ONLY
+        if has_training and (has_validation or has_eval_trigger):
+            return JobType.TRAINING_WITH_EVALUATION
+        return JobType.TRAINING_ONLY
+
+    def _job_has_training(self):
+        return self.job_type in (
+            JobType.TRAINING_ONLY,
+            JobType.TRAINING_WITH_EVALUATION,
+        )
+
+    def _create_checkpoint_service(self, args):
+        include_eval = self.job_type == JobType.TRAINING_WITH_EVALUATION
+        return CheckpointService(
+            getattr(args, "checkpoint_dir", ""),
+            getattr(args, "checkpoint_steps", 0),
+            getattr(args, "keep_checkpoint_max", 0),
+            include_eval,
+        )
+
+    def _create_tensorboard_service(self, args):
+        logdir = getattr(args, "tensorboard_log_dir", "")
+        if not logdir:
+            return None
+        service = TensorboardService(logdir)
+        service.start()
+        import os as _os
+
+        if _os.getenv("KUBERNETES_SERVICE_HOST"):
+            # expose TB via a LoadBalancer service (reference
+            # k8s_tensorboard_client.py); best-effort
+            try:
+                from elasticdl_tpu.common.k8s_tensorboard_client import (
+                    TensorBoardClient,
+                )
+
+                TensorBoardClient(
+                    image_name=None,
+                    namespace=args.namespace,
+                    job_name=args.job_name,
+                ).create_tensorboard_service()
+            except Exception:
+                logger.warning(
+                    "failed to create TensorBoard k8s service",
+                    exc_info=True,
+                )
+        return service
+
+    def _create_evaluation_service(self, args):
+        if self.job_type == JobType.TRAINING_ONLY:
+            return None
+        eval_only = self.job_type == JobType.EVALUATION_ONLY
+        return EvaluationService(
+            self.checkpoint_service,
+            self.tb_service,
+            self.task_d,
+            getattr(args, "evaluation_start_delay_secs", 0),
+            getattr(args, "evaluation_throttle_secs", 0),
+            getattr(args, "evaluation_steps", 0),
+            eval_only,
+            self.model_module[args.eval_metrics_fn],
+        )
+
+    def _create_instance_manager(self, args):
+        """k8s-backed instance manager for in-cluster masters.
+
+        Parity: reference master.py:379-450 — the master builds worker/PS
+        command lines by relaying its own parsed args. Local runs get a
+        LocalInstanceManager wired by api.py instead (or none for the
+        inline single-process mode).
+        """
+        import os as _os
+
+        if not _os.getenv("KUBERNETES_SERVICE_HOST"):
+            return None
+        if getattr(args, "num_workers", 0) <= 0:
+            return None
+        from elasticdl_tpu.common.args import (
+            build_arguments_from_parsed_result,
+            parse_envs,
+        )
+        from elasticdl_tpu.master.k8s_instance_manager import InstanceManager
+
+        relay = build_arguments_from_parsed_result(
+            args, filter_args={"port", "num_workers", "num_ps_pods"}
+        )
+        port = args.port if args.port is not None else 50001
+        worker_args = [
+            "-m",
+            "elasticdl_tpu.worker.main",
+            "--master_addr",
+            "%s:%d" % (_os.getenv("MY_POD_IP", "localhost"), port),
+            "--job_type",
+            self.job_type,
+        ] + relay
+        ps_args = [
+            "-m",
+            "elasticdl_tpu.ps.main",
+        ] + relay
+        return InstanceManager(
+            self.task_d,
+            num_workers=args.num_workers,
+            worker_command=["python"],
+            worker_args=worker_args,
+            worker_resource_request=args.worker_resource_request,
+            worker_resource_limit=args.worker_resource_limit,
+            worker_pod_priority=args.worker_pod_priority,
+            num_ps=args.num_ps_pods,
+            ps_command=["python"],
+            ps_args=ps_args,
+            ps_resource_request=args.ps_resource_request,
+            ps_resource_limit=args.ps_resource_limit,
+            ps_pod_priority=args.ps_pod_priority,
+            volume=args.volume,
+            image_pull_policy=args.image_pull_policy,
+            restart_policy=args.restart_policy,
+            envs=parse_envs(args.envs),
+            image_name=getattr(args, "worker_image", "") or None,
+            namespace=args.namespace,
+            job_name=args.job_name,
+            cluster_spec=args.cluster_spec,
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def prepare(self):
+        if self.evaluation_service:
+            self.evaluation_service.start()
+        from elasticdl_tpu.rpc.core import serve
+
+        port = self.args.port if self.args.port is not None else 50001
+        self._server = serve(
+            MasterRpcService(self.master_servicer).rpc_methods(),
+            port,
+        )
+        self.port = self._server._edl_port
+        logger.info("Master RPC server started on port %d", self.port)
+        if self.instance_manager:
+            self.instance_manager.start_all_ps()
+            self.instance_manager.start_workers()
+
+    def run(self, poll_secs=30):
+        """Poll until all tasks are done (reference master.py:178-195)."""
+        try:
+            while not self._stop_requested.is_set():
+                if self.task_d.finished():
+                    if self.task_d.invoke_deferred_callback():
+                        continue  # a SAVE_MODEL task was just queued
+                    break
+                self._stop_requested.wait(poll_secs)
+        except KeyboardInterrupt:
+            logger.warning("Master stopping")
+        finally:
+            self.stop()
+        return 0
+
+    def request_stop(self):
+        self._stop_requested.set()
+
+    def stop(self):
+        if self.evaluation_service:
+            self.evaluation_service.stop()
+        if self.tb_service:
+            self.tb_service.close()
+        if self.instance_manager:
+            self.instance_manager.stop_relaunch_and_remove_all_pods()
+        if self._server:
+            self._server.stop(grace=None)
+            self._server = None
+
+
+def main():
+    from elasticdl_tpu.common.args import parse_master_args
+
+    args = parse_master_args()
+    master = Master(args)
+    master.prepare()
+    return master.run()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

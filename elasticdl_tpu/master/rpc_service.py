@@ -1,0 +1,191 @@
+"""Master servicer <-> wire adapters.
+
+Exposes MasterServicer's method surface over rpc.core (dict messages) and
+provides the worker-side client proxy that speaks the same interface as
+the in-process servicer — so Worker code is transport-agnostic (the
+reference achieves this with gRPC stubs + InProcessMaster duck-typing).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import GetModelMethod, TaskType
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.master.servicer import TaskResponse
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+
+
+class MasterRpcService:
+    """Server side: dict-message handlers around a MasterServicer."""
+
+    def __init__(self, servicer):
+        self._s = servicer
+
+    def get_task(self, req):
+        task_type = req.get("task_type")
+        res = self._s.get_task(
+            req.get("worker_id", -1),
+            TaskType(task_type) if task_type is not None else None,
+        )
+        return {
+            "task_id": res.task_id,
+            "shard_name": res.shard_name,
+            "start": res.start,
+            "end": res.end,
+            "type": int(res.type) if res.type is not None else None,
+            "model_version": res.model_version,
+            "minibatch_size": res.minibatch_size,
+            "extended_config": res.extended_config,
+        }
+
+    def get_model(self, req):
+        version, named = self._s.get_model(
+            req.get("version", 0),
+            GetModelMethod(req.get("method", 0)),
+        )
+        return {
+            "version": version,
+            "params": [Tensor(n, v) for n, v in sorted(named.items())],
+        }
+
+    def report_variable(self, req):
+        self._s.report_variable(
+            {t.name: t.values for t in req.get("params", [])}
+        )
+        return {}
+
+    def report_gradient(self, req):
+        accepted, version = self._s.report_gradient(
+            req.get("gradients", []), req.get("model_version", -1)
+        )
+        return {"accepted": accepted, "version": version}
+
+    def report_task_result(self, req):
+        self._s.report_task_result(
+            req.get("task_id", -1),
+            req.get("err_message", ""),
+            req.get("exec_counters") or None,
+        )
+        return {}
+
+    def report_evaluation_metrics(self, req):
+        outputs = {t.name: t.values for t in req.get("model_outputs", [])}
+        accepted, version = self._s.report_evaluation_metrics(
+            req.get("model_version", -1), outputs, req.get("labels")
+        )
+        return {"accepted": accepted, "version": version}
+
+    def push_embedding_info(self, req):
+        self._s.push_embedding_info(
+            [
+                EmbeddingTableInfo(
+                    i["name"], i["dim"], i.get("initializer", "uniform")
+                )
+                for i in req.get("embedding_infos", [])
+            ]
+        )
+        return {}
+
+    def pull_embedding_vectors(self, req):
+        rows = self._s.pull_embedding_vectors(
+            req["name"], np.asarray(req["ids"], dtype=np.int64)
+        )
+        return {"rows": rows}
+
+    def rpc_methods(self):
+        return {
+            "get_task": self.get_task,
+            "get_model": self.get_model,
+            "report_variable": self.report_variable,
+            "report_gradient": self.report_gradient,
+            "report_task_result": self.report_task_result,
+            "report_evaluation_metrics": self.report_evaluation_metrics,
+            "push_embedding_info": self.push_embedding_info,
+            "pull_embedding_vectors": self.pull_embedding_vectors,
+        }
+
+
+class MasterClient:
+    """Worker side: the servicer method surface over an rpc.core channel."""
+
+    def __init__(self, addr):
+        from elasticdl_tpu.rpc.core import Client
+
+        self._client = Client(addr)
+
+    def get_task(self, worker_id, task_type=None):
+        resp = self._client.call(
+            "get_task",
+            worker_id=worker_id,
+            task_type=int(task_type) if task_type is not None else None,
+        )
+        return TaskResponse(
+            task_id=resp["task_id"],
+            shard_name=resp["shard_name"],
+            start=resp["start"],
+            end=resp["end"],
+            type=TaskType(resp["type"]) if resp["type"] is not None else None,
+            model_version=resp["model_version"],
+            minibatch_size=resp["minibatch_size"],
+            extended_config=resp.get("extended_config") or {},
+        )
+
+    def get_model(self, version, method=GetModelMethod.MINIMUM):
+        resp = self._client.call(
+            "get_model", version=int(version), method=int(method)
+        )
+        return resp["version"], {
+            t.name: t.values for t in resp.get("params", [])
+        }
+
+    def report_variable(self, named_arrays):
+        self._client.call(
+            "report_variable",
+            params=[Tensor(n, v) for n, v in named_arrays.items()],
+        )
+
+    def report_gradient(self, gradients, model_version):
+        resp = self._client.call(
+            "report_gradient",
+            gradients=list(gradients),
+            model_version=int(model_version),
+        )
+        return resp["accepted"], resp["version"]
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        self._client.call(
+            "report_task_result",
+            task_id=int(task_id),
+            err_message=err_message,
+            exec_counters=exec_counters,
+        )
+
+    def report_evaluation_metrics(self, model_version, model_outputs, labels):
+        resp = self._client.call(
+            "report_evaluation_metrics",
+            model_version=int(model_version),
+            model_outputs=[
+                Tensor(n, np.asarray(v)) for n, v in model_outputs.items()
+            ],
+            labels=np.asarray(labels),
+        )
+        return resp["accepted"], resp["version"]
+
+    def push_embedding_info(self, embedding_infos):
+        self._client.call(
+            "push_embedding_info",
+            embedding_infos=[
+                {"name": i.name, "dim": i.dim, "initializer": i.initializer}
+                for i in embedding_infos
+            ],
+        )
+
+    def pull_embedding_vectors(self, layer_name, ids):
+        resp = self._client.call(
+            "pull_embedding_vectors",
+            name=layer_name,
+            ids=np.asarray(ids, dtype=np.int64),
+        )
+        return resp["rows"]
+
+    def close(self):
+        self._client.close()

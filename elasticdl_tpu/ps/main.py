@@ -1,0 +1,8 @@
+"""PS process entry (reference ps/main.py:5-9)."""
+
+import sys
+
+from elasticdl_tpu.ps.parameter_server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

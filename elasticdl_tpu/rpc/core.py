@@ -139,6 +139,8 @@ def serve(methods, port, max_workers=64):
         handlers=(_GenericHandler(methods),),
     )
     chosen = server.add_insecure_port("[::]:%d" % port)
+    if chosen == 0:
+        raise RuntimeError("failed to bind RPC server port %d" % port)
     server.start()
     server._edl_port = chosen
     return server
@@ -165,15 +167,15 @@ class Client:
         )
         self._stubs = {}
 
-    def call(self, method, **fields):
-        stub = self._stubs.get(method)
+    def call(self, rpc_name, **fields):
+        stub = self._stubs.get(rpc_name)
         if stub is None:
             stub = self._channel.unary_unary(
-                "/%s/%s" % (_SERVICE, method),
+                "/%s/%s" % (_SERVICE, rpc_name),
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b,
             )
-            self._stubs[method] = stub
+            self._stubs[rpc_name] = stub
         return unpack_message(stub(pack_message(fields)))
 
     def close(self):

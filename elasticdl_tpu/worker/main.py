@@ -1,0 +1,50 @@
+"""Worker process entry.
+
+Parity: reference worker/main.py — builds the master channel (256 MB caps
+live in rpc.core), optional PS channels from ``--ps_addrs``, then runs the
+task loop to completion.
+"""
+
+import sys
+
+from elasticdl_tpu.common.args import parse_worker_args
+from elasticdl_tpu.master.rpc_service import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+
+def main():
+    args = parse_worker_args()
+    stub = MasterClient(args.master_addr) if args.master_addr else None
+    ps_client = None
+    if args.ps_addrs:
+        from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+
+        addrs = [a for a in args.ps_addrs.split(",") if a]
+        ps_client = PSClient([BoundPS(a) for a in addrs])
+    from elasticdl_tpu.common.model_utils import get_dict_from_params_str
+
+    worker = Worker(
+        worker_id=args.worker_id,
+        job_type=args.job_type,
+        minibatch_size=args.minibatch_size,
+        model_zoo=args.model_zoo,
+        model_def=args.model_def,
+        model_params=args.model_params,
+        dataset_fn=args.dataset_fn,
+        loss=args.loss,
+        optimizer=args.optimizer,
+        eval_metrics_fn=args.eval_metrics_fn,
+        prediction_outputs_processor=args.prediction_outputs_processor,
+        stub=stub,
+        ps_client=ps_client,
+        get_model_steps=args.get_model_steps,
+        data_reader_params=get_dict_from_params_str(
+            args.data_reader_params
+        ),
+    )
+    worker.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Client API / CLI local-mode test.
+
+Parity: reference scripts/client_test.sh rung-3 semantics (submit a job,
+wait for success) executed in local mode: master in-process + inline
+worker, deferred SAVE_MODEL export, checkpointing.
+"""
+
+import glob
+import os
+
+from elasticdl_tpu.api import cli_main
+from tests.test_utils import MODEL_ZOO_PATH, DatasetName, create_recordio_file
+
+
+def test_cli_train_local_single_process(tmp_path):
+    create_recordio_file(
+        128, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+    )
+    export_dir = str(tmp_path / "export")
+    ckpt_dir = str(tmp_path / "ckpt")
+    rc = cli_main(
+        [
+            "train",
+            "--job_name", "cli-test",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", "mnist_subclass.mnist_subclass.CustomModel",
+            "--minibatch_size", "16",
+            "--num_epochs", "1",
+            "--training_data", str(tmp_path),
+            "--num_ps_pods", "0",
+            "--use_async", "true",
+            "--checkpoint_steps", "4",
+            "--checkpoint_dir", ckpt_dir,
+            "--output", export_dir,
+        ]
+    )
+    assert rc == 0
+    exported = glob.glob(os.path.join(export_dir, "*", "model.chkpt"))
+    assert exported, "SAVE_MODEL export missing"
+    assert glob.glob(os.path.join(ckpt_dir, "model_v*.chkpt"))

@@ -1,0 +1,96 @@
+"""Resource/volume/args parser tests.
+
+Parity: reference tests/args_test.py and the parser halves of
+k8s_client_test.py that need no cluster.
+"""
+
+import pytest
+
+from elasticdl_tpu.common.args import (
+    build_arguments_from_parsed_result,
+    parse_envs,
+    parse_master_args,
+    parse_ps_args,
+    parse_worker_args,
+)
+from elasticdl_tpu.common.k8s_resource import parse_resource
+from elasticdl_tpu.common.k8s_volume import parse_volume
+
+
+def test_parse_resource():
+    parsed = parse_resource("cpu=1,memory=4096Mi,tpu=8")
+    assert parsed == {"cpu": "1", "memory": "4096Mi", "tpu": "8"}
+    with pytest.raises(ValueError):
+        parse_resource("cpu=1,cpu=2")
+    with pytest.raises(ValueError):
+        parse_resource("flux_capacitors=2")
+    with pytest.raises(ValueError):
+        parse_resource("memory=lots")
+    assert parse_resource("google.com/tpu=4") == {"google.com/tpu": "4"}
+
+
+def test_parse_volume():
+    volume, mount = parse_volume("claim_name=c1,mount_path=/data")
+    assert volume["persistent_volume_claim"]["claim_name"] == "c1"
+    assert mount["mount_path"] == "/data"
+    volume, mount = parse_volume("host_path=/mnt,mount_path=/data")
+    assert volume["host_path"]["path"] == "/mnt"
+    with pytest.raises(ValueError):
+        parse_volume("claim_name=c1")
+    assert parse_volume("") is None
+
+
+def test_parse_envs():
+    assert parse_envs("a=1,b=x") == {"a": "1", "b": "x"}
+    assert parse_envs("") == {}
+
+
+def test_master_args_async_forces_grads_to_wait():
+    args = parse_master_args(
+        [
+            "--job_name", "j", "--model_zoo", "z", "--model_def", "m",
+            "--minibatch_size", "4", "--training_data", "d",
+            "--use_async", "true", "--grads_to_wait", "8",
+        ]
+    )
+    assert args.use_async and args.grads_to_wait == 1
+
+
+def test_master_args_sync_forces_get_model_steps():
+    args = parse_master_args(
+        [
+            "--job_name", "j", "--model_zoo", "z", "--model_def", "m",
+            "--minibatch_size", "4", "--training_data", "d",
+            "--get_model_steps", "5",
+        ]
+    )
+    assert args.get_model_steps == 1
+
+
+def test_ps_and_worker_args():
+    args = parse_ps_args(
+        ["--ps_id", "1", "--port", "2222", "--model_zoo", "z",
+         "--model_def", "m"]
+    )
+    assert args.ps_id == 1 and args.port == 2222
+    args = parse_worker_args(
+        ["--worker_id", "3", "--job_type", "training_only",
+         "--model_zoo", "z", "--model_def", "m", "--minibatch_size", "8"]
+    )
+    assert args.worker_id == 3 and args.distribution_strategy
+
+
+def test_arg_relay_roundtrip():
+    """Master re-serializes args into child-pod argv (reference
+    args.py:622-643)."""
+    args = parse_master_args(
+        [
+            "--job_name", "j", "--model_zoo", "z", "--model_def", "m",
+            "--minibatch_size", "4", "--training_data", "d",
+            "--use_async", "true",
+        ]
+    )
+    argv = build_arguments_from_parsed_result(args)
+    assert "--use_async" in argv
+    assert argv[argv.index("--use_async") + 1] == "true"
+    assert argv[argv.index("--minibatch_size") + 1] == "4"
