@@ -1,0 +1,103 @@
+"""Vocab-sharded embedding table resident in device HBM.
+
+This is the TPU-native replacement for the reference's sharded-PS/Redis
+embedding plane (BASELINE.json north star: "row-partitioned embedding
+tables live in pod HBM with ICI collectives for id lookup/update"):
+
+- the table is a *regular trainable parameter* sharded on its vocab axis
+  across a mesh axis (``P(axis, None)``); optimizer state co-shards
+  automatically under jit, mirroring the PS slot-table co-location
+  (reference ps/parameters.py:145-159) with zero extra machinery,
+- lookup runs under shard_map: every device gathers the rows it owns for
+  the (replicated) id batch and a ``psum`` over ICI assembles the full
+  activation — communication is O(B x L x D), independent of vocab size,
+- gradients flow through the shard_map transpose: each device receives
+  exactly its shard's row gradients, so the update never materializes the
+  dense (V, D) gradient anywhere.
+
+The host-PS mode (nn/embedding.py + ps/) remains for CPU-RAM-sized tables
+and async training; both share checkpoint naming via the params pytree.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.ring_attention import shard_map
+
+
+def sharded_lookup(table, ids, mesh, axis):
+    """Gather rows of a vocab-sharded table; differentiable.
+
+    ``table``: global (V, D) sharded P(axis, None); ``ids``: int array of
+    any shape. Returns ids.shape + (D,).
+
+    When the mesh also has a ``data`` axis distinct from the table axis,
+    the id batch (and the output) shard over it, so each dp replica only
+    gathers/psums its own batch slice and the psum rides the table axis
+    alone. On a mesh where the table axis IS the batch axis (pure-dp), ids
+    must replicate across it — the collective then carries the global
+    batch, which is the unavoidable cost of vocab-sharding over the same
+    axis as the batch; shard tables on ``model`` to avoid it.
+    """
+
+    def _lookup(table_local, ids):
+        n = jax.lax.psum(1, axis)
+        me = jax.lax.axis_index(axis)
+        rows_per = table_local.shape[0]
+        local = ids.astype(jnp.int32) - me * rows_per
+        mask = (local >= 0) & (local < rows_per)
+        safe = jnp.clip(local, 0, rows_per - 1)
+        rows = jnp.take(table_local, safe, axis=0)
+        rows = jnp.where(mask[..., None], rows, 0)
+        return jax.lax.psum(rows, axis)
+
+    axes = set(mesh.axis_names)
+    batch_axis = "data" if ("data" in axes and axis != "data") else None
+    ids_spec = P(*([batch_axis] + [None] * (ids.ndim - 1)))
+    out_spec = P(*([batch_axis] + [None] * ids.ndim))
+    return shard_map(
+        _lookup,
+        mesh=mesh,
+        in_specs=(P(axis, None), ids_spec),
+        out_specs=out_spec,
+        check_rep=False,
+    )(table, ids)
+
+
+class HbmEmbedding(nn.Module):
+    """Drop-in embedding whose table shards over ``mesh[axis]`` HBM."""
+
+    vocab_size: int
+    features: int
+    mesh: object = None
+    axis: str = "data"
+    mask_zero: bool = False
+
+    @nn.compact
+    def __call__(self, ids, training=False):
+        table = self.param(
+            "table",
+            nn.initializers.variance_scaling(
+                1.0, "fan_in", "normal", out_axis=0
+            ),
+            (self.vocab_size, self.features),
+        )
+        ids = jnp.asarray(ids).astype(jnp.int32)
+        if self.mesh is None:
+            emb = jnp.take(table, ids, axis=0)
+        else:
+            table = jax.lax.with_sharding_constraint(
+                table, NamedSharding(self.mesh, P(self.axis, None))
+            )
+            emb = sharded_lookup(table, ids, self.mesh, self.axis)
+        if self.mask_zero:
+            emb = emb * (ids != 0).astype(emb.dtype)[..., None]
+        return emb
+
+
+def table_sharding(mesh, axis="data"):
+    """NamedSharding to place an HbmEmbedding table parameter."""
+    return NamedSharding(mesh, P(axis, None))

@@ -1,0 +1,155 @@
+"""Fused flash-attention forward kernel (Pallas/TPU).
+
+The hot op of the transformer family: softmax(QK^T)V computed blockwise
+with the online-softmax recurrence, so neither the (L, L) score matrix nor
+full-length K/V ever sit in VMEM. The grid is (batch*heads, q_blocks,
+k_blocks): Pallas streams one (block_k, D) K/V tile from HBM per step
+while the running max / normalizer / accumulator persist in VMEM scratch
+across the innermost k axis — the standard TPU flash pipeline.
+Accumulation is float32 while inputs may be bfloat16 (MXU native).
+
+Gradient support: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes attention with the shared XLA reference
+(parallel/ring_attention.reference_attention) — the standard memory/FLOP
+trade (same role as ``jax.checkpoint``).
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests), so
+numerics are identical everywhere.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from elasticdl_tpu.parallel.ring_attention import reference_attention
+
+NEG_INF = -1e30
+_LANES = 128  # stats are broadcast across a full lane register
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, causal, scale
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())))
+            * scale
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)  # (block_q, 1)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(p, v_blk)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # blocks entirely above the diagonal contribute nothing
+        @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+
+    else:
+        compute()
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            "sequence lengths (%d, %d) must divide block sizes (%d, %d)"
+            % (lq, lk, block_q, block_k)
+        )
+    scale = d ** -0.5
+    # fold heads into the grid's leading axis: (B*H, L, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, lq // block_q, lk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, qi, kj: (i, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+def _use_interpret():
+    return jax.default_backend() not in ("tpu",)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
+    """(B, L, H, D) fused attention. Differentiable (recompute backward)."""
+    return _flash_fwd(
+        q, k, v, causal, block_q, block_k, _use_interpret()
+    )
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k):
+    out = flash_attention(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd_rule(causal, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,156 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+Long-context training shards the sequence axis across devices (the mesh's
+``seq`` axis). Each device keeps its Q shard resident and K/V shards rotate
+around the ring via ``ppermute`` over ICI; partial attention outputs merge
+with the online-softmax (flash) recurrence, so the full (L, L) score matrix
+never materializes and memory stays O(L_local).
+
+This is the blockwise ring attention of Liu et al. (Ring Attention with
+Blockwise Transformers, 2023), built with shard_map + XLA collectives —
+the per-device block kernel lowers to the MXU, and the K/V rotation
+overlaps with compute via XLA's async collective scheduling.
+
+No counterpart exists in the reference (no attention models, SURVEY.md
+§5.7); this subsystem is the framework's long-context scaling axis.
+"""
+
+import functools
+import inspect
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma in jax 0.8
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, **kwargs):
+    if "check_rep" in kwargs:
+        kwargs[_CHECK_KW] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)
+
+
+def _block_attend(q, k, v, bias=None):
+    """Scores + flash statistics for one (Q_block, KV_block) pair.
+
+    q: (B, Lq, H, D), k/v: (B, Lk, H, D). Returns (out_unnorm, row_max,
+    row_sum) with out_unnorm = exp(s - row_max) @ v.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)  # (B, H, Lq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)  # (B, H, Lq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two flash partials (associative online-softmax combine)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    o = o1 * a1.transpose(0, 2, 1)[..., None] + o2 * a2.transpose(0, 2, 1)[
+        ..., None
+    ]
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def _causal_bias(q_offset, k_offset, lq, lk, dtype):
+    q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+    k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+    return jnp.where(q_pos >= k_pos, 0.0, jnp.finfo(dtype).min)
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Exact attention with K/V rotating around ``axis_name``.
+
+    Call *inside* shard_map with q/k/v already sequence-sharded:
+    q, k, v: (B, L_local, H, D). Returns (B, L_local, H, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    l_local = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        o, m, l, kk, vv = carry
+        # the K/V block now held came from device (my_idx - step) % n
+        src = (my_idx - step) % n
+        if causal:
+            bias = _causal_bias(
+                my_idx * l_local,
+                src * l_local,
+                l_local,
+                kk.shape[1],
+                q.dtype,
+            )[None, None]
+        else:
+            bias = None
+        bo, bm, bl = _block_attend(q, kk, vv, bias)
+        o, m, l = _merge(o, m, l, bo, bm, bl)
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        return o, m, l, kk, vv
+
+    b, _, h, d = q.shape
+    o0 = jnp.zeros((b, l_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, l_local), jnp.finfo(jnp.float32).min, jnp.float32)
+    l0 = jnp.zeros((b, h, l_local), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, seq_axis="seq", causal=False):
+    """shard_map-wrapped ring attention over ``mesh[seq_axis]``.
+
+    Inputs/outputs are global (B, L, H, D) arrays sharded on L. The batch
+    dim additionally shards over ``data`` and the head dim over ``model``
+    when those axes exist in the mesh, so dp x tp replicas each attend
+    over their own batch/head slice — the ring only rotates K/V along
+    ``seq_axis``.
+    """
+    axes = set(mesh.axis_names)
+    batch_axis = "data" if "data" in axes and "data" != seq_axis else None
+    head_axis = "model" if "model" in axes and "model" != seq_axis else None
+    spec = P(batch_axis, seq_axis, head_axis, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    def _ring(q, k, v):
+        return ring_attention(q, k, v, seq_axis, causal=causal)
+
+    return _ring
+
+
+def reference_attention(q, k, v, causal=False):
+    """Plain XLA attention (for tests and single-device fallback)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        s = s + _causal_bias(0, 0, lq, lk, q.dtype)[None, None]
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )

@@ -1,0 +1,202 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+No counterpart exists in the reference zoo (CNNs/DeepFM only, SURVEY.md
+§5.7); this family exercises the framework's TPU-native scaling axes:
+
+- ``data``  — batch data parallelism,
+- ``model`` — tensor parallelism (parallel/sharding.py rules match this
+  module's parameter names: query/key/value/out, mlp_up/mlp_down, embed),
+- ``seq``   — sequence parallelism via ring attention
+  (parallel/ring_attention.py) when constructed with ``mesh`` +
+  ``seq_axis``.
+
+Compute dtype is configurable (bfloat16 on the MXU by default for large
+configs); RMSNorm + rotary embeddings keep the block cache/scan friendly.
+"""
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.data.example import FixedLenFeature, parse_example
+from elasticdl_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+
+
+def _rotary(x, positions):
+    """Rotary position embedding over the last (head) dim."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, L, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Any
+    attention_fn: Any
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = nn.RMSNorm(dtype=self.dtype)(x)
+        dense = functools.partial(
+            nn.DenseGeneral,
+            features=(self.num_heads, self.head_dim),
+            axis=-1,
+            use_bias=False,
+            dtype=self.dtype,
+        )
+        q = _rotary(dense(name="query")(h), positions)
+        k = _rotary(dense(name="key")(h), positions)
+        v = dense(name="value")(h)
+        attn = self.attention_fn(q, k, v)
+        attn = nn.DenseGeneral(
+            features=x.shape[-1],
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=self.dtype,
+            name="out",
+        )(attn)
+        x = x + attn
+        h = nn.RMSNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 1024
+    num_layers: int = 2
+    num_heads: int = 4
+    head_dim: int = 16
+    embed_dim: int = 64
+    mlp_dim: int = 256
+    dtype: Any = jnp.float32
+    mesh: Any = None
+    seq_axis: Any = None
+    use_flash: bool = False  # Pallas fused-attention kernel (single-chip)
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        tokens = (
+            features["tokens"] if isinstance(features, dict) else features
+        )
+        tokens = tokens.astype(jnp.int32)
+        b, l = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32), (b, l))
+
+        if self.mesh is not None and self.seq_axis is not None:
+            attention_fn = make_ring_attention(
+                self.mesh, self.seq_axis, causal=True
+            )
+        elif self.use_flash:
+            from elasticdl_tpu.ops.flash_attention import flash_attention
+
+            attention_fn = lambda q, k, v: flash_attention(  # noqa: E731
+                q, k, v, True
+            )
+        else:
+            attention_fn = functools.partial(
+                reference_attention, causal=True
+            )
+
+        embed_layer = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            name="embed",
+        )
+        x = embed_layer(tokens)
+        for i in range(self.num_layers):
+            x = Block(
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                attention_fn=attention_fn,
+                name="block_%d" % i,
+            )(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype)(x)
+        # weight-tied LM head (reads the vocab-sharded embed table)
+        logits = embed_layer.attend(x.astype(jnp.float32))
+        return logits
+
+
+def custom_model(
+    vocab_size=1024,
+    num_layers=2,
+    num_heads=4,
+    head_dim=16,
+    embed_dim=64,
+    mlp_dim=256,
+    dtype="float32",
+    mesh=None,
+    seq_axis=None,
+    use_flash=False,
+):
+    return TransformerLM(
+        vocab_size=vocab_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        head_dim=head_dim,
+        embed_dim=embed_dim,
+        mlp_dim=mlp_dim,
+        dtype=jnp.dtype(dtype),
+        mesh=mesh,
+        seq_axis=seq_axis,
+        use_flash=use_flash,
+    )
+
+
+def loss(output, labels):
+    """Next-token cross entropy; position 0 predicts token 1, etc."""
+    logits = output[:, :-1]
+    targets = labels.astype(jnp.int32)[:, 1:]
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets
+    ).mean()
+
+
+def optimizer(lr=3e-3):
+    return optax.adamw(lr)
+
+
+def dataset_fn(dataset, mode, _):
+    def _parse_data(record):
+        r = parse_example(record, {"tokens": FixedLenFeature([64], np.int64)})
+        tokens = r["tokens"].astype(np.int32)
+        features = {"tokens": tokens}
+        if mode == Mode.PREDICTION:
+            return features
+        return features, tokens
+
+    dataset = dataset.map(_parse_data)
+    if mode == Mode.TRAINING:
+        dataset = dataset.shuffle(buffer_size=1024)
+    return dataset
+
+
+def eval_metrics_fn():
+    def _token_accuracy(labels, predictions):
+        pred = np.argmax(np.asarray(predictions)[:, :-1], axis=-1)
+        tgt = np.asarray(labels)[:, 1:]
+        return (pred == tgt).reshape(-1)
+
+    return {"token_accuracy": _token_accuracy}

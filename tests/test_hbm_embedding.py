@@ -1,0 +1,97 @@
+"""HBM-sharded embedding: lookup + gradient correctness on the 8-dev mesh."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.nn.hbm_embedding import (
+    HbmEmbedding,
+    sharded_lookup,
+    table_sharding,
+)
+from elasticdl_tpu.parallel.mesh import create_mesh
+
+
+def test_sharded_lookup_matches_take():
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    rng = np.random.default_rng(0)
+    table = rng.standard_normal((64, 5)).astype(np.float32)
+    ids = rng.integers(0, 64, size=(3, 7))
+    got = np.asarray(
+        jax.jit(lambda t, i: sharded_lookup(t, i, mesh, "data"))(table, ids)
+    )
+    np.testing.assert_allclose(got, table[ids], rtol=1e-6)
+
+
+def test_sharded_lookup_gradient_is_row_sparse_scatter():
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    table = np.ones((16, 3), np.float32)
+    ids = np.array([[1, 5, 1]])
+
+    def loss(t):
+        return sharded_lookup(t, ids, mesh, "data").sum()
+
+    g = np.asarray(jax.jit(jax.grad(loss))(table))
+    expected = np.zeros_like(table)
+    expected[1] = 2.0  # duplicate id accumulates
+    expected[5] = 1.0
+    np.testing.assert_array_equal(g, expected)
+
+
+class TinyCTR(nn.Module):
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = features["ids"]
+        emb = HbmEmbedding(
+            vocab_size=128, features=8, mesh=self.mesh, axis="data"
+        )(ids)
+        x = emb.sum(axis=1)
+        return nn.Dense(1)(x).reshape(-1)
+
+
+def test_hbm_embedding_trains_sharded():
+    """Full jitted train step with the table sharded over the mesh; the
+    optimizer state co-shards with the table parameter."""
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    model = TinyCTR(mesh=mesh)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 128, size=(16, 4))
+    y = (ids == 7).any(axis=1).astype(np.float32)
+    features = {"ids": ids}
+
+    variables = model.init(jax.random.PRNGKey(0), features)
+    params = variables["params"]
+    # place the table sharded, everything else replicated
+    params = jax.tree_util.tree_map(jax.device_put, params)
+    params["HbmEmbedding_0"]["table"] = jax.device_put(
+        params["HbmEmbedding_0"]["table"], table_sharding(mesh)
+    )
+    opt = optax.adam(3e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out = model.apply({"params": p}, features)
+            return optax.sigmoid_binary_cross_entropy(out, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    with mesh:
+        losses = []
+        for _ in range(60):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # the table stayed sharded through the updates
+    spec = params["HbmEmbedding_0"]["table"].sharding.spec
+    assert "data" in str(spec)
+    # adam's moment buffers co-sharded with the table
+    mu_table = opt_state[0].mu["HbmEmbedding_0"]["table"]
+    assert "data" in str(mu_table.sharding.spec)

@@ -1,0 +1,71 @@
+"""Ring attention correctness on the virtual 8-device mesh.
+
+The sequence-sharded ring computation must match full-sequence attention
+exactly (same softmax, different blocking), causal and non-causal, and
+gradients must flow through the shard_map.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+
+
+def _qkv(b=2, l=32, h=4, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (b, l, h, d)
+    return (
+        rng.standard_normal(shape).astype(np.float32),
+        rng.standard_normal(shape).astype(np.float32),
+        rng.standard_normal(shape).astype(np.float32),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    mesh = create_mesh({"seq": 8}, axis_names=("seq",))
+    q, k, v = _qkv()
+    ring = make_ring_attention(mesh, "seq", causal=causal)
+    with mesh:
+        got = np.asarray(jax.jit(ring)(q, k, v))
+    want = np.asarray(reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_gradients_match():
+    mesh = create_mesh({"seq": 8}, axis_names=("seq",))
+    q, k, v = _qkv(l=16)
+    ring = make_ring_attention(mesh, "seq", causal=True)
+
+    def ring_loss(q, k, v):
+        return (ring(q, k, v) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    with mesh:
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4
+        )
+
+
+def test_ring_with_data_parallel_axis():
+    """seq parallelism composes with a data axis on the same mesh."""
+    mesh = create_mesh(
+        {"data": 2, "seq": 4}, axis_names=("data", "seq")
+    )
+    q, k, v = _qkv(b=4, l=16)
+    ring = make_ring_attention(mesh, "seq", causal=False)
+    with mesh:
+        got = np.asarray(jax.jit(ring)(q, k, v))
+    want = np.asarray(reference_attention(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
