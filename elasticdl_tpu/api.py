@@ -146,6 +146,30 @@ def _run_local_job(args):
         from elasticdl_tpu.common.model_utils import (
             get_dict_from_params_str,
         )
+
+        if args.distribution_strategy == "AllreduceStrategy":
+            from elasticdl_tpu.worker.allreduce_worker import (
+                AllReduceWorker,
+            )
+
+            AllReduceWorker(
+                worker_id=0,
+                job_type=master.job_type,
+                minibatch_size=args.minibatch_size,
+                model_zoo=args.model_zoo,
+                model_def=args.model_def,
+                model_params=args.model_params,
+                dataset_fn=args.dataset_fn,
+                loss=args.loss,
+                optimizer=args.optimizer,
+                eval_metrics_fn=args.eval_metrics_fn,
+                stub=master.master_servicer,
+                data_reader_params=get_dict_from_params_str(
+                    args.data_reader_params
+                ),
+            ).run()
+            return master.run(poll_secs=0.2)
+
         from elasticdl_tpu.worker.worker import Worker
 
         worker = Worker(

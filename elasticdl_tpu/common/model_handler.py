@@ -1,0 +1,124 @@
+"""Strategy-aware model rewriting.
+
+Parity: reference common/model_handler.py — under ParameterServerStrategy
+the handler swaps standard ``Embedding`` layers for the elastic
+(externally-stored) variant at training time (model_handler.py:143-196),
+and swaps them back for export, materializing the trained rows from the
+store into a dense table (:108-141, :198-231).
+
+Flax adaptation: modules are frozen dataclasses, so the swap rewrites
+module *fields* via ``Module.clone`` — the analog of the reference's
+attribute replacement for subclassed keras models (:180-196). Models that
+instantiate their embedding inline in ``@nn.compact`` bodies pick the
+layer explicitly instead (the zoo's deepfm_functional_api vs
+deepfm_edl_embedding pair mirrors exactly this split, as the reference
+zoo does).
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import numpy as np
+
+from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.nn.embedding import Embedding as ElasticEmbedding
+
+
+class ModelHandler:
+    @staticmethod
+    def get_model_handler(
+        distribution_strategy=None, checkpoint_dir=None
+    ):
+        """Factory (reference model_handler.py:31-44)."""
+        if distribution_strategy == DistributionStrategy.PARAMETER_SERVER:
+            return ParameterServerModelHandler(
+                checkpoint_dir=checkpoint_dir
+            )
+        return DefaultModelHandler()
+
+    def get_model_to_train(self, model):
+        raise NotImplementedError
+
+    def get_model_to_export(self, model, params, embedding_store=None):
+        raise NotImplementedError
+
+
+class DefaultModelHandler(ModelHandler):
+    """Local/allreduce strategies: the model trains as defined."""
+
+    def get_model_to_train(self, model):
+        return model
+
+    def get_model_to_export(self, model, params, embedding_store=None):
+        return model, params
+
+
+def _swap_fields(module, swap_fn):
+    """Rebuild a module dataclass with swapped submodule fields."""
+    replacements = {}
+    for field in dataclasses.fields(module):
+        if not field.init:
+            continue
+        value = getattr(module, field.name, None)
+        swapped = swap_fn(value)
+        if swapped is not value:
+            replacements[field.name] = swapped
+    if not replacements:
+        return module
+    return module.clone(**replacements)
+
+
+class ParameterServerModelHandler(ModelHandler):
+    def __init__(self, checkpoint_dir=None):
+        self._checkpoint_dir = checkpoint_dir
+
+    def get_model_to_train(self, model):
+        """nn.Embed fields -> elastic Embedding fields.
+
+        Inline-compact embeddings cannot be rewritten post-hoc; the
+        handler warns (reference clone_model limitations are analogous).
+        """
+
+        def swap(value):
+            if isinstance(value, nn.Embed):
+                return ElasticEmbedding(
+                    output_dim=value.features,
+                    name=value.name,
+                )
+            return value
+
+        swapped = _swap_fields(model, swap)
+        if swapped is model:
+            logger.info(
+                "model has no swappable Embed fields; elastic embedding "
+                "layers must be used directly in compact models"
+            )
+        return swapped
+
+    def get_model_to_export(self, model, params, embedding_store=None):
+        """Elastic Embedding fields -> nn.Embed + dense tables.
+
+        Trained rows are pulled from the store and packed into a dense
+        (vocab, dim) array inserted into the params pytree under the
+        standard ``{name}/embedding`` key, so the exported model serves
+        with zero framework dependencies (reference :108-141).
+        """
+
+        def swap(value):
+            if isinstance(value, ElasticEmbedding):
+                table = embedding_store.embedding_params[value.name]
+                ids = sorted(table.embedding_vectors)
+                vocab = (ids[-1] + 1) if ids else 1
+                dense = np.zeros((vocab, value.output_dim), np.float32)
+                for i in ids:
+                    dense[i] = table.embedding_vectors[i]
+                params[value.name] = {"embedding": dense}
+                return nn.Embed(
+                    num_embeddings=vocab,
+                    features=value.output_dim,
+                    name=value.name,
+                )
+            return value
+
+        return _swap_fields(model, swap), params

@@ -12,7 +12,7 @@ import os
 from abc import ABC, abstractmethod
 
 from elasticdl_tpu.common.constants import ODPSConfig
-from elasticdl_tpu.data.recordio import RecordIOReader
+from elasticdl_tpu.data.recordio import RecordIOReader, open_recordio
 
 
 class Metadata:
@@ -58,7 +58,8 @@ class RecordIODataReader(AbstractDataReader):
 
     def _reader(self, path):
         if path not in self._readers:
-            self._readers[path] = RecordIOReader(path)
+            # C++ mmap reader when built; Python fallback otherwise
+            self._readers[path] = open_recordio(path)
         return self._readers[path]
 
     def read_records(self, task):

@@ -1,0 +1,55 @@
+"""ODPS table rows -> structured examples -> EDLR shard files.
+
+Parity: reference data/odps_recordio_conversion_utils.py — convert
+MaxCompute rows (sequences of column values) into the framework's example
+records partitioned into shard files. Numeric columns become float32/int64
+features named by column; string columns are utf-8 byte features.
+"""
+
+import os
+
+import numpy as np
+
+from elasticdl_tpu.data.example import encode_example
+from elasticdl_tpu.data.recordio import RecordIOWriter
+
+
+def row_to_example(row, column_names):
+    features = {}
+    for name, value in zip(column_names, row):
+        if isinstance(value, (int, np.integer)):
+            features[name] = np.asarray([value], dtype=np.int64)
+        elif isinstance(value, (float, np.floating)):
+            features[name] = np.asarray([value], dtype=np.float32)
+        elif isinstance(value, bytes):
+            features[name] = np.frombuffer(value, dtype=np.uint8)
+        else:
+            features[name] = np.frombuffer(
+                str(value).encode("utf-8"), dtype=np.uint8
+            )
+    return features
+
+
+def write_recordio_shards_from_iterator(
+    records_iter,
+    column_names,
+    output_dir,
+    records_per_shard=8192,
+):
+    """Reference write_recordio_shards_from_iterator semantics."""
+    os.makedirs(output_dir, exist_ok=True)
+    files = []
+    writer = None
+    count = 0
+    for row in records_iter:
+        if writer is None or count % records_per_shard == 0:
+            if writer is not None:
+                writer.close()
+            path = os.path.join(output_dir, "data-%05d" % len(files))
+            files.append(path)
+            writer = RecordIOWriter(path)
+        writer.write(encode_example(row_to_example(row, column_names)))
+        count += 1
+    if writer is not None:
+        writer.close()
+    return files

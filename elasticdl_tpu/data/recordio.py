@@ -137,3 +137,19 @@ def write_recordio(path, payloads):
         for p in payloads:
             w.write(p)
         return w.num_records
+
+
+def open_recordio(path):
+    """Reader factory: the C++ mmap reader when built, else the Python one.
+
+    Both expose the same API (len/read/read_range/close); build the native
+    one with ``python -m elasticdl_tpu.native.build``.
+    """
+    try:
+        from elasticdl_tpu.native import NativeRecordIOReader, native_lib
+
+        if native_lib() is not None:
+            return NativeRecordIOReader(path)
+    except Exception:
+        pass
+    return RecordIOReader(path)

@@ -1,0 +1,77 @@
+"""Convert frappe-style CTR data (libffm text) into EDLR shards.
+
+Parity: reference data/recordio_gen/frappe_recordio_gen.py — each input
+line is ``label feat:field:... feat:...``; features become an int64 id
+vector and the label a single int64, matching the deepfm zoo dataset_fn.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from elasticdl_tpu.data.example import encode_example
+from elasticdl_tpu.data.recordio import RecordIOWriter
+
+
+def parse_line(line, num_features=10):
+    parts = line.strip().split()
+    if not parts:
+        return None
+    label = int(float(parts[0]))
+    feats = []
+    for tok in parts[1 : num_features + 1]:
+        feats.append(int(tok.split(":")[0]))
+    while len(feats) < num_features:
+        feats.append(0)
+    return np.asarray(feats, dtype=np.int64), np.asarray(
+        [label], dtype=np.int64
+    )
+
+
+def convert(input_file, output_dir, records_per_shard=8192, num_features=10):
+    os.makedirs(output_dir, exist_ok=True)
+    files = []
+    writer = None
+    count = 0
+    with open(input_file) as f:
+        for line in f:
+            parsed = parse_line(line, num_features)
+            if parsed is None:
+                continue
+            if writer is None or count % records_per_shard == 0:
+                if writer is not None:
+                    writer.close()
+                path = os.path.join(
+                    output_dir, "frappe-%05d" % len(files)
+                )
+                files.append(path)
+                writer = RecordIOWriter(path)
+            feature, label = parsed
+            writer.write(
+                encode_example({"feature": feature, "label": label})
+            )
+            count += 1
+    if writer is not None:
+        writer.close()
+    return files
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input", required=True)
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--records_per_shard", type=int, default=8192)
+    parser.add_argument("--num_features", type=int, default=10)
+    args = parser.parse_args(argv)
+    files = convert(
+        args.input,
+        args.output_dir,
+        args.records_per_shard,
+        args.num_features,
+    )
+    print("\n".join(files))
+
+
+if __name__ == "__main__":
+    main()

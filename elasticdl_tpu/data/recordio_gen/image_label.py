@@ -1,0 +1,87 @@
+"""Convert (image, label) datasets into sharded EDLR files.
+
+Parity: reference data/recordio_gen/image_label.py — partition a dataset
+into N records per shard file under ``{dir}/data-%05d`` so the master can
+shard-address them. Works on in-memory arrays or any (image, label)
+iterable; e.g. mnist/cifar10 arrays from any source.
+
+Usage:
+    python -m elasticdl_tpu.data.recordio_gen.image_label \
+        --output_dir /data/mnist --records_per_shard 4096 --dataset mnist
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from elasticdl_tpu.data.example import encode_example
+from elasticdl_tpu.data.recordio import RecordIOWriter
+
+
+def convert(iterable, output_dir, records_per_shard=4096, partition=""):
+    """Write examples; returns the list of shard files created."""
+    os.makedirs(output_dir, exist_ok=True)
+    files = []
+    writer = None
+    count = 0
+    try:
+        for image, label in iterable:
+            if writer is None or count % records_per_shard == 0:
+                if writer is not None:
+                    writer.close()
+                name = "data%s-%05d" % (
+                    "-" + partition if partition else "",
+                    len(files),
+                )
+                path = os.path.join(output_dir, name)
+                files.append(path)
+                writer = RecordIOWriter(path)
+            writer.write(
+                encode_example(
+                    {
+                        "image": np.asarray(image),
+                        "label": np.asarray(label, dtype=np.int64).reshape(
+                            -1
+                        ),
+                    }
+                )
+            )
+            count += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    return files
+
+
+def _load_builtin(name):
+    """Synthesize or load well-known datasets without TF."""
+    if name == "synthetic-mnist":
+        rng = np.random.default_rng(0)
+        n = 4096
+        images = rng.integers(0, 256, size=(n, 28, 28)).astype(np.float32)
+        labels = rng.integers(0, 10, size=(n,))
+        return zip(images, labels)
+    raise ValueError(
+        "unknown dataset %r (pass your own arrays via convert())" % name
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--records_per_shard", type=int, default=4096)
+    parser.add_argument("--dataset", default="synthetic-mnist")
+    parser.add_argument("--partition", default="")
+    args = parser.parse_args(argv)
+    files = convert(
+        _load_builtin(args.dataset),
+        args.output_dir,
+        args.records_per_shard,
+        args.partition,
+    )
+    print("\n".join(files))
+
+
+if __name__ == "__main__":
+    main()

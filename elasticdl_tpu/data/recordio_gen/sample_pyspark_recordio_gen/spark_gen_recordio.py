@@ -1,0 +1,60 @@
+"""PySpark job: distributed dataset -> EDLR shards.
+
+Parity: reference data/recordio_gen/sample_pyspark_recordio_gen/
+spark_gen_recordio.py — each Spark partition writes its own shard files
+via ``mapPartitionsWithIndex``; the pyspark dependency is required only
+when actually submitting the job.
+"""
+
+import argparse
+
+
+def write_partition(index, records, output_dir, records_per_shard, prepare):
+    """Runs on executors: converts one partition's records."""
+    from elasticdl_tpu.data.recordio_gen.image_label import convert
+
+    examples = (prepare(r) for r in records)
+    files = convert(
+        examples,
+        output_dir,
+        records_per_shard=records_per_shard,
+        partition="p%05d" % index,
+    )
+    return files
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--training_data_dir", required=True)
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--records_per_shard", type=int, default=4096)
+    parser.add_argument("--num_workers", type=int, default=2)
+    args = parser.parse_args(argv)
+
+    from pyspark import SparkContext  # noqa: PLC0415 — executor-only dep
+
+    sc = SparkContext()
+    rdd = sc.binaryFiles(args.training_data_dir).repartition(
+        args.num_workers
+    )
+
+    def prepare(pair):
+        # filename encodes the label as its parent directory, matching
+        # the reference mnist ingestion convention
+        import numpy as np
+
+        path, payload = pair
+        label = int(path.split("/")[-2])
+        image = np.frombuffer(payload, dtype=np.uint8)
+        return image, label
+
+    rdd.mapPartitionsWithIndex(
+        lambda idx, it: write_partition(
+            idx, it, args.output_dir, args.records_per_shard, prepare
+        )
+    ).collect()
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

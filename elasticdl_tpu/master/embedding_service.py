@@ -1,0 +1,55 @@
+"""Embedding service — replaced-by note + compatible facade.
+
+The reference ran a dedicated pod with six redis-server instances formed
+into a Redis Cluster and moved embedding rows over TCP as float32 blobs
+(reference master/embedding_service.py:57-354). The TPU-native build
+eliminates the external KV entirely:
+
+- master-central mode stores tables in the master's ``ps.Parameters``
+  store (master/servicer.py ``_embedding_store``), updated by the
+  structure-generic OptimizerWrapper — same semantics, no extra pods;
+- sharded mode keeps rows on the PS fleet (ps/) or, on the TPU fast
+  path, sharded in device HBM (nn/hbm_embedding.py) where lookups/updates
+  ride ICI collectives instead of a network KV.
+
+This module keeps the reference's static lookup/update API shape for code
+that imported it, backed by a Parameters store.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.ps.parameters import Parameters
+
+
+class EmbeddingService:
+    """Facade over a Parameters store (reference :268-354 API shape)."""
+
+    def __init__(self, parameters=None):
+        self._parameters = parameters or Parameters()
+
+    @property
+    def parameters(self):
+        return self._parameters
+
+    def lookup_embedding(self, keys):
+        """keys: iterable of "{layer}-{id}" strings -> (values, unknown).
+
+        Mirrors the reference's pipelined GET returning which keys were
+        missing (here: lazily initialized, so none are).
+        """
+        values = []
+        for key in keys:
+            layer, _, row_id = key.rpartition("-")
+            values.append(
+                self._parameters.get_embedding_param(
+                    layer, [int(row_id)]
+                )[0]
+            )
+        return values, []
+
+    def update_embedding(self, keys, values):
+        for key, value in zip(keys, values):
+            layer, _, row_id = key.rpartition("-")
+            self._parameters.set_embedding_param(
+                layer, [int(row_id)], np.asarray(value)[None]
+            )

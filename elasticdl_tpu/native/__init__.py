@@ -1,0 +1,109 @@
+"""Native (C++) components and their ctypes bindings.
+
+Build with ``python -m elasticdl_tpu.native.build`` (g++ + zlib); loading
+falls back silently to the portable Python implementations when the
+shared library is absent or ``EDL_DISABLE_NATIVE=1``.
+"""
+
+import ctypes
+import os
+
+_SO_NAME = "libedl_native.so"
+_handle = None
+_load_failed = False
+
+
+def native_lib():
+    """The loaded CDLL, or None if unavailable."""
+    global _handle, _load_failed
+    if _handle is not None or _load_failed:
+        return _handle
+    if os.environ.get("EDL_DISABLE_NATIVE") == "1":
+        _load_failed = True
+        return None
+    path = os.path.join(os.path.dirname(__file__), _SO_NAME)
+    if not os.path.exists(path):
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.edlr_open.restype = ctypes.c_void_p
+        lib.edlr_open.argtypes = [ctypes.c_char_p]
+        lib.edlr_num_records.restype = ctypes.c_int64
+        lib.edlr_num_records.argtypes = [ctypes.c_void_p]
+        for fn in (lib.edlr_read, lib.edlr_read_validate):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+        lib.edlr_close.restype = None
+        lib.edlr_close.argtypes = [ctypes.c_void_p]
+        _handle = lib
+    except OSError:
+        _load_failed = True
+    return _handle
+
+
+class NativeRecordIOReader:
+    """ctypes wrapper with the RecordIOReader API (data/recordio.py)."""
+
+    def __init__(self, path):
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError("native library not available")
+        self._lib = lib
+        self._path = path
+        self._h = lib.edlr_open(path.encode())
+        if not self._h:
+            raise ValueError("not a valid EDLR file: %s" % path)
+        self._len = lib.edlr_num_records(self._h)
+
+    def __len__(self):
+        return self._len
+
+    def read(self, i, validate=False):
+        data = ctypes.POINTER(ctypes.c_ubyte)()
+        length = ctypes.c_uint32()
+        fn = (
+            self._lib.edlr_read_validate
+            if validate
+            else self._lib.edlr_read
+        )
+        rc = fn(self._h, i, ctypes.byref(data), ctypes.byref(length))
+        if rc == -4:
+            raise ValueError(
+                "crc mismatch at record %d of %s" % (i, self._path)
+            )
+        if rc != 0:
+            raise IndexError(
+                "record %d unreadable in %s (rc=%d)" % (i, self._path, rc)
+            )
+        return ctypes.string_at(data, length.value)
+
+    def read_range(self, start, end):
+        end = min(end, self._len)
+        for i in range(max(start, 0), end):
+            yield self.read(i)
+
+    def __iter__(self):
+        return self.read_range(0, self._len)
+
+    def close(self):
+        if self._h:
+            self._lib.edlr_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,32 @@
+"""Build the native library: ``python -m elasticdl_tpu.native.build``."""
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(verbose=True):
+    src = os.path.join(_DIR, "recordio_reader.cc")
+    out = os.path.join(_DIR, "libedl_native.so")
+    cmd = [
+        "g++",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        src,
+        "-lz",
+        "-o",
+        out,
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.check_call(cmd)
+    return out
+
+
+if __name__ == "__main__":
+    build()
+    sys.exit(0)

@@ -1,0 +1,148 @@
+// Native EDLR (indexed record file) reader.
+//
+// Role parity: the reference's native substrate for shard-addressable data
+// is the third-party RecordIO C/Go library (SURVEY.md §2.4); this is the
+// framework's own. The file layout is defined in
+// elasticdl_tpu/data/recordio.py (the Python writer/reader is the
+// portable fallback):
+//
+//   file   := "EDLR" u32 version  record*  index  tail
+//   record := u32 payload_len, u32 crc32(payload), payload bytes
+//   index  := u64 count, u64 record_offset[count]
+//   tail   := u64 index_offset, "EDLX"
+//
+// The reader mmaps the file, resolves the index once, and serves
+// zero-copy pointers into the mapping — the Python binding wraps them in
+// memoryview/bytes. Exposed as a C ABI for ctypes (no pybind11 in this
+// toolchain).
+
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'E', 'D', 'L', 'R'};
+constexpr char kTailMagic[4] = {'E', 'D', 'L', 'X'};
+constexpr uint32_t kVersion = 1;
+constexpr size_t kHeaderSize = 8;   // magic + u32 version
+constexpr size_t kTailSize = 12;    // u64 index_offset + tail magic
+constexpr size_t kRecHeaderSize = 8;  // u32 len + u32 crc
+
+struct Reader {
+  int fd = -1;
+  const uint8_t* base = nullptr;
+  size_t size = 0;
+  const uint64_t* offsets = nullptr;  // points into the mapping
+  uint64_t count = 0;
+};
+
+uint32_t read_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t read_u64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or nullptr on any structural error.
+void* edlr_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) <
+                                 kHeaderSize + kTailSize) {
+    ::close(fd);
+    return nullptr;
+  }
+  size_t size = st.st_size;
+  void* mapped = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (mapped == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  const uint8_t* base = static_cast<const uint8_t*>(mapped);
+  if (std::memcmp(base, kMagic, 4) != 0 ||
+      read_u32(base + 4) != kVersion ||
+      std::memcmp(base + size - 4, kTailMagic, 4) != 0) {
+    munmap(mapped, size);
+    ::close(fd);
+    return nullptr;
+  }
+  uint64_t index_offset = read_u64(base + size - kTailSize);
+  if (index_offset + 8 > size - kTailSize) {
+    munmap(mapped, size);
+    ::close(fd);
+    return nullptr;
+  }
+  uint64_t count = read_u64(base + index_offset);
+  if (index_offset + 8 + count * 8 > size - kTailSize) {
+    munmap(mapped, size);
+    ::close(fd);
+    return nullptr;
+  }
+  Reader* r = new Reader();
+  r->fd = fd;
+  r->base = base;
+  r->size = size;
+  r->count = count;
+  r->offsets = reinterpret_cast<const uint64_t*>(base + index_offset + 8);
+  return r;
+}
+
+int64_t edlr_num_records(void* handle) {
+  if (!handle) return -1;
+  return static_cast<Reader*>(handle)->count;
+}
+
+// Zero-copy read: *data points into the mapping; valid until edlr_close.
+// Returns 0 on success, negative on error.
+int edlr_read(void* handle, int64_t index, const uint8_t** data,
+              uint32_t* len) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || index < 0 || static_cast<uint64_t>(index) >= r->count) return -1;
+  uint64_t off = r->offsets[index];
+  if (off + kRecHeaderSize > r->size) return -2;
+  uint32_t payload_len = read_u32(r->base + off);
+  if (off + kRecHeaderSize + payload_len > r->size) return -3;
+  *data = r->base + off + kRecHeaderSize;
+  *len = payload_len;
+  return 0;
+}
+
+// CRC-validating read. Returns 0 ok, -4 on checksum mismatch.
+int edlr_read_validate(void* handle, int64_t index, const uint8_t** data,
+                       uint32_t* len) {
+  int rc = edlr_read(handle, index, data, len);
+  if (rc != 0) return rc;
+  Reader* r = static_cast<Reader*>(handle);
+  uint64_t off = r->offsets[index];
+  uint32_t expected = read_u32(r->base + off + 4);
+  uint32_t actual =
+      crc32(0L, reinterpret_cast<const Bytef*>(*data), *len);
+  return actual == expected ? 0 : -4;
+}
+
+void edlr_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r) return;
+  munmap(const_cast<uint8_t*>(r->base), r->size);
+  ::close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

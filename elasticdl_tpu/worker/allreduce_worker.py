@@ -1,0 +1,247 @@
+"""ALLREDUCE-strategy worker: task-driven on-device data parallelism.
+
+The reference never implemented its allreduce design (docs/designs/
+allreduce.md is a survey; SURVEY.md §2.2) — this is the TPU-native
+realization. The worker pulls tasks from the master exactly like the PS
+worker (same dispatcher, same elasticity: a resize looks like recovered
+tasks), but parameters never leave device HBM: every minibatch is one
+fused jitted step over the device mesh, and the gradient exchange is the
+in-step XLA collective (parallel/trainer.py).
+
+The master runs in pure control-plane mode (optimizer=None): tasks, eval
+bookkeeping, SAVE_MODEL. Checkpoints are written by this worker from the
+device state since the master holds no parameters.
+
+Elasticity inside one host: ``resize(devices)`` re-forms the mesh
+mid-job. Across hosts the same loop runs per-process over a
+``jax.distributed`` mesh; membership changes pause at a task boundary and
+re-enter through ``resize``.
+"""
+
+import os
+import time
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import (
+    GetModelMethod,
+    JobType,
+    MetricsDictKey,
+    Mode,
+    SaveModelConfig,
+    TaskType,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import (
+    get_model_spec,
+    save_checkpoint_to_file,
+)
+from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+from elasticdl_tpu.parallel.trainer import AllReduceTrainer
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+
+class AllReduceWorker:
+    def __init__(
+        self,
+        worker_id,
+        job_type,
+        minibatch_size,
+        model_zoo,
+        model_def,
+        model_params=None,
+        dataset_fn="dataset_fn",
+        loss="loss",
+        optimizer="optimizer",
+        eval_metrics_fn="eval_metrics_fn",
+        stub=None,
+        devices=None,
+        data_reader_params=None,
+        seed=0,
+    ):
+        self._worker_id = worker_id
+        self._job_type = job_type
+        self._minibatch_size = minibatch_size
+        self._stub = stub
+        spec = get_model_spec(
+            model_zoo=model_zoo,
+            model_def=model_def,
+            model_params=model_params,
+            dataset_fn=dataset_fn,
+            loss=loss,
+            optimizer=optimizer,
+            eval_metrics_fn=eval_metrics_fn,
+        )
+        self._dataset_fn = spec.dataset_fn
+        self.trainer = AllReduceTrainer(
+            spec.model, spec.loss, spec.optimizer(), devices=devices,
+            seed=seed,
+        )
+        self._forward_fn = None
+        self._model = spec.model
+        self._evaluation_result = {}
+        self._task_data_service = TaskDataService(
+            self,
+            self._job_type == JobType.TRAINING_WITH_EVALUATION,
+            data_reader_params=data_reader_params,
+        )
+
+    # master surface used by TaskDataService
+    def get_task(self, task_type=None):
+        return self._stub.get_task(self._worker_id, task_type)
+
+    def report_task_result(self, task_id, err_msg="", exec_counters=None):
+        return self._stub.report_task_result(task_id, err_msg, exec_counters)
+
+    # -- steps --------------------------------------------------------------
+
+    def _pad_to_devices(self, features, labels):
+        """Pad a partial batch up to a multiple of the mesh size.
+
+        Padding repeats the final example; the padded rows slightly
+        re-weight the last partial batch of a task (bounded by
+        n_devices/batch) — the price of static shapes on the mesh.
+        """
+        import jax
+
+        n = self.trainer.num_devices
+        leaf = jax.tree_util.tree_leaves(features)[0]
+        b = np.asarray(leaf).shape[0]
+        pad = (-b) % n
+        if pad == 0:
+            return features, labels, b
+
+        def _pad(x):
+            x = np.asarray(x)
+            return np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
+
+        return (
+            jax.tree_util.tree_map(_pad, features),
+            jax.tree_util.tree_map(_pad, labels),
+            b,
+        )
+
+    def _train_batch(self, dataset_batch):
+        features, labels = dataset_batch
+        features, labels, count = self._pad_to_devices(features, labels)
+        loss = self.trainer.train_step(features, labels)
+        return float(loss), count
+
+    def _forward(self, features):
+        import jax
+
+        if self._forward_fn is None:
+            from elasticdl_tpu.training.step import make_forward_fn
+
+            self._forward_fn = make_forward_fn(self._model)
+        ts = self.trainer.train_state
+        return self._forward_fn(ts.params, ts.state, features)
+
+    # -- evaluation ---------------------------------------------------------
+
+    def _process_eval_task(self, task):
+        eval_info = self._task_data_service.get_validation_dataset(task)
+        if not eval_info:
+            return
+        eval_dataset, model_version, task_id = eval_info
+        eval_dataset = self._dataset_fn(
+            eval_dataset,
+            Mode.EVALUATION,
+            self._task_data_service.data_reader.metadata,
+        )
+        eval_dataset = eval_dataset.batch(self._minibatch_size).prefetch(1)
+        err_msg = ""
+        outputs_key = MetricsDictKey.MODEL_OUTPUT
+        for features, labels in eval_dataset:
+            outputs = self._forward(features)
+            if not isinstance(outputs, dict):
+                outputs = {outputs_key: outputs}
+            for k, v in outputs.items():
+                self._evaluation_result.setdefault(
+                    outputs_key, {}
+                ).setdefault(k, []).append(np.asarray(v))
+            self._evaluation_result.setdefault(
+                MetricsDictKey.LABEL, []
+            ).append(np.asarray(labels))
+        if outputs_key in self._evaluation_result:
+            outputs = {
+                name: np.concatenate(chunks)
+                for name, chunks in self._evaluation_result[
+                    outputs_key
+                ].items()
+            }
+            labels = np.concatenate(
+                self._evaluation_result[MetricsDictKey.LABEL]
+            )
+            self._stub.report_evaluation_metrics(
+                model_version, outputs, labels
+            )
+        self.report_task_result(task_id, err_msg)
+        self._evaluation_result = {}
+
+    def _evaluate_only(self):
+        executed = False
+        while True:
+            task = self.get_task(TaskType.EVALUATION)
+            if not task.shard_name:
+                break
+            self._process_eval_task(task)
+            executed = True
+        return executed
+
+    def _process_save_model_task_if_needed(self):
+        task, dataset = (
+            self._task_data_service.get_save_model_task_and_dataset()
+        )
+        if task is None or dataset is None:
+            return
+        saved_model_path = task.extended_config.get(
+            SaveModelConfig.SAVED_MODEL_PATH
+        )
+        saved_model_path = os.path.join(
+            saved_model_path, str(int(time.time()))
+        )
+        os.makedirs(saved_model_path, exist_ok=True)
+        ts = self.trainer.get_host_state()
+        save_checkpoint_to_file(
+            pytree_to_named_arrays(ts.params),
+            self.trainer.version,
+            os.path.join(saved_model_path, "model.chkpt"),
+        )
+        logger.info("Exported model to %s", saved_model_path)
+        self.report_task_result(task_id=task.task_id, err_msg="")
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self):
+        losses = []
+        while True:
+            dataset = self._task_data_service.get_dataset()
+            if not dataset:
+                break
+            dataset = self._dataset_fn(
+                dataset,
+                Mode.TRAINING,
+                self._task_data_service.data_reader.metadata,
+            )
+            dataset = dataset.batch(self._minibatch_size).prefetch(1)
+            batches = 0
+            for dataset_batch in dataset:
+                batches += 1
+                if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                    self._evaluate_only()
+                err_msg = ""
+                try:
+                    loss, count = self._train_batch(dataset_batch)
+                    losses.append(loss)
+                except Exception as e:  # report, don't die: task requeues
+                    err_msg = str(e)
+                    logger.exception("train step failed")
+                    count = self._task_data_service.get_current_task().end
+                self._task_data_service.report_record_done(count, err_msg)
+            if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                self._evaluate_only()
+            self._process_save_model_task_if_needed()
+            if batches == 0:
+                time.sleep(0.2)
+        return losses

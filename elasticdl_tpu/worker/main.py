@@ -23,6 +23,27 @@ def main():
         ps_client = PSClient([BoundPS(a) for a in addrs])
     from elasticdl_tpu.common.model_utils import get_dict_from_params_str
 
+    if args.distribution_strategy == "AllreduceStrategy":
+        from elasticdl_tpu.worker.allreduce_worker import AllReduceWorker
+
+        AllReduceWorker(
+            worker_id=args.worker_id,
+            job_type=args.job_type,
+            minibatch_size=args.minibatch_size,
+            model_zoo=args.model_zoo,
+            model_def=args.model_def,
+            model_params=args.model_params,
+            dataset_fn=args.dataset_fn,
+            loss=args.loss,
+            optimizer=args.optimizer,
+            eval_metrics_fn=args.eval_metrics_fn,
+            stub=stub,
+            data_reader_params=get_dict_from_params_str(
+                args.data_reader_params
+            ),
+        ).run()
+        return 0
+
     worker = Worker(
         worker_id=args.worker_id,
         job_type=args.job_type,

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# E2E CI job: train + evaluate + predict through the real CLI in local
+# mode (parity: reference scripts/client_test.sh, which submits the same
+# three jobs to minikube; local mode exercises the identical master/
+# worker/dispatcher paths without a cluster).
+set -euo pipefail
+
+JOB_TYPE=${1:-train}
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+
+python -m elasticdl_tpu.data.recordio_gen.image_label \
+    --output_dir "$WORK/data" --records_per_shard 128 \
+    --dataset synthetic-mnist >/dev/null
+
+case "$JOB_TYPE" in
+train)
+    python -m elasticdl_tpu.cli train \
+        --job_name test-train \
+        --model_zoo model_zoo \
+        --model_def mnist_subclass.mnist_subclass.CustomModel \
+        --minibatch_size 64 \
+        --num_epochs 1 \
+        --num_workers 2 \
+        --use_async true \
+        --training_data "$WORK/data" \
+        --checkpoint_steps 10 --checkpoint_dir "$WORK/ckpt" \
+        --output "$WORK/export"
+    test -n "$(ls "$WORK"/export/*/model.chkpt)" || exit 1
+    ;;
+evaluate)
+    python -m elasticdl_tpu.cli train \
+        --job_name seed --model_zoo model_zoo \
+        --model_def mnist_subclass.mnist_subclass.CustomModel \
+        --minibatch_size 64 --num_epochs 1 --use_async true \
+        --training_data "$WORK/data" \
+        --checkpoint_steps 10 --checkpoint_dir "$WORK/ckpt"
+    CKPT=$(ls "$WORK"/ckpt/model_v*.chkpt | tail -1)
+    python -m elasticdl_tpu.cli evaluate \
+        --job_name test-eval --model_zoo model_zoo \
+        --model_def mnist_subclass.mnist_subclass.CustomModel \
+        --minibatch_size 64 \
+        --validation_data "$WORK/data" \
+        --checkpoint_filename_for_init "$CKPT"
+    ;;
+predict)
+    python -m elasticdl_tpu.cli train \
+        --job_name seed --model_zoo model_zoo \
+        --model_def mnist_subclass.mnist_subclass.CustomModel \
+        --minibatch_size 64 --num_epochs 1 --use_async true \
+        --training_data "$WORK/data" \
+        --checkpoint_steps 10 --checkpoint_dir "$WORK/ckpt"
+    CKPT=$(ls "$WORK"/ckpt/model_v*.chkpt | tail -1)
+    python -m elasticdl_tpu.cli predict \
+        --job_name test-predict --model_zoo model_zoo \
+        --model_def mnist_subclass.mnist_subclass.CustomModel \
+        --minibatch_size 64 \
+        --prediction_data "$WORK/data" \
+        --checkpoint_filename_for_init "$CKPT"
+    ;;
+*)
+    echo "unknown job type $JOB_TYPE" >&2
+    exit 2
+    ;;
+esac
+echo "client_test $JOB_TYPE: OK"

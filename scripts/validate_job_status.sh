@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Poll a cluster job until its pods reach Succeeded (parity: reference
+# scripts/validate_job_status.sh — reads the master pod's `status` label
+# when TensorBoard keeps the pod alive).
+set -euo pipefail
+
+JOB_NAME=${1:?usage: validate_job_status.sh <job_name> [timeout_s]}
+TIMEOUT=${2:-600}
+NS=${NAMESPACE:-default}
+MASTER="elasticdl-${JOB_NAME}-master"
+
+for ((t = 0; t < TIMEOUT; t += 10)); do
+    phase=$(kubectl -n "$NS" get pod "$MASTER" \
+        -o jsonpath='{.status.phase}' 2>/dev/null || echo Missing)
+    label=$(kubectl -n "$NS" get pod "$MASTER" \
+        -o jsonpath='{.metadata.labels.status}' 2>/dev/null || true)
+    echo "t=${t}s master phase=${phase} status-label=${label}"
+    if [[ "$phase" == "Succeeded" || "$label" == "Finished" ]]; then
+        echo "job ${JOB_NAME}: OK"
+        exit 0
+    fi
+    if [[ "$phase" == "Failed" ]]; then
+        kubectl -n "$NS" logs "$MASTER" --tail 50 || true
+        exit 1
+    fi
+    sleep 10
+done
+echo "job ${JOB_NAME}: timeout" >&2
+exit 1

@@ -1,0 +1,76 @@
+"""Model handler layer-swap tests (reference tests/model_handler_test.py)."""
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.model_handler import (
+    DefaultModelHandler,
+    ModelHandler,
+    ParameterServerModelHandler,
+)
+from elasticdl_tpu.nn.embedding import (
+    IDX_COLLECTION,
+    ROWS_COLLECTION,
+    Embedding as ElasticEmbedding,
+)
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo, Parameters
+
+
+class SetupStyleModel(nn.Module):
+    """Declarative model whose embedding is a swappable field."""
+
+    embed: nn.Module = None
+
+    def setup(self):
+        self.dense = nn.Dense(2)
+
+    def __call__(self, ids, training=False):
+        return self.dense(self.embed(ids).sum(axis=1))
+
+
+def test_factory():
+    assert isinstance(
+        ModelHandler.get_model_handler(
+            DistributionStrategy.PARAMETER_SERVER
+        ),
+        ParameterServerModelHandler,
+    )
+    assert isinstance(
+        ModelHandler.get_model_handler(DistributionStrategy.ALLREDUCE),
+        DefaultModelHandler,
+    )
+
+
+def test_swap_embed_to_elastic():
+    model = SetupStyleModel(embed=nn.Embed(100, 8, name="emb"))
+    handler = ParameterServerModelHandler()
+    trained = handler.get_model_to_train(model)
+    assert isinstance(trained.embed, ElasticEmbedding)
+    assert trained.embed.output_dim == 8
+    assert trained.embed.name == "emb"
+
+
+def test_export_swaps_back_with_trained_rows():
+    store = Parameters()
+    store.init_embedding_params([EmbeddingTableInfo("emb", 4)])
+    store.embedding_params["emb"].set(
+        [0, 3], np.array([[1, 1, 1, 1], [3, 3, 3, 3]], np.float32)
+    )
+    model = SetupStyleModel(
+        embed=ElasticEmbedding(output_dim=4, name="emb")
+    )
+    handler = ParameterServerModelHandler()
+    params = {}
+    exported, params = handler.get_model_to_export(model, params, store)
+    assert isinstance(exported.embed, nn.Embed)
+    assert exported.embed.num_embeddings == 4
+    np.testing.assert_array_equal(params["emb"]["embedding"][3], 3.0)
+    np.testing.assert_array_equal(params["emb"]["embedding"][1], 0.0)
+
+
+def test_default_handler_passthrough():
+    model = SetupStyleModel(embed=nn.Embed(10, 2))
+    handler = DefaultModelHandler()
+    assert handler.get_model_to_train(model) is model

@@ -1,0 +1,78 @@
+"""C++ EDLR reader vs the Python implementation (same file, same bytes)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from elasticdl_tpu.data.recordio import (
+    RecordIOReader,
+    RecordIOWriter,
+    open_recordio,
+)
+from elasticdl_tpu.native import NativeRecordIOReader, native_lib
+
+
+def _ensure_built():
+    if native_lib() is None:
+        subprocess.check_call(
+            [sys.executable, "-m", "elasticdl_tpu.native.build"]
+        )
+        # reset the load cache
+        import elasticdl_tpu.native as native_mod
+
+        native_mod._load_failed = False
+        native_mod._handle = None
+    return native_lib() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _ensure_built(), reason="native toolchain unavailable"
+)
+
+
+def _write(tmp_path, records):
+    path = str(tmp_path / "data.edlr")
+    with RecordIOWriter(path) as w:
+        for r in records:
+            w.write(r)
+    return path
+
+
+def test_native_matches_python(tmp_path):
+    records = [b"alpha", b"", b"x" * 10000, b"tail"]
+    path = _write(tmp_path, records)
+    with NativeRecordIOReader(path) as native, RecordIOReader(path) as py:
+        assert len(native) == len(py) == 4
+        for i in range(4):
+            assert native.read(i) == bytes(py.read(i)) == records[i]
+        assert list(native.read_range(1, 3)) == records[1:3]
+
+
+def test_native_crc_validation(tmp_path):
+    path = _write(tmp_path, [b"payload"])
+    with NativeRecordIOReader(path) as r:
+        assert r.read(0, validate=True) == b"payload"
+    # corrupt the payload in place
+    with open(path, "r+b") as f:
+        f.seek(8 + 8)  # header + record header
+        f.write(b"X")
+    with NativeRecordIOReader(path) as r:
+        with pytest.raises(ValueError):
+            r.read(0, validate=True)
+
+
+def test_native_rejects_garbage(tmp_path):
+    bad = str(tmp_path / "bad.bin")
+    with open(bad, "wb") as f:
+        f.write(b"not an edlr file at all........")
+    with pytest.raises(ValueError):
+        NativeRecordIOReader(bad)
+
+
+def test_factory_prefers_native(tmp_path):
+    path = _write(tmp_path, [b"a"])
+    reader = open_recordio(path)
+    assert isinstance(reader, NativeRecordIOReader)
+    reader.close()

@@ -1,0 +1,245 @@
+"""Service unit tests.
+
+Parity: reference tests/servicer_test.py, checkpoint_test.py,
+evaluation_service_test.py, staleness_aware_test.py, tensor/dtype and
+model_utils units.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.common.constants import GetModelMethod, TaskType
+from elasticdl_tpu.common.model_utils import (
+    get_dict_from_params_str,
+    get_module_file_path,
+    load_from_checkpoint_file,
+    save_checkpoint_to_file,
+)
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.master.checkpoint_service import CheckpointService
+from elasticdl_tpu.master.evaluation_service import (
+    EvaluationService,
+    _EvaluationJob,
+)
+from elasticdl_tpu.master.learning_rate_modulator import (
+    add_lr_modulation_to_optimizer,
+)
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+def _dispatcher(records=64, rpt=16, epochs=1):
+    return TaskDispatcher({"f": (0, records)}, {}, {}, rpt, epochs)
+
+
+# -- master servicer (reference servicer_test.py) ---------------------------
+
+
+def test_get_task_and_wait_semantics():
+    m = MasterServicer(1, 8, optax.sgd(0.1), _dispatcher(records=16))
+    t1 = m.get_task(1)
+    assert t1.shard_name == "f" and t1.minibatch_size == 8
+    # drain: one task total; next get returns no-task but doing nonempty
+    t2 = m.get_task(1)
+    assert not t2.shard_name and t2.type == TaskType.WAIT
+    m.report_task_result(t1.task_id)
+    t3 = m.get_task(1)
+    assert not t3.shard_name and t3.type is None
+
+
+def test_report_gradient_validation():
+    m = MasterServicer(1, 8, optax.sgd(0.1), _dispatcher(), use_async=True)
+    m.report_variable({"w": np.ones((2, 3), np.float32)})
+    with pytest.raises(ValueError):
+        m.report_gradient([Tensor("bogus", np.ones((2, 3)))], 0)
+    with pytest.raises(ValueError):
+        m.report_gradient([Tensor("w", np.ones((3, 3)))], 0)
+    with pytest.raises(ValueError):
+        # indexed grad with out-of-range row
+        m.report_gradient(
+            [Tensor("w", np.ones((1, 3), np.float32), indices=[5])], 0
+        )
+    accepted, version = m.report_gradient(
+        [Tensor("w", np.full((2, 3), 0.1, np.float32))], 0
+    )
+    assert accepted and version == 1
+
+
+def test_sync_rejects_stale_version():
+    m = MasterServicer(1, 8, optax.sgd(0.1), _dispatcher())
+    m.report_variable({"w": np.ones((2,), np.float32)})
+    m.report_gradient([Tensor("w", np.ones((2,), np.float32))], 0)
+    accepted, version = m.report_gradient(
+        [Tensor("w", np.ones((2,), np.float32))], 0
+    )
+    assert not accepted and version == 1
+    with pytest.raises(ValueError):
+        m.get_model(99, GetModelMethod.MINIMUM)
+
+
+def test_indexed_grad_scatter_adds_duplicates():
+    m = MasterServicer(1, 8, optax.sgd(1.0), _dispatcher(), use_async=True)
+    m.report_variable({"emb": np.zeros((4, 2), np.float32)})
+    m.report_gradient(
+        [
+            Tensor(
+                "emb",
+                np.ones((3, 2), np.float32),
+                indices=[1, 1, 3],
+            )
+        ],
+        0,
+    )
+    _, named = m.get_model(1)
+    np.testing.assert_array_equal(named["emb"][1], [-2.0, -2.0])
+    np.testing.assert_array_equal(named["emb"][3], [-1.0, -1.0])
+    np.testing.assert_array_equal(named["emb"][0], [0.0, 0.0])
+
+
+# -- checkpoint service (reference checkpoint_test.py) ----------------------
+
+
+def test_checkpoint_ring_retention(tmp_path):
+    svc = CheckpointService(str(tmp_path), 1, 3, False)
+    for v in range(5):
+        svc.save(v, {"w": np.full((2,), v, np.float32)}, False)
+    assert svc.get_latest_checkpoint_version() == 4
+    assert svc.get_checkpoint_path(0) == ""  # evicted
+    assert svc.get_checkpoint_path(2) != ""
+    version, named = svc.get_checkpoint_model(3)
+    assert version == 3
+    np.testing.assert_array_equal(named["w"], 3.0)
+
+
+def test_checkpoint_file_roundtrip(tmp_path):
+    path = str(tmp_path / "m.chkpt")
+    arrays = {
+        "a": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": np.arange(4, dtype=np.int64),
+    }
+    save_checkpoint_to_file(arrays, 17, path)
+    version, named = load_from_checkpoint_file(path)
+    assert version == 17
+    for k in arrays:
+        np.testing.assert_array_equal(named[k], arrays[k])
+
+
+def test_init_from_checkpoint(tmp_path):
+    path = str(tmp_path / "m.chkpt")
+    save_checkpoint_to_file({"w": np.full((2,), 7, np.float32)}, 11, path)
+    m = MasterServicer(
+        1,
+        8,
+        optax.sgd(0.1),
+        _dispatcher(),
+        checkpoint_filename_for_init=path,
+    )
+    assert m.get_model_version() == 11
+    _, named = m.get_model(11)
+    np.testing.assert_array_equal(named["w"], 7.0)
+
+
+# -- evaluation service (reference evaluation_service_test.py) --------------
+
+
+def test_evaluation_job_single_and_multi_output():
+    job = _EvaluationJob(
+        {"accuracy": lambda labels, p: labels.reshape(-1) == p.argmax(1)},
+        model_version=3,
+        total_tasks=2,
+    )
+    outputs = {"output": np.eye(4, dtype=np.float32)}
+    labels = np.arange(4)
+    assert job.report_evaluation_metrics(3, outputs, labels)
+    assert not job.report_evaluation_metrics(2, outputs, labels)
+    job.complete_task()
+    assert not job.finished()
+    job.complete_task()
+    assert job.finished()
+    assert job.get_evaluation_summary()["accuracy"] == 1.0
+
+
+def test_eval_service_pins_checkpoint_version(tmp_path):
+    task_d = TaskDispatcher({"f": (0, 8)}, {"f": (0, 8)}, {}, 8, 1)
+    ckpt = CheckpointService(str(tmp_path), 0, 0, True)
+    svc = EvaluationService(
+        ckpt,
+        None,
+        task_d,
+        0,
+        0,
+        1,
+        False,
+        lambda: {"acc": lambda labels, p: labels == labels},
+    )
+    task_d.set_evaluation_service(svc)
+    m = MasterServicer(
+        1,
+        8,
+        optax.sgd(0.1),
+        task_d,
+        checkpoint_service=ckpt,
+        evaluation_service=svc,
+        use_async=True,
+    )
+    m.report_variable({"w": np.zeros((2,), np.float32)})
+    m.report_gradient([Tensor("w", np.ones((2,), np.float32))], 0)
+    # version 1 was checkpointed for the eval round
+    tid, task = task_d.get_eval_task(1)
+    assert task.model_version == 1
+    version, named = m.get_model(1, GetModelMethod.FIXED)
+    assert version == 1 and "w" in named
+
+
+# -- staleness-aware LR (reference staleness_aware_test.py) -----------------
+
+
+def test_lr_modulation_scales_updates():
+    opt, modulator = add_lr_modulation_to_optimizer(optax.sgd(1.0))
+    params = {"w": np.ones((2,), np.float32)}
+    state = opt.init(params)
+    grads = {"w": np.ones((2,), np.float32)}
+
+    modulator.set_multiplier(0.25)
+    updates, state = opt.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.25)
+
+    modulator.set_multiplier(1.0)
+    updates, _ = opt.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -1.0)
+
+
+def test_staleness_modulation_in_async_servicer():
+    m = MasterServicer(
+        1,
+        8,
+        optax.sgd(1.0),
+        _dispatcher(),
+        use_async=True,
+        lr_staleness_modulation=True,
+    )
+    m.report_variable({"w": np.zeros((2,), np.float32)})
+    m.report_gradient([Tensor("w", np.ones((2,), np.float32))], 0)  # v0->1
+    m.report_gradient([Tensor("w", np.ones((2,), np.float32))], 1)  # fresh
+    _, named = m.get_model(2)
+    np.testing.assert_allclose(named["w"], -2.0)
+    # stale by 2: multiplier 1/2
+    m.report_gradient([Tensor("w", np.ones((2,), np.float32))], 0)
+    _, named = m.get_model(3)
+    np.testing.assert_allclose(named["w"], -2.5)
+
+
+# -- misc utils -------------------------------------------------------------
+
+
+def test_params_str_and_module_path():
+    assert get_dict_from_params_str("a=1,b='x',c=2.5") == {
+        "a": 1,
+        "b": "x",
+        "c": 2.5,
+    }
+    assert get_dict_from_params_str("") is None
+    assert get_module_file_path("/zoo", "pkg.mod.custom_model") == (
+        "/zoo/pkg/mod.py"
+    )
