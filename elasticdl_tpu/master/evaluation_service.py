@@ -171,23 +171,41 @@ class EvaluationService:
         self._eval_job = _EvaluationJob(self._eval_metrics_fn(), -1, num_task)
 
     def add_evaluation_task(self, is_time_based_eval, master_locking=True):
-        """Checkpoint the current model and queue an eval round on it."""
+        """Checkpoint the current model and queue an eval round on it.
+
+        The version guard, the eval-checkpoint write, and the guard update
+        all run under the master servicer's model lock so the time-based
+        trigger thread and the step-based path (gradient threads, which
+        already hold that lock and pass master_locking=False) can't both
+        pass the guard for the same version and queue duplicate rounds.
+        Reusing the servicer's lock — rather than a second lock — keeps a
+        single lock order between the two services.
+        """
         if is_time_based_eval and self._task_d.finished():
             return
+        if master_locking:
+            with self._master_servicer.lock:
+                queued = self._checkpoint_for_eval_locked()
+        else:
+            queued = self._checkpoint_for_eval_locked()
+        if queued:
+            self.try_to_create_new_job()
+
+    def _checkpoint_for_eval_locked(self):
+        """Guard + eval-checkpoint; caller holds the master model lock."""
         model_version = self._master_servicer.get_model_version()
         if model_version == self._last_eval_checkpoint_version:
-            return
-
+            return False
         checkpoint_version = self._master_servicer.save_eval_checkpoint(
-            locking=master_locking
+            locking=False
         )
         if checkpoint_version is None:
             # checkpoint write failed; do not queue an eval round on it
-            return
+            return False
         with self._lock:
             self._eval_checkpoint_versions.append(checkpoint_version)
         self._last_eval_checkpoint_version = checkpoint_version
-        self.try_to_create_new_job()
+        return True
 
     def try_to_create_new_job(self):
         """Start the next queued eval round if none is running."""

@@ -443,21 +443,25 @@ class MasterServicer:
                 )
             self._embedding_gradient_applier(edl_embedding_gradients)
 
-        if (grads or indexed_grads) and self._opt is not None:
-            self._ensure_opt_state()
-            dense = self._densify(grads, indexed_grads)
-            updates, self._opt_state = self._opt.update(
-                dense, self._opt_state, self._model
-            )
-            new_params = optax.apply_updates(self._model, updates)
-            self._model = {
-                k: np.asarray(v, dtype=np.float32)
-                for k, v in new_params.items()
-            }
-
+        # In async mode report_gradient does not hold the lock, so the
+        # read-modify-replace of (model, opt_state) below must be serialized
+        # here or concurrent workers silently drop each other's whole update
+        # (the embedding applier above is already serialized internally).
         if self._use_async:
             self._lock.acquire()
         try:
+            if (grads or indexed_grads) and self._opt is not None:
+                self._ensure_opt_state()
+                dense = self._densify(grads, indexed_grads)
+                updates, self._opt_state = self._opt.update(
+                    dense, self._opt_state, self._model
+                )
+                new_params = optax.apply_updates(self._model, updates)
+                self._model = {
+                    k: np.asarray(v, dtype=np.float32)
+                    for k, v in new_params.items()
+                }
+
             self._version += 1
             self._update_evaluation()
             self._update_checkpoint()
@@ -471,6 +475,13 @@ class MasterServicer:
             self._grad_n = 0
 
     # -- version/checkpoint helpers ----------------------------------------
+
+    @property
+    def lock(self):
+        """Model/version lock. The evaluation service serializes its
+        trigger guard under it so gradient threads (which hold it) and the
+        time-based trigger thread share one lock order."""
+        return self._lock
 
     def get_model_version(self):
         return self._version

@@ -83,14 +83,17 @@ void* edlr_open(const char* path) {
     ::close(fd);
     return nullptr;
   }
+  // Bounds checks in subtraction form: the additive forms
+  // (index_offset + 8, index_offset + 8 + count * 8) wrap around on
+  // file-controlled u64 values and would pass on a crafted file.
   uint64_t index_offset = read_u64(base + size - kTailSize);
-  if (index_offset + 8 > size - kTailSize) {
+  if (index_offset > size - kTailSize - 8) {
     munmap(mapped, size);
     ::close(fd);
     return nullptr;
   }
   uint64_t count = read_u64(base + index_offset);
-  if (index_offset + 8 + count * 8 > size - kTailSize) {
+  if (count > (size - kTailSize - index_offset - 8) / 8) {
     munmap(mapped, size);
     ::close(fd);
     return nullptr;
@@ -116,9 +119,11 @@ int edlr_read(void* handle, int64_t index, const uint8_t** data,
   Reader* r = static_cast<Reader*>(handle);
   if (!r || index < 0 || static_cast<uint64_t>(index) >= r->count) return -1;
   uint64_t off = r->offsets[index];
-  if (off + kRecHeaderSize > r->size) return -2;
+  // Subtraction form: off / payload_len come from the file and the
+  // additive checks wrap on crafted u64/u32 values.
+  if (off > r->size - kRecHeaderSize) return -2;
   uint32_t payload_len = read_u32(r->base + off);
-  if (off + kRecHeaderSize + payload_len > r->size) return -3;
+  if (payload_len > r->size - off - kRecHeaderSize) return -3;
   *data = r->base + off + kRecHeaderSize;
   *len = payload_len;
   return 0;

@@ -48,13 +48,19 @@ def _block_attend(q, k, v, bias=None):
     row_sum) with out_unnorm = exp(s - row_max) @ v.
     """
     scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # online-softmax statistics must form in f32 even for bf16 q/k/v —
+    # bf16 s/m/p/l loses precision the f32 accumulators can't recover
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if bias is not None:
-        s = s + bias
+        s = s + bias.astype(jnp.float32)
     m = jnp.max(s, axis=-1)  # (B, H, Lq)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)  # (B, H, Lq)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    )
     return o, m, l
 
 

@@ -237,7 +237,14 @@ class AllReduceWorker:
                 except Exception as e:  # report, don't die: task requeues
                     err_msg = str(e)
                     logger.exception("train step failed")
-                    count = self._task_data_service.get_current_task().end
+                    # drain exactly the head task so it fail-reports and
+                    # requeues now; when no task is pending (failure after
+                    # the task drained) charge the batch size instead of
+                    # masking the real error with an AttributeError
+                    count = (
+                        self._task_data_service.remaining_records_in_head_task()
+                        or len(dataset_batch[1])
+                    )
                 self._task_data_service.report_record_done(count, err_msg)
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                 self._evaluate_only()

@@ -44,6 +44,21 @@ class TaskDataService:
     def get_current_task(self):
         return self._current_task
 
+    def remaining_records_in_head_task(self):
+        """Records still unreported in the head pending task (0 if none).
+
+        report_record_done counts *relative* to the head task's size, so a
+        failed train step charges exactly this to drain + fail-report the
+        task it was working on, without over-draining later pending tasks.
+        """
+        with self._lock:
+            if not self._pending_tasks:
+                return 0
+            head = self._pending_tasks[0]
+            return max(
+                0, (head.end - head.start) - self._reported_record_count
+            )
+
     def _do_report_task(self, task, err_msg=""):
         if self._failed_record_count != 0:
             exec_counters = {

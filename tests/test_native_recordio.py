@@ -76,3 +76,52 @@ def test_factory_prefers_native(tmp_path):
     reader = open_recordio(path)
     assert isinstance(reader, NativeRecordIOReader)
     reader.close()
+
+
+def _patch(path, offset, value_u64):
+    import struct
+
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(struct.pack("<Q", value_u64))
+
+
+def test_native_rejects_wrapping_index_offset(tmp_path):
+    # index_offset chosen so index_offset + 8 wraps past 2**64 and the
+    # additive bounds check would accept it
+    path = _write(tmp_path, [b"abc"])
+    size = os.path.getsize(path)
+    _patch(path, size - 12, 2**64 - 4)
+    with pytest.raises(ValueError):
+        NativeRecordIOReader(path)
+
+
+def test_native_rejects_wrapping_record_count(tmp_path):
+    # count * 8 == 0 mod 2**64: additive check would pass, reader would
+    # then index 2**61 "records" off the end of the mapping
+    path = _write(tmp_path, [b"abc"])
+    size = os.path.getsize(path)
+    import struct
+
+    with open(path, "rb") as f:
+        f.seek(size - 12)
+        index_offset = struct.unpack("<Q", f.read(8))[0]
+    _patch(path, index_offset, 2**61)
+    with pytest.raises(ValueError):
+        NativeRecordIOReader(path)
+
+
+def test_native_rejects_wrapping_record_offset(tmp_path):
+    # offsets[0] near 2**64: off + header wraps, payload_len check would
+    # read out of the mapping without the subtraction-form bounds
+    path = _write(tmp_path, [b"abc"])
+    size = os.path.getsize(path)
+    import struct
+
+    with open(path, "rb") as f:
+        f.seek(size - 12)
+        index_offset = struct.unpack("<Q", f.read(8))[0]
+    _patch(path, index_offset + 8, 2**64 - 2)
+    with NativeRecordIOReader(path) as r:
+        with pytest.raises(IndexError):
+            r.read(0)
