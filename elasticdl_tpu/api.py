@@ -201,6 +201,9 @@ def _run_local_job(args):
     env["PYTHONPATH"] = (
         os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
     )
+    # local workers all share this host; the allreduce coordinator must
+    # advertise an address the sibling processes can dial
+    env.setdefault("EDL_COMM_HOST", "localhost")
 
     def worker_command(worker_id):
         return [
@@ -231,6 +234,7 @@ def _run_local_job(args):
         worker_command,
         restart_policy=args.restart_policy,
         env=env,
+        membership=master.membership,
     )
     master.instance_manager = manager
     manager.start_workers()

@@ -265,6 +265,14 @@ def parse_master_args(master_args=None):
     parser.add_argument("--port", type=non_neg_int, default=None)
     parser.add_argument("--worker_image", default="")
     parser.add_argument("--prediction_data", default="")
+    parser.add_argument(
+        "--comm_base_port",
+        type=non_neg_int,
+        default=0,
+        help="Allreduce-plane coordinator port base; each membership "
+        "epoch binds base+epoch%%64 on rank 0's host. 0 picks ephemeral "
+        "ports (single-host jobs)",
+    )
     add_common_params(parser)
     add_train_params(parser)
     args, unknown = parser.parse_known_args(args=master_args)
@@ -297,6 +305,13 @@ def parse_worker_args(worker_args=None):
     parser.add_argument("--job_type", required=True)
     parser.add_argument("--master_addr", default="")
     parser.add_argument("--ps_addrs", default="", help="Comma-separated")
+    parser.add_argument(
+        "--comm_host",
+        default="",
+        help="Host other allreduce workers can reach this process at "
+        "(the coordinator address when it is rank 0); defaults to "
+        "$EDL_COMM_HOST or the hostname",
+    )
     parser.add_argument(
         "--prediction_outputs_processor",
         default="PredictionOutputsProcessor",

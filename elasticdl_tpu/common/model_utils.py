@@ -21,10 +21,28 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.tensor import Tensor, deserialize_tensors, serialize_tensors
 
 
+_module_cache = {}  # abspath -> (mtime, module)
+
+
 def load_module(module_file):
+    """Load a zoo module, cached per (path, mtime).
+
+    Several call sites resolve the same module per process (spec
+    resolution, strategy-rewrite hooks); re-executing it would repeat
+    module-level side effects and hand out distinct class identities.
+    """
+    path = os.path.abspath(module_file)
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = None
+    cached = _module_cache.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
     spec = importlib.util.spec_from_file_location(module_file, module_file)
     module = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(module)
+    _module_cache[path] = (mtime, module)
     return module
 
 

@@ -1,24 +1,90 @@
 """Kubernetes instance manager — pod-level elasticity.
 
-Parity: reference master/k8s_instance_manager.py — starts N worker and M
-PS pods, tracks ``{id: (pod_name, phase)}`` maps, and reacts to the pod
-watch stream: a DELETED worker pod re-queues its in-flight tasks
-(``task_d.recover_tasks``) and is relaunched with a fresh monotonically
-increasing id unless it Succeeded; a DELETED PS pod is relaunched with the
-*same* id so its stable Service DNS keeps resolving; the master pod's
-``status`` label mirrors the job status for external pollers.
+Role parity (not a port) with the reference's instance manager
+(reference master/k8s_instance_manager.py): keep N workers and M PS pods
+alive, and turn pod-death events into the elasticity reactions — requeue
+the dead worker's in-flight tasks, bump the allreduce membership epoch,
+and relaunch (workers under fresh monotonically-growing ids, PS under the
+*same* id so its stable Service DNS keeps resolving).
 
-The process-level analog with the same callback contract (usable without
-k8s, and what the elastic tests exercise) is
+Design here: each instance kind is a name-keyed :class:`_Fleet` table,
+and the reaction to an exit is computed by a *pure* decision function
+(:func:`decide_on_exit`) over (kind, phase, policy) — the watch callback
+just parses the event, folds it into the fleet, and applies the returned
+decision. That keeps the whole elasticity brain unit-testable with a fake
+client (tests/test_k8s_instance_manager.py), which the reference only
+managed against a live minikube (its k8s tests are env-gated).
+
+The process-level backend with the same outward contract (usable without
+k8s, exercised by the elastic job tests) is
 master/local_instance_manager.py.
 """
 
 import itertools
 import threading
-from collections import Counter
+from collections import Counter, namedtuple
 
 from elasticdl_tpu.common import k8s_client as k8s
 from elasticdl_tpu.common.log_utils import default_logger as logger
+
+WORKER = "worker"
+PS = "ps"
+
+# what to do after an instance leaves: requeue its tasks? start a
+# replacement (and under which id)?
+ExitDecision = namedtuple("ExitDecision", ["recover", "relaunch", "new_id"])
+
+
+def decide_on_exit(kind, phase, relaunch_enabled, budget_left):
+    """Pure elasticity decision for one instance exit.
+
+    - Workers: tasks always recover (the dispatcher tolerates spurious
+      recovers); a replacement starts under a *fresh* id unless the pod
+      Succeeded, relaunch is disabled, or the relaunch budget is spent.
+    - PS: state lives behind a stable per-id Service DNS, so the
+      replacement must reuse the id; nothing to recover.
+    """
+    if kind == WORKER:
+        relaunch = (
+            relaunch_enabled and budget_left > 0 and phase != "Succeeded"
+        )
+        return ExitDecision(recover=True, relaunch=relaunch, new_id=True)
+    relaunch = relaunch_enabled and budget_left > 0
+    return ExitDecision(recover=False, relaunch=relaunch, new_id=False)
+
+
+class _Fleet:
+    """Live instances of one kind, keyed both ways (pod name <-> id)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._name_to_id = {}
+        self._phases = {}  # id -> (pod_name, phase)
+
+    def track(self, name, instance_id):
+        self._name_to_id[name] = instance_id
+        self._phases[instance_id] = (name, None)
+
+    def observe(self, name, phase):
+        instance_id = self._name_to_id.get(name)
+        if instance_id is not None:
+            self._phases[instance_id] = (name, phase)
+        return instance_id
+
+    def drop(self, name):
+        instance_id = self._name_to_id.pop(name, None)
+        if instance_id is not None:
+            self._phases.pop(instance_id, None)
+        return instance_id
+
+    def knows(self, name):
+        return name in self._name_to_id
+
+    def ids(self):
+        return list(self._phases)
+
+    def phase_counter(self):
+        return Counter(phase for _, phase in self._phases.values())
 
 
 class InstanceManager:
@@ -41,183 +107,182 @@ class InstanceManager:
         image_pull_policy="Always",
         restart_policy="Never",
         envs=None,
+        membership=None,
+        max_relaunches=64,
+        k8s_client=None,
         **kwargs,
     ):
+        self._task_d = task_d
+        self._membership = membership
         self._num_workers = num_workers
-        self._worker_command = worker_command
-        self._worker_args = worker_args or []
-        self._worker_resource_request = worker_resource_request
-        self._worker_resource_limit = worker_resource_limit
-        self._worker_pod_priority = worker_pod_priority
-
         self._num_ps = num_ps
-        self._ps_command = ps_command
-        self._ps_args = ps_args or []
-        self._ps_resource_request = ps_resource_request
-        self._ps_resource_limit = ps_resource_limit
-        self._ps_pod_priority = ps_pod_priority
-
-        self._restart_policy = restart_policy
+        self._launch_spec = {
+            WORKER: dict(
+                command=worker_command,
+                args=worker_args or [],
+                resource_requests=worker_resource_request,
+                resource_limits=worker_resource_limit,
+                pod_priority=worker_pod_priority,
+            ),
+            PS: dict(
+                command=ps_command,
+                args=ps_args or [],
+                resource_requests=ps_resource_request,
+                resource_limits=ps_resource_limit,
+                pod_priority=ps_pod_priority,
+            ),
+        }
         self._volume = volume
         self._image_pull_policy = image_pull_policy
+        self._restart_policy = restart_policy
         self._envs = envs
-        self._task_d = task_d
-        self._next_worker_id = itertools.count().__next__
 
         self._lock = threading.Lock()
-        self._worker_pods_phase = {}
-        self._worker_pod_name_to_id = {}
-        self._relaunch_deleted_live_worker = True
-        self._ps_pods_phase = {}
-        self._ps_pod_name_to_id = {}
-        self._relaunch_deleted_live_ps = True
+        self._fleets = {WORKER: _Fleet(WORKER), PS: _Fleet(PS)}
+        self._relaunch_on = {WORKER: True, PS: True}
+        # per-kind budgets: worker churn must not starve PS relaunches
+        # (a PS that never comes back wedges every worker's pulls)
+        self._relaunch_budget = {WORKER: max_relaunches, PS: max_relaunches}
+        self._fresh_worker_id = itertools.count().__next__
 
-        self._k8s_client = k8s.Client(
-            event_callback=self._event_cb, **kwargs
+        self._client = k8s_client or k8s.Client(
+            event_callback=self.handle_pod_event, **kwargs
         )
-        self._ps_addrs = self._get_ps_addrs()
+        self._ps_addrs = ",".join(
+            self._client.get_ps_service_address(i) for i in range(num_ps)
+        )
+        if membership is not None:
+            # fence a member dropped as unresponsive: delete its pod so
+            # its in-flight tasks recover through the ordinary DELETED
+            # event instead of being held by a wedged process
+            membership.set_fencer(self._client.delete_worker)
 
     # -- launches -----------------------------------------------------------
 
-    def _start_worker(self, worker_id):
-        logger.info("Starting worker: %d" % worker_id)
-        with self._lock:
-            pod = self._k8s_client.create_worker(
-                worker_id=worker_id,
-                resource_requests=self._worker_resource_request,
-                resource_limits=self._worker_resource_limit,
-                pod_priority=self._worker_pod_priority,
-                volume=self._volume,
-                image_pull_policy=self._image_pull_policy,
-                command=self._worker_command,
-                args=self._worker_args
-                + ["--worker_id", str(worker_id)]
-                + ["--ps_addrs", self._ps_addrs],
-                restart_policy=self._restart_policy,
-                envs=self._envs,
-            )
-            name = pod.metadata.name
-            self._worker_pod_name_to_id[name] = worker_id
-            self._worker_pods_phase[worker_id] = (name, None)
-
-    def _start_ps(self, ps_id):
-        logger.info("Starting PS: %d" % ps_id)
-        with self._lock:
-            pod = self._k8s_client.create_ps(
-                ps_id=ps_id,
-                resource_requests=self._ps_resource_request,
-                resource_limits=self._ps_resource_limit,
-                pod_priority=self._ps_pod_priority,
-                volume=self._volume,
-                image_pull_policy=self._image_pull_policy,
-                command=self._ps_command,
-                args=self._ps_args + ["--ps_id", str(ps_id)],
-                restart_policy=self._restart_policy,
-                envs=self._envs,
-            )
-            name = pod.metadata.name
-            self._ps_pod_name_to_id[name] = ps_id
-            self._ps_pods_phase[ps_id] = (name, None)
-            self._k8s_client.create_ps_service(ps_id)
-
-    def _get_ps_addrs(self):
-        return ",".join(
-            self._k8s_client.get_ps_service_address(ps_id)
-            for ps_id in range(self._num_ps)
+    def _launch(self, kind, instance_id):
+        spec = self._launch_spec[kind]
+        common = dict(
+            resource_requests=spec["resource_requests"],
+            resource_limits=spec["resource_limits"],
+            pod_priority=spec["pod_priority"],
+            volume=self._volume,
+            image_pull_policy=self._image_pull_policy,
+            command=spec["command"],
+            restart_policy=self._restart_policy,
+            envs=self._envs,
         )
-
-    def update_status(self, status):
-        """Job status exported as a master pod label (reference :124-128)."""
-        self._k8s_client.patch_labels_to_pod(
-            self._k8s_client.get_master_pod_name(),
-            labels_dict={"status": status},
-        )
+        logger.info("Launching %s %d", kind, instance_id)
+        # hold the lock across create+track: the watch thread serializes
+        # on it, so a pod that dies instantly still finds itself tracked
+        # when its DELETED event arrives
+        with self._lock:
+            if kind == WORKER:
+                pod = self._client.create_worker(
+                    worker_id=instance_id,
+                    args=spec["args"]
+                    + ["--worker_id", str(instance_id)]
+                    + ["--ps_addrs", self._ps_addrs],
+                    **common,
+                )
+            else:
+                pod = self._client.create_ps(
+                    ps_id=instance_id,
+                    args=spec["args"] + ["--ps_id", str(instance_id)],
+                    **common,
+                )
+            self._fleets[kind].track(pod.metadata.name, instance_id)
+        if kind == PS:
+            self._client.create_ps_service(instance_id)
 
     def start_workers(self):
         for _ in range(self._num_workers):
-            self._start_worker(self._next_worker_id())
+            self._launch(WORKER, self._fresh_worker_id())
 
     def start_all_ps(self):
-        for i in range(self._num_ps):
-            self._start_ps(i)
+        for ps_id in range(self._num_ps):
+            self._launch(PS, ps_id)
 
-    # -- teardown -----------------------------------------------------------
+    # -- the elasticity loop ------------------------------------------------
+
+    def handle_pod_event(self, event):
+        """k8s watch callback: fold one pod event into the fleet tables
+        and apply the exit decision when an instance leaves."""
+        obj, evt_type = event.get("object"), event.get("type")
+        if not obj or not evt_type or obj.kind != "Pod":
+            return
+        name, phase = obj.metadata.name, obj.status.phase
+        if name == self._client.get_master_pod_name():
+            return
+
+        with self._lock:
+            kind = next(
+                (k for k, f in self._fleets.items() if f.knows(name)), None
+            )
+            if kind is None:
+                logger.warning("Event for unknown pod %s ignored", name)
+                return
+            fleet = self._fleets[kind]
+            if evt_type != "DELETED":
+                fleet.observe(name, phase)
+                return
+            instance_id = fleet.drop(name)
+            decision = decide_on_exit(
+                kind,
+                phase,
+                self._relaunch_on[kind],
+                self._relaunch_budget[kind],
+            )
+            if decision.relaunch:
+                self._relaunch_budget[kind] -= 1
+        logger.info(
+            "%s %d left (phase %s): recover=%s relaunch=%s",
+            kind,
+            instance_id,
+            phase,
+            decision.recover,
+            decision.relaunch,
+        )
+        if decision.recover:
+            self._task_d.recover_tasks(instance_id)
+            if self._membership is not None:
+                self._membership.remove(instance_id)
+        if decision.relaunch:
+            self._launch(
+                kind,
+                self._fresh_worker_id() if decision.new_id else instance_id,
+            )
+
+    # -- status / teardown --------------------------------------------------
+
+    def update_status(self, status):
+        """Job status exported as a master pod label for external pollers
+        (consumed by scripts/validate_job_status.sh)."""
+        self._client.patch_labels_to_pod(
+            self._client.get_master_pod_name(), labels_dict={"status": status}
+        )
+
+    def get_worker_counter(self):
+        with self._lock:
+            return self._fleets[WORKER].phase_counter()
+
+    def get_ps_counter(self):
+        with self._lock:
+            return self._fleets[PS].phase_counter()
 
     def stop_relaunch_and_remove_workers(self):
         with self._lock:
-            self._relaunch_deleted_live_worker = False
-            for worker_id in self._worker_pods_phase:
-                self._k8s_client.delete_worker(worker_id)
+            self._relaunch_on[WORKER] = False
+            ids = self._fleets[WORKER].ids()
+        for worker_id in ids:
+            self._client.delete_worker(worker_id)
 
     def stop_relaunch_and_remove_all_ps(self):
         with self._lock:
-            self._relaunch_deleted_live_ps = False
-            for ps_id in self._ps_pods_phase:
-                self._k8s_client.delete_ps(ps_id)
+            self._relaunch_on[PS] = False
+            ids = self._fleets[PS].ids()
+        for ps_id in ids:
+            self._client.delete_ps(ps_id)
 
     def stop_relaunch_and_remove_all_pods(self):
         self.stop_relaunch_and_remove_workers()
         self.stop_relaunch_and_remove_all_ps()
-
-    def get_worker_counter(self):
-        with self._lock:
-            return Counter(
-                [v for _, v in self._worker_pods_phase.values()]
-            )
-
-    def get_ps_counter(self):
-        with self._lock:
-            return Counter([v for _, v in self._ps_pods_phase.values()])
-
-    # -- the elasticity loop ------------------------------------------------
-
-    def _event_cb(self, event):
-        evt_obj = event.get("object")
-        evt_type = event.get("type")
-        if not evt_obj or not evt_type:
-            logger.error("Event doesn't have object or type: %s" % event)
-            return
-        if evt_obj.kind != "Pod":
-            return
-        pod_name = evt_obj.metadata.name
-        phase = evt_obj.status.phase
-        logger.info(
-            "Got event %s, phase %s for pod: %s"
-            % (evt_type, phase, pod_name)
-        )
-        if pod_name == self._k8s_client.get_master_pod_name():
-            return
-
-        relaunch_worker = False
-        relaunch_ps = False
-        ps_id = -1
-        with self._lock:
-            if pod_name in self._worker_pod_name_to_id:
-                worker_id = self._worker_pod_name_to_id.get(pod_name)
-                self._worker_pods_phase[worker_id] = (pod_name, phase)
-                if evt_type == "DELETED":
-                    del self._worker_pods_phase[worker_id]
-                    del self._worker_pod_name_to_id[pod_name]
-                    # dead worker's in-flight tasks -> back on todo
-                    self._task_d.recover_tasks(worker_id)
-                    relaunch_worker = (
-                        self._relaunch_deleted_live_worker
-                        and phase != "Succeeded"
-                    )
-            elif pod_name in self._ps_pod_name_to_id:
-                ps_id = self._ps_pod_name_to_id.get(pod_name)
-                self._ps_pods_phase[ps_id] = (pod_name, phase)
-                if evt_type == "DELETED":
-                    del self._ps_pods_phase[ps_id]
-                    del self._ps_pod_name_to_id[pod_name]
-                    relaunch_ps = self._relaunch_deleted_live_ps
-            else:
-                logger.error("Unknown worker pod name: %s" % pod_name)
-                return
-
-        if relaunch_worker:
-            logger.info("Relaunching worker.")
-            self._start_worker(self._next_worker_id())
-        elif relaunch_ps:
-            logger.info("Relaunching ps.")
-            self._start_ps(ps_id)

@@ -28,12 +28,19 @@ class LocalInstanceManager:
         restart_policy="Always",
         max_relaunches=3,
         env=None,
+        membership=None,
     ):
         """``worker_command(worker_id) -> argv``; ``ps_command(ps_id) ->
         argv``. Worker ids grow monotonically across relaunches like the
         reference's next_worker_id counter; PS relaunches keep their id
-        (reference k8s_instance_manager.py:229-231)."""
+        (reference k8s_instance_manager.py:229-231). ``membership`` is the
+        allreduce-plane MembershipService: worker exits additionally
+        trigger a membership epoch so survivors re-form their collective
+        world."""
         self._task_d = task_d
+        self._membership = membership
+        if membership is not None:
+            membership.set_fencer(self.kill_worker)
         self._num_workers = num_workers
         self._worker_command = worker_command
         self._num_ps = num_ps
@@ -90,6 +97,8 @@ class LocalInstanceManager:
             # reference k8s_instance_manager.py:207 — a dead worker's
             # in-flight tasks go back on the todo queue
             self._task_d.recover_tasks(instance_id)
+            if self._membership is not None:
+                self._membership.remove(instance_id)
             if returncode == 0:
                 logger.info("Worker %d completed", instance_id)
                 return

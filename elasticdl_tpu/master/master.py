@@ -131,6 +131,18 @@ class Master:
             ),
             use_async=getattr(args, "use_async", False),
         )
+        # membership epochs for the elastic allreduce plane (the PS plane
+        # needs no inter-worker world)
+        self.membership = None
+        if strategy == DistributionStrategy.ALLREDUCE:
+            from elasticdl_tpu.master.membership_service import (
+                MembershipService,
+            )
+
+            self.membership = MembershipService(
+                expected_workers=max(1, getattr(args, "num_workers", 0)),
+                base_port=getattr(args, "comm_base_port", 0),
+            )
         self._server = None
         self.instance_manager = self._create_instance_manager(args)
         self._stop_requested = threading.Event()
@@ -249,6 +261,7 @@ class Master:
         ] + relay
         return InstanceManager(
             self.task_d,
+            membership=self.membership,
             num_workers=args.num_workers,
             worker_command=["python"],
             worker_args=worker_args,
@@ -280,7 +293,9 @@ class Master:
 
         port = self.args.port if self.args.port is not None else 50001
         self._server = serve(
-            MasterRpcService(self.master_servicer).rpc_methods(),
+            MasterRpcService(
+                self.master_servicer, membership=self.membership
+            ).rpc_methods(),
             port,
         )
         self.port = self._server._edl_port

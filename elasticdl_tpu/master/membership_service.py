@@ -1,0 +1,226 @@
+"""Membership epochs for the elastic allreduce plane.
+
+The reference's elasticity loop is pod-level: the instance manager watches
+pods and, on deletion, re-queues tasks and relaunches
+(reference master/k8s_instance_manager.py:177-231). That suffices for PS
+training because workers never talk to each other. The allreduce plane
+adds a second requirement: every worker holds a slot in one global device
+mesh, so membership changes must be *coordinated* — survivors and joiners
+have to agree on a world (size, ranks, coordinator address) before any
+collective can run.
+
+This service is that agreement point. It lives in the master (the single
+source of truth for task dispatch already) and speaks three verbs:
+
+- ``register(worker_id, host)`` — a worker process announces itself;
+  the world grows at the next epoch bump.
+- ``remove(worker_id)`` — instance-manager death event; the world shrinks.
+- ``get_world(worker_id)`` — poll: returns the current epoch's
+  :class:`~elasticdl_tpu.parallel.distributed.WorldSpec` fields for that
+  worker, or ``ready=False`` while the world is forming.
+
+Epoch rules: the first world forms when ``expected`` workers have
+registered (or ``form_grace_secs`` after the first registration, so a
+crashed launch can't wedge the job). Every later membership change bumps
+the epoch and recomputes the world as the sorted live set. Ranks are
+assigned by ascending worker id; relaunched workers get fresh, higher ids
+(reference next_worker_id semantics), so rank 0 is always the
+longest-lived survivor — the state-broadcast source after a re-form.
+
+Each epoch gets a fresh coordinator port so a stale coordination service
+from the previous world can never be mistaken for the new one.
+"""
+
+import socket
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class MembershipService:
+    def __init__(
+        self,
+        expected_workers,
+        base_port=0,
+        form_grace_secs=30.0,
+        confirm_timeout_secs=15.0,
+    ):
+        """``base_port=0`` picks ephemeral ports (single-host jobs, where
+        the master and rank 0 share the host); on a cluster pass a fixed
+        base and the coordinator binds ``base_port + epoch % 64`` on rank
+        0's pod.
+
+        World formation is **two-phase**: after an epoch bump, ``ready``
+        stays False until every listed member has polled the new epoch
+        from its await loop — only then do members call
+        ``jax.distributed.initialize``, so no one enters the formation
+        barrier while a peer is still finishing the previous epoch. A
+        member that doesn't confirm within ``confirm_timeout_secs`` (it
+        is dead, or wedged in a stale initialize) is dropped from the
+        world and the epoch re-bumps with the responsive members; the
+        laggard re-joins through its next poll. Without this, one stuck
+        member makes the coordination service time out the formation
+        barrier and *fatally terminate* every process that did register.
+        """
+        self._expected = max(1, expected_workers)
+        self._base_port = base_port
+        self._form_grace_secs = form_grace_secs
+        self._confirm_timeout = confirm_timeout_secs
+        self._lock = threading.Lock()
+        self._live = {}  # worker_id -> advertised host
+        self._epoch = 0
+        self._world = []  # [(worker_id, host)] of the current epoch
+        self._coordinator = None
+        self._formed_initial = False
+        self._first_register_time = None
+        self._confirmed = set()  # members that polled the current epoch
+        self._world_ready = False
+        self._bump_time = None
+        self._last_poll = {}  # worker_id -> wall time of last poll
+        self._fencer = None
+
+    def set_fencer(self, fencer):
+        """``fencer(worker_id)`` forcibly terminates a dropped member.
+
+        A member can wedge in a blocking collective (a SIGKILLed peer's
+        sockets don't always reset) — alive as a process, gone from the
+        world. Unfenced it would hold its in-flight tasks forever; the
+        instance manager's kill -> watch -> recover_tasks + relaunch path
+        turns the wedge into an ordinary death.
+        """
+        self._fencer = fencer
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def _bump_locked(self):
+        self._epoch += 1
+        self._world = sorted(self._live.items())
+        self._confirmed = set()
+        self._world_ready = not self._world  # empty world: nothing to form
+        self._bump_time = time.time()
+        if self._world:
+            rank0_host = self._world[0][1]
+            port = (
+                self._base_port + self._epoch % 64
+                if self._base_port
+                else _free_port()
+            )
+            self._coordinator = "%s:%d" % (rank0_host, port)
+        else:
+            self._coordinator = None
+        logger.info(
+            "membership epoch %d: world=%s coordinator=%s",
+            self._epoch,
+            [w for w, _ in self._world],
+            self._coordinator,
+        )
+
+    def register(self, worker_id, host="localhost"):
+        with self._lock:
+            if self._live.get(worker_id) == host:
+                return
+            self._live[worker_id] = host
+            if self._first_register_time is None:
+                self._first_register_time = time.time()
+            if self._formed_initial:
+                # a joiner (relaunch or scale-up): grow the world
+                self._bump_locked()
+            elif len(self._live) >= self._expected:
+                self._formed_initial = True
+                self._bump_locked()
+
+    def remove(self, worker_id):
+        with self._lock:
+            if worker_id not in self._live:
+                return
+            del self._live[worker_id]
+            if self._formed_initial:
+                self._bump_locked()
+
+    def get_world(self, worker_id, host="localhost", awaiting=True):
+        """Poll-and-register in one verb (workers call this in a loop).
+
+        ``awaiting=True`` means the caller is parked in its await loop and
+        will initialize as soon as ``ready`` — such polls confirm the
+        epoch. Mid-training polls (epoch-change checks at batch
+        boundaries) pass False: the worker has seen the bump but still
+        has to leave its current world first.
+        """
+        self.register(worker_id, host)
+        now = time.time()
+        with self._lock:
+            self._last_poll[worker_id] = now
+            if not self._formed_initial:
+                grace_over = (
+                    self._first_register_time is not None
+                    and now - self._first_register_time
+                    > self._form_grace_secs
+                )
+                if grace_over and self._live:
+                    logger.warning(
+                        "forming world with %d/%d workers after grace",
+                        len(self._live),
+                        self._expected,
+                    )
+                    self._formed_initial = True
+                    self._bump_locked()
+                else:
+                    return {"epoch": self._epoch, "ready": False}
+            ids = [w for w, _ in self._world]
+            if worker_id not in ids:
+                # removed as dead but evidently alive: next poll's register
+                # re-adds it (and has already done so above -> bumped)
+                return {"epoch": self._epoch, "ready": False}
+            if not self._world_ready:
+                if awaiting:
+                    self._confirmed.add(worker_id)
+                if set(ids) <= self._confirmed:
+                    self._world_ready = True
+                elif now - self._bump_time > self._confirm_timeout:
+                    # drop members that went quiet (dead or wedged in a
+                    # stale initialize); they re-join via their next poll
+                    lagging = [
+                        w
+                        for w in ids
+                        if w not in self._confirmed
+                        and now - self._last_poll.get(w, 0) > 2.0
+                    ]
+                    if lagging:
+                        logger.warning(
+                            "world %d: dropping unresponsive members %s",
+                            self._epoch,
+                            lagging,
+                        )
+                        for w in lagging:
+                            self._live.pop(w, None)
+                        self._bump_locked()
+                        if self._fencer is not None:
+                            for w in lagging:
+                                try:
+                                    self._fencer(w)
+                                except Exception:
+                                    logger.warning(
+                                        "fencing worker %d failed",
+                                        w,
+                                        exc_info=True,
+                                    )
+                        return {"epoch": self._epoch, "ready": False}
+                    self._bump_time = now  # responsive but slow: wait on
+                if not self._world_ready:
+                    return {"epoch": self._epoch, "ready": False}
+            return {
+                "epoch": self._epoch,
+                "ready": True,
+                "coordinator": self._coordinator,
+                "num_processes": len(ids),
+                "process_id": ids.index(worker_id),
+            }

@@ -17,8 +17,9 @@ from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
 class MasterRpcService:
     """Server side: dict-message handlers around a MasterServicer."""
 
-    def __init__(self, servicer):
+    def __init__(self, servicer, membership=None):
         self._s = servicer
+        self._membership = membership
 
     def get_task(self, req):
         task_type = req.get("task_type")
@@ -91,9 +92,21 @@ class MasterRpcService:
         )
         return {"rows": rows}
 
+    def get_comm_world(self, req):
+        """Membership poll for the elastic allreduce plane (no reference
+        counterpart: the PS plane needs no inter-worker world)."""
+        if self._membership is None:
+            return {"epoch": -1, "ready": False}
+        return self._membership.get_world(
+            req.get("worker_id", -1),
+            req.get("host", "localhost"),
+            awaiting=req.get("awaiting", True),
+        )
+
     def rpc_methods(self):
         return {
             "get_task": self.get_task,
+            "get_comm_world": self.get_comm_world,
             "get_model": self.get_model,
             "report_variable": self.report_variable,
             "report_gradient": self.report_gradient,
@@ -186,6 +199,14 @@ class MasterClient:
             ids=np.asarray(ids, dtype=np.int64),
         )
         return resp["rows"]
+
+    def get_comm_world(self, worker_id, host="localhost", awaiting=True):
+        return self._client.call(
+            "get_comm_world",
+            worker_id=int(worker_id),
+            host=host,
+            awaiting=awaiting,
+        )
 
     def close(self):
         self._client.close()

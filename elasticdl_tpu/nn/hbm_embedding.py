@@ -67,14 +67,112 @@ def sharded_lookup(table, ids, mesh, axis):
     )(table, ids)
 
 
+def all_to_all_lookup(table, ids, mesh, axis, capacity=None):
+    """Row exchange by explicit ``all_to_all`` routing (the BASELINE.json
+    north-star formulation); differentiable.
+
+    Each device buckets its ids by owning shard (range partition:
+    ``owner = id // rows_per_shard``), ships the buckets over the ``axis``
+    ring with ``lax.all_to_all``, gathers locally on the owner, and ships
+    the rows back. On a mesh with a ``data`` axis distinct from the table
+    axis, each dp replica routes only its own id slice, so per-device
+    communication is O(capacity x D) — the rows actually requested —
+    versus the gather+psum form's O(ids x D) zero-padded reduction, and
+    each device's take() only runs over its own requests. On a
+    single-axis mesh (table axis == batch axis) the ids replicate and
+    this form loses its advantage — use the psum form there
+    (``HbmEmbedding(method="auto")`` picks per mesh).
+
+    ``capacity`` bounds the per-peer bucket (static shape). None means the
+    exact worst case (every id owned by one shard) — always correct, the
+    right choice for tests and modest batches. Production lookups on
+    hashed/unique ids set ``capacity ~= 2 x ids/n_shards``; overflowing
+    ids fall back to zero rows (same contract as a dropped row in the
+    reference's best-effort Redis plane) — size capacity generously.
+
+    Backward: the transpose of ``all_to_all`` is ``all_to_all`` and the
+    transpose of the owner-side take is a scatter-add into that shard
+    alone, so the row gradients route straight back to their owners and
+    the dense (V, D) gradient never exists — each device only ever holds
+    its own (V/n, D) gradient shard.
+    """
+    orig_shape = ids.shape
+    flat = jnp.reshape(jnp.asarray(ids).astype(jnp.int32), (-1,))
+    m = flat.shape[0]
+
+    def _lookup(table_local, ids_flat):
+        n = jax.lax.psum(1, axis)
+        me = jax.lax.axis_index(axis)
+        rows_per = table_local.shape[0]
+        mm = ids_flat.shape[0]  # ids local to this batch shard
+        cap = mm if capacity is None else min(capacity, mm)
+
+        owner = jnp.clip(ids_flat // rows_per, 0, n - 1)
+        order = jnp.argsort(owner, stable=True)
+        sorted_owner = owner[order]
+        sorted_ids = ids_flat[order]
+        counts = jnp.bincount(owner, length=n)
+        starts = jnp.cumsum(counts) - counts
+        pos = jnp.arange(mm) - starts[sorted_owner]
+        ok = pos < cap
+        # overflow entries write to a trash column (cap) so they can't
+        # clobber a live slot; the buffer is sliced back to cap below
+        pos = jnp.where(ok, pos, cap)
+
+        # (n, cap) send buffers: row p holds the ids this device asks
+        # peer p for; invalid slots carry id -1
+        send_ids = jnp.full((n, cap + 1), -1, jnp.int32)
+        send_ids = send_ids.at[sorted_owner, pos].set(sorted_ids)[:, :cap]
+        pos = jnp.where(ok, pos, 0)
+        recv_ids = jax.lax.all_to_all(
+            send_ids, axis, split_axis=0, concat_axis=0, tiled=True
+        )  # row p = ids peer p asked me for
+
+        local = recv_ids - me * rows_per
+        valid = (local >= 0) & (local < rows_per)
+        rows = jnp.take(
+            table_local, jnp.clip(local, 0, rows_per - 1), axis=0
+        )
+        rows = jnp.where(valid[..., None], rows, 0)
+        back = jax.lax.all_to_all(
+            rows, axis, split_axis=0, concat_axis=0, tiled=True
+        )  # row p = rows for the ids I sent to peer p
+
+        out_sorted = back[sorted_owner, pos]
+        out_sorted = jnp.where(ok[..., None], out_sorted, 0)
+        inv = jnp.argsort(order, stable=True)
+        return out_sorted[inv]
+
+    axes = set(mesh.axis_names)
+    batch_axis = "data" if ("data" in axes and axis != "data") else None
+    out = shard_map(
+        _lookup,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(batch_axis)),
+        out_specs=P(batch_axis, None),
+        check_rep=False,
+    )(table, flat)
+    return jnp.reshape(out, orig_shape + (table.shape[1],))
+
+
 class HbmEmbedding(nn.Module):
-    """Drop-in embedding whose table shards over ``mesh[axis]`` HBM."""
+    """Drop-in embedding whose table shards over ``mesh[axis]`` HBM.
+
+    ``method``: "auto" (default) picks all_to_all row routing when the
+    mesh gives the batch its own axis (where a2a's O(capacity x D) per
+    device wins — the north-star formulation) and gather+psum on a
+    single-axis mesh (where a2a would replicate the ids and lose);
+    "a2a"/"psum" force a form. ``capacity`` tunes the a2a per-peer
+    bucket (see :func:`all_to_all_lookup`).
+    """
 
     vocab_size: int
     features: int
     mesh: object = None
     axis: str = "data"
     mask_zero: bool = False
+    method: str = "auto"
+    capacity: int = None
 
     @nn.compact
     def __call__(self, ids, training=False):
@@ -92,7 +190,18 @@ class HbmEmbedding(nn.Module):
             table = jax.lax.with_sharding_constraint(
                 table, NamedSharding(self.mesh, P(self.axis, None))
             )
-            emb = sharded_lookup(table, ids, self.mesh, self.axis)
+            method = self.method
+            if method == "auto":
+                has_batch_axis = (
+                    "data" in self.mesh.axis_names and self.axis != "data"
+                )
+                method = "a2a" if has_batch_axis else "psum"
+            if method == "a2a":
+                emb = all_to_all_lookup(
+                    table, ids, self.mesh, self.axis, capacity=self.capacity
+                )
+            else:
+                emb = sharded_lookup(table, ids, self.mesh, self.axis)
         if self.mask_zero:
             emb = emb * (ids != 0).astype(emb.dtype)[..., None]
         return emb

@@ -1,0 +1,320 @@
+"""Multi-process elastic data-parallel training over a global device mesh.
+
+This is the cross-host realization of the ALLREDUCE strategy
+(parallel/trainer.py is the single-process form): every worker process
+holds a slot in one ``jax.sharding.Mesh`` spanning all hosts, parameters
+live replicated in device memory, and the per-step gradient exchange is
+the in-step XLA collective. The reference never built this plane (its
+allreduce.md is a design survey, SURVEY.md §2.2); the gRPC dense-gradient
+round trips it *did* build (GetModel/ReportGradient) are exactly what the
+in-mesh collective replaces.
+
+Three problems unique to the elastic multi-process setting, and their
+solutions here:
+
+- **Lockstep with independent task queues.** Each process pulls its own
+  tasks from the master, so processes run out of data at different
+  times — but every process must participate in every collective. The
+  step is *weighted*: each device contributes its gradient scaled by a
+  0/1 weight, the weighted psum divides by the live count, and the step
+  returns that count. A process with no data feeds its previous batch at
+  weight 0 and keeps stepping until the global count reaches zero — the
+  collective itself is the "anyone still training?" barrier.
+
+- **State continuity across membership epochs.** On a world change the
+  worker pulls its addressable replica to host, re-forms the world
+  (parallel/distributed.py), and re-places state with
+  :func:`broadcast_from_device0`: every process offers its copy, device 0
+  (rank 0 = the longest-lived survivor) wins, XLA broadcasts it. A fresh
+  joiner offers garbage and receives the survivors' state — replacing the
+  reference's workers-re-push-to-PS re-init (ps/servicer.py:70-79).
+
+- **Failure visibility.** A peer death mid-collective surfaces as an
+  error from the jitted step on every survivor. Step inputs are not
+  donated, so the pre-step state is still addressable afterwards; the
+  worker fetches it, waits for the master to bump the epoch, and
+  re-forms. (The single-process trainer donates; here the double
+  buffering is the price of kill-anywhere recovery.)
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.nn.model_api import apply_model, init_variables, split_variables
+from elasticdl_tpu.parallel import distributed
+from elasticdl_tpu.parallel.ring_attention import shard_map
+from elasticdl_tpu.training.step import TrainState
+
+
+def host_copy(tree):
+    """Fetch each leaf's process-addressable replica to host numpy."""
+
+    def fetch(x):
+        if hasattr(x, "addressable_shards"):
+            return np.asarray(x.addressable_shards[0].data)
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(fetch, tree)
+
+
+def broadcast_from_device0(mesh, host_tree):
+    """Place ``host_tree`` replicated on ``mesh``, all processes adopting
+    device 0's copy.
+
+    Each process tiles its own host copy across its local devices into a
+    global (n_devices, ...) array sharded on ``data``; selecting row 0
+    under jit makes XLA broadcast the rank-0 copy to every device. This is
+    both the multi-process placement primitive (plain ``device_put`` can't
+    target non-addressable shardings) and the survivor-state re-broadcast.
+    """
+    n_local = jax.local_device_count()
+    n_dev = mesh.devices.size
+
+    def place(x):
+        x = np.asarray(x)
+        tiled = np.broadcast_to(x[None], (n_local,) + x.shape)
+        spec = P(*(("data",) + (None,) * x.ndim))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), tiled, (n_dev,) + x.shape
+        )
+
+    stacked = jax.tree_util.tree_map(place, host_tree)
+    pick0 = jax.jit(
+        lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    return pick0(stacked)
+
+
+def make_elastic_train_step(module, loss_fn, optimizer, mesh, axis="data"):
+    """Weighted lockstep step: ``(ts, features, labels, weights, rng) ->
+    (ts', loss, n_active)``.
+
+    ``weights`` is a global (n_devices,) 0/1 array — per-device
+    participation. Gradients and batch statistics merge as weighted psums
+    over ``axis`` divided by the live-device count; with zero live devices
+    the state passes through unchanged and ``version`` does not advance,
+    so drain-mode dummy steps are exact no-ops.
+    """
+
+    def per_device(ts, features, labels, weights, rng):
+        w = weights[0].astype(jnp.float32)
+        # decorrelate stochastic layers (dropout) across the batch shards
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+        def loss_of(p):
+            output, new_state = apply_model(
+                module, p, ts.state, features, training=True, rng=rng
+            )
+            return loss_fn(output, labels), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            ts.params
+        )
+        n = jax.lax.psum(w, axis)
+        denom = jnp.maximum(n, 1.0)
+
+        def wavg(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return jax.lax.psum(x * w, axis) / denom
+            return x  # int leaves (counters) advance identically everywhere
+
+        grads = jax.tree_util.tree_map(wavg, grads)
+        loss = wavg(loss)
+        new_state = jax.tree_util.tree_map(wavg, new_state)
+
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        params = optax.apply_updates(ts.params, updates)
+        live = n > 0
+
+        def select(new, old):
+            return jnp.where(live, new, old)
+
+        new_ts = TrainState(
+            params=jax.tree_util.tree_map(select, params, ts.params),
+            state=jax.tree_util.tree_map(select, new_state, ts.state),
+            opt_state=jax.tree_util.tree_map(select, opt_state, ts.opt_state),
+            version=ts.version + live.astype(jnp.int32),
+        )
+        return new_ts, loss, n
+
+    sharded = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    # no donation: the pre-step state must survive a failed collective so
+    # survivors can re-form from it (see module docstring)
+    return jax.jit(sharded)
+
+
+class ElasticDPTrainer:
+    """Per-process handle on the global elastic DP training plane."""
+
+    def __init__(self, module, loss_fn, optimizer, seed=0):
+        self._module = module
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._seed = seed
+        self._mesh = None
+        self._spec = None
+        self._ts = None
+        self._host_ts = None  # latest host snapshot (re-form source)
+        self._step_fn = None
+        self._host_step = 0
+        self._last_local = None  # (features, labels) for weight-0 steps
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def version(self):
+        return (
+            int(host_copy(self._ts.version)) if self._ts is not None else -1
+        )
+
+    def establish(self, spec, example_batch=None):
+        """Join ``spec``'s world and (re)place train state on its mesh.
+
+        ``example_batch`` is required the first time (state init); on
+        re-forms the previous host snapshot is re-broadcast, with rank 0
+        as the source of truth.
+        """
+        distributed.ensure_world(spec)
+        self._spec = spec
+        self._mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        if self._host_ts is None:
+            if example_batch is None:
+                raise ValueError("first establish() needs an example batch")
+            features = example_batch[0]
+            host_one = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:1], features
+            )
+            variables = init_variables(
+                self._module, jax.random.PRNGKey(self._seed), host_one
+            )
+            params, state = split_variables(variables)
+            ts = TrainState.create(params, state, self._optimizer)
+            self._host_ts = host_copy(ts)
+        self._ts = broadcast_from_device0(self._mesh, self._host_ts)
+        self._step_fn = make_elastic_train_step(
+            self._module, self._loss_fn, self._optimizer, self._mesh
+        )
+        logger.info(
+            "elastic plane established: epoch=%d rank=%d/%d devices=%d",
+            spec.epoch,
+            spec.process_id,
+            spec.num_processes,
+            self._mesh.devices.size,
+        )
+
+    def _place_batch(self, tree):
+        n_proc = self._spec.num_processes
+
+        def place(x):
+            x = np.asarray(x)
+            global_shape = (x.shape[0] * n_proc,) + x.shape[1:]
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self._mesh, P("data")), x, global_shape
+            )
+
+        return jax.tree_util.tree_map(place, tree)
+
+    def _pad_local(self, tree, rows):
+        def pad(x):
+            x = np.asarray(x)
+            short = rows - x.shape[0]
+            if short <= 0:
+                return x[:rows]
+            return np.concatenate([x, np.repeat(x[-1:], short, axis=0)])
+
+        return jax.tree_util.tree_map(pad, tree)
+
+    def local_rows(self, minibatch_size):
+        """Fixed per-process rows: minibatch padded to the local devices."""
+        n_local = jax.local_device_count()
+        return -(-minibatch_size // n_local) * n_local
+
+    def train_step(self, features, labels, minibatch_size):
+        """One weighted lockstep step; ``features=None`` participates at
+        weight 0 (drain mode). Returns (loss, n_active_devices, count)
+        where count is this process's true (unpadded) contribution."""
+        rows = self.local_rows(minibatch_size)
+        has_data = features is not None
+        if has_data:
+            leaf = jax.tree_util.tree_leaves(features)[0]
+            count = int(np.asarray(leaf).shape[0])
+            local = (
+                self._pad_local(features, rows),
+                self._pad_local(labels, rows),
+            )
+            self._last_local = local
+        else:
+            count = 0
+            if self._last_local is None:
+                raise RuntimeError(
+                    "cannot run a weight-0 step before the first data step"
+                )
+            local = self._last_local
+        n_local = jax.local_device_count()
+        w_local = np.full(
+            (n_local,), 1.0 if has_data else 0.0, dtype=np.float32
+        )
+        g_features = self._place_batch(local[0])
+        g_labels = self._place_batch(local[1])
+        g_weights = jax.make_array_from_process_local_data(
+            NamedSharding(self._mesh, P("data")),
+            w_local,
+            (self._mesh.devices.size,),
+        )
+        self._host_step += 1
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed), self._host_step
+        )
+        with self._mesh:
+            new_ts, loss, n = self._step_fn(
+                self._ts, g_features, g_labels, g_weights, rng
+            )
+        # commit only after the fetch proves the collectives completed:
+        # on a failed step self._ts keeps the valid pre-step state, which
+        # is exactly what the re-form snapshot needs
+        loss_v = float(host_copy(loss))
+        n_v = int(host_copy(n))
+        self._ts = new_ts
+        return loss_v, n_v, count
+
+    def snapshot(self):
+        """Pull current state to host (the re-form / checkpoint source)."""
+        if self._ts is not None:
+            self._host_ts = host_copy(self._ts)
+        return self._host_ts
+
+    def host_params(self):
+        return self.snapshot().params
+
+    def load_host_state(self, host_ts):
+        """Adopt a checkpointed host TrainState before establish()."""
+        self._host_ts = host_ts
+
+    def leave(self):
+        """Snapshot and leave the world (graceful epoch boundary)."""
+        try:
+            self.snapshot()
+        except Exception:
+            logger.warning(
+                "state snapshot failed; re-form will use the previous one",
+                exc_info=True,
+            )
+        distributed.leave_world()
+        self._ts = None
+        self._mesh = None
+        self._step_fn = None

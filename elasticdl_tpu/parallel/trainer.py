@@ -16,12 +16,14 @@ tasks were recovered" plus a barrier.
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.nn.model_api import init_variables, split_variables
 from elasticdl_tpu.parallel.mesh import (
     create_mesh,
     replicate,
+    replicated,
     shard_batch,
 )
 from elasticdl_tpu.training.step import TrainState, make_train_step
@@ -36,14 +38,22 @@ class AllReduceTrainer:
         devices=None,
         batch_axis="data",
         seed=0,
+        mesh=None,
+        param_specs=None,
     ):
+        """``param_specs``: optional nested dict mirroring (a prefix of)
+        the params tree whose leaves are PartitionSpecs — parameters it
+        names shard over the mesh instead of replicating (HBM embedding
+        tables); their optimizer slots co-shard by shape."""
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._batch_axis = batch_axis
         self._seed = seed
+        self._param_specs = param_specs
+        self._sharded_shapes = {}
         self._step_fn = make_train_step(module, loss_fn, optimizer)
-        self._mesh = create_mesh(devices=devices)
+        self._mesh = mesh if mesh is not None else create_mesh(devices=devices)
         self._ts = None
         self._host_step = 0
 
@@ -63,8 +73,44 @@ class AllReduceTrainer:
     def version(self):
         return int(self._ts.version) if self._ts is not None else -1
 
+    def _collect_sharded_shapes(self, params):
+        """Map leaf shapes named by param_specs to their NamedShardings.
+
+        Shape-keyed matching lets the same map place optimizer slots
+        (param-shaped moment trees) without spec plumbing; vocab-sized
+        tables don't collide with dense-layer shapes in practice.
+        """
+        shapes = {}
+        if not self._param_specs:
+            return shapes
+
+        def walk(spec_tree, param_tree):
+            if hasattr(spec_tree, "items"):
+                for k, sub in spec_tree.items():
+                    if param_tree is not None and k in param_tree:
+                        walk(sub, param_tree[k])
+            else:
+                for leaf in jax.tree_util.tree_leaves(param_tree):
+                    shapes[np.shape(leaf)] = NamedSharding(
+                        self._mesh, spec_tree
+                    )
+
+        walk(self._param_specs, params)
+        return shapes
+
+    def _place(self, tree):
+        """Place a host pytree: spec-named shapes shard, the rest
+        replicates."""
+        rep = replicated(self._mesh)
+
+        def put(x):
+            sharding = self._sharded_shapes.get(np.shape(x), rep)
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(put, tree)
+
     def init_from_batch(self, global_batch):
-        """Create + replicate train state from one example batch."""
+        """Create + place train state from one example batch."""
         features = (
             global_batch[0]
             if isinstance(global_batch, tuple)
@@ -78,12 +124,14 @@ class AllReduceTrainer:
         )
         params, state = split_variables(variables)
         ts = TrainState.create(params, state, self._optimizer)
-        self._ts = replicate(self._mesh, ts)
+        self._sharded_shapes = self._collect_sharded_shapes(params)
+        self._ts = self._place(ts)
         return self._ts
 
     def load_state(self, ts):
         """Adopt an existing host/device train state (checkpoint restore)."""
-        self._ts = replicate(self._mesh, ts)
+        self._sharded_shapes = self._collect_sharded_shapes(ts.params)
+        self._ts = self._place(ts)
 
     def train_step(self, features, labels):
         """One global step. Batch leading dim must divide the data axis."""
@@ -117,7 +165,10 @@ class AllReduceTrainer:
             self.num_devices,
         )
         if host_ts is not None:
-            self._ts = replicate(self._mesh, host_ts)
+            self._sharded_shapes = self._collect_sharded_shapes(
+                host_ts.params
+            )
+            self._ts = self._place(host_ts)
 
     def get_host_state(self):
         """Pull the train state to host memory (for checkpointing)."""

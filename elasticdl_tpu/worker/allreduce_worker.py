@@ -73,12 +73,36 @@ class AllReduceWorker:
             eval_metrics_fn=eval_metrics_fn,
         )
         self._dataset_fn = spec.dataset_fn
+        # strategy-aware model rewriting (the ModelHandler concept,
+        # reference model_handler.py:94-106): a zoo module that defines
+        # ``build_distributed_model(mesh)`` gets its HBM-sharded variant
+        # here — embedding tables row-shard over device memory and update
+        # inside the jitted step instead of living in a host PS store
+        from elasticdl_tpu.common.model_utils import (
+            get_dict_from_params_str,
+            get_module_file_path,
+            load_module,
+        )
+        from elasticdl_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh(devices=devices)
+        module = load_module(
+            get_module_file_path(model_zoo, model_def)
+        ).__dict__
+        model = spec.model
+        param_specs = None
+        if "build_distributed_model" in module:
+            model = module["build_distributed_model"](
+                mesh=mesh, **(get_dict_from_params_str(model_params) or {})
+            )
+            if "param_shardings" in module:
+                param_specs = module["param_shardings"](mesh)
         self.trainer = AllReduceTrainer(
-            spec.model, spec.loss, spec.optimizer(), devices=devices,
-            seed=seed,
+            model, spec.loss, spec.optimizer(), mesh=mesh,
+            param_specs=param_specs, seed=seed,
         )
         self._forward_fn = None
-        self._model = spec.model
+        self._model = model
         self._evaluation_result = {}
         self._task_data_service = TaskDataService(
             self,

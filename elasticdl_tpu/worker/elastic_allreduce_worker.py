@@ -1,0 +1,418 @@
+"""Elastic multi-process ALLREDUCE worker: one process per TPU host.
+
+The reference's north-star behavior — a job that survives killing half its
+workers (BASELINE.md config 3) — exists there only for the PS plane, where
+workers never talk to each other. This worker realizes it for the
+collective plane: each process pulls tasks from the master exactly like a
+PS worker (same dispatcher, same recover_tasks elasticity), but trains via
+the global-mesh weighted lockstep step (parallel/elastic.py), and on any
+membership change re-forms the ``jax.distributed`` world under the
+master's MembershipService epochs.
+
+Run loop shape:
+
+    prime (first local batch in hand)           # join only once shapes known
+    loop:
+        await world (master membership RPC)
+        establish (join + broadcast state from rank 0)
+        step until: out-of-data-globally | epoch bump | collective failure
+    final SAVE_MODEL if assigned
+
+Epoch bumps are observed at batch boundaries (a cheap get_comm_world call
+per step — the PS worker pays a get_model RPC per step for the same
+cadence, reference worker.py:630-637). A peer death mid-collective instead
+surfaces as a step error; the pre-step state is still addressable
+(elastic step does not donate), so the worker snapshots, waits for the
+master to notice the death and bump the epoch, and re-forms. Evaluation
+tasks run between steps on host-fetched params over local devices only —
+never on the global mesh — so slow eval can't wedge the collective plane.
+"""
+
+import os
+import socket
+import time
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import (
+    JobType,
+    MetricsDictKey,
+    Mode,
+    SaveModelConfig,
+)
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.model_utils import (
+    get_model_spec,
+    save_checkpoint_to_file,
+)
+from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+from elasticdl_tpu.parallel.distributed import WorldSpec, WorldBroken
+from elasticdl_tpu.parallel.elastic import ElasticDPTrainer
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+
+class ElasticAllReduceWorker:
+    def __init__(
+        self,
+        worker_id,
+        job_type,
+        minibatch_size,
+        model_zoo,
+        model_def,
+        model_params=None,
+        dataset_fn="dataset_fn",
+        loss="loss",
+        optimizer="optimizer",
+        eval_metrics_fn="eval_metrics_fn",
+        stub=None,
+        data_reader_params=None,
+        seed=0,
+        comm_host=None,
+        epoch_poll_secs=10.0,
+    ):
+        self._worker_id = worker_id
+        self._job_type = job_type
+        self._minibatch_size = minibatch_size
+        self._stub = stub
+        self._host = comm_host or os.environ.get("EDL_COMM_HOST", "")
+        if not self._host:
+            # advertise an address peers can dial: on k8s the bare pod
+            # hostname is not resolvable from sibling pods, but the pod IP
+            # (what the hostname resolves to locally) is routable
+            hostname = socket.gethostname()
+            try:
+                self._host = socket.gethostbyname(hostname)
+            except OSError:
+                self._host = hostname
+        self._epoch_poll_secs = epoch_poll_secs
+        spec = get_model_spec(
+            model_zoo=model_zoo,
+            model_def=model_def,
+            model_params=model_params,
+            dataset_fn=dataset_fn,
+            loss=loss,
+            optimizer=optimizer,
+            eval_metrics_fn=eval_metrics_fn,
+        )
+        self._dataset_fn = spec.dataset_fn
+        self._model = spec.model
+        self._eval_metrics_fn = spec.eval_metrics_fn
+        from elasticdl_tpu.common.model_utils import (
+            get_module_file_path,
+            load_module,
+        )
+
+        zoo_module = load_module(
+            get_module_file_path(model_zoo, model_def)
+        ).__dict__
+        if "build_distributed_model" in zoo_module:
+            # HBM-sharded tables need sharded snapshot/broadcast across
+            # membership epochs (the sharded-checkpoint plane); the
+            # replicated-state re-form implemented here would silently
+            # corrupt them. The single-process ALLREDUCE path
+            # (api local mode / AllReduceWorker) runs these models today.
+            raise NotImplementedError(
+                "model %s defines build_distributed_model (HBM-sharded "
+                "parameters); the multi-process elastic plane does not "
+                "support sharded parameters yet — run it under the "
+                "single-process ALLREDUCE strategy" % model_def
+            )
+        self.trainer = ElasticDPTrainer(
+            spec.model, spec.loss, spec.optimizer(), seed=seed
+        )
+        self._task_data_service = TaskDataService(
+            self,
+            self._job_type == JobType.TRAINING_WITH_EVALUATION,
+            data_reader_params=data_reader_params,
+        )
+        self._batch_gen = None
+        self._retry_batch = None
+        self._drained = False
+        self._forward_fn = None
+        self._eval_params_version = None
+        self._eval_params = None
+
+    # master surface used by TaskDataService
+    def get_task(self, task_type=None):
+        return self._stub.get_task(self._worker_id, task_type)
+
+    def report_task_result(self, task_id, err_msg="", exec_counters=None):
+        return self._stub.report_task_result(task_id, err_msg, exec_counters)
+
+    # -- data ---------------------------------------------------------------
+
+    def _batches(self):
+        """Continuous (features, labels) stream over all task rounds.
+
+        Yields None on a WAIT round (no data *now*, job not finished) so
+        the caller can keep the collective plane ticking; StopIteration
+        means the master has no more training work for this process.
+        """
+        while True:
+            dataset = self._task_data_service.get_dataset()
+            if not dataset:
+                return
+            dataset = self._dataset_fn(
+                dataset,
+                Mode.TRAINING,
+                self._task_data_service.data_reader.metadata,
+            )
+            dataset = dataset.batch(self._minibatch_size).prefetch(1)
+            got = False
+            for batch in dataset:
+                got = True
+                yield batch
+            self._process_save_model_task_if_needed()
+            if not got:
+                yield None
+
+    def _next_batch(self):
+        if self._retry_batch is not None:
+            batch, self._retry_batch = self._retry_batch, None
+            return batch
+        if self._drained:
+            return None
+        try:
+            batch = next(self._batch_gen)
+        except StopIteration:
+            self._drained = True
+            return None
+        return batch
+
+    # -- membership ---------------------------------------------------------
+
+    def _await_world(self):
+        """Poll the master until a world including us is ready.
+
+        Returns a WorldSpec, or None if the job finished while waiting
+        (every process drained and the master stopped handing out work).
+        """
+        while True:
+            w = self._stub.get_comm_world(
+                self._worker_id, self._host, awaiting=True
+            )
+            if w.get("ready"):
+                return WorldSpec(
+                    coordinator=w["coordinator"],
+                    num_processes=w["num_processes"],
+                    process_id=w["process_id"],
+                    epoch=w["epoch"],
+                )
+            if self._drained and self._retry_batch is None:
+                return None
+            time.sleep(0.2)
+
+    def _await_epoch_bump(self, stale_epoch):
+        """After a collective failure: wait for the master to re-form.
+
+        Returns True once the epoch bumps; False if it never does within
+        the poll window (the failure wasn't a membership event and should
+        propagate as a real bug, not be retried forever).
+        """
+        deadline = time.time() + self._epoch_poll_secs
+        while time.time() < deadline:
+            w = self._stub.get_comm_world(
+                self._worker_id, self._host, awaiting=False
+            )
+            if w["epoch"] != stale_epoch:
+                return True
+            time.sleep(0.3)
+        return False
+
+    # -- the run loop --------------------------------------------------------
+
+    def run(self):
+        losses = []
+        self._batch_gen = self._batches()
+        first = self._prime()
+        if first is None:
+            # no training data ever assigned; still serve eval/save tasks
+            self._finalize()
+            return losses
+        self._retry_batch = first
+
+        while True:
+            world = self._await_world()
+            if world is None:
+                break
+            try:
+                example = self._retry_batch or self.trainer._last_local
+                self.trainer.establish(world, example_batch=example)
+            except WorldBroken:
+                logger.warning(
+                    "world %d broke during formation; re-polling", world.epoch
+                )
+                continue
+            outcome = self._train_epoch(world, losses)
+            if outcome == "done":
+                break
+        self._finalize()
+        return losses
+
+    def _prime(self):
+        """Block until the first local batch is in hand (its shapes gate
+        world membership — a shapeless process can't hold a mesh slot)."""
+        while True:
+            batch = self._next_batch()
+            if batch is not None:
+                return batch
+            if self._drained:
+                return None
+            time.sleep(0.2)
+
+    def _train_epoch(self, world, losses):
+        while True:
+            if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                self._evaluate_only()
+            w = self._stub.get_comm_world(
+                self._worker_id, self._host, awaiting=False
+            )
+            if w["epoch"] != world.epoch:
+                logger.info(
+                    "epoch bump %d -> %s; pausing at batch boundary",
+                    world.epoch,
+                    w["epoch"],
+                )
+                self.trainer.leave()
+                return "reform"
+            batch = self._next_batch()
+            err_msg = ""
+            try:
+                if batch is None:
+                    loss, n_active, count = self.trainer.train_step(
+                        None, None, self._minibatch_size
+                    )
+                else:
+                    features, labels = batch
+                    loss, n_active, count = self.trainer.train_step(
+                        features, labels, self._minibatch_size
+                    )
+                    losses.append(loss)
+            except Exception:
+                logger.exception("collective step failed")
+                self._retry_batch = batch
+                self.trainer.leave()
+                if not self._await_epoch_bump(world.epoch):
+                    raise
+                return "reform"
+            if batch is not None:
+                self._task_data_service.report_record_done(count, err_msg)
+            if n_active == 0:
+                if self._drained:
+                    return "done"
+                time.sleep(0.2)
+
+    # -- evaluation (local devices only, host-fetched params) ---------------
+
+    def _local_forward(self, features):
+        import jax
+
+        if self._forward_fn is None:
+            from elasticdl_tpu.training.step import make_forward_fn
+
+            self._forward_fn = make_forward_fn(self._model)
+        version = self.trainer.version
+        if self._eval_params_version != version:
+            host_ts = self.trainer.snapshot()
+            if host_ts is None:
+                # never trained (peers drained the queue before this
+                # process got a task): no params to evaluate with
+                raise RuntimeError("no local train state for evaluation")
+            self._eval_params = (host_ts.params, host_ts.state)
+            self._eval_params_version = version
+        params, state = self._eval_params
+        return self._forward_fn(params, state, features)
+
+    def _evaluate_only(self):
+        from elasticdl_tpu.common.constants import TaskType
+
+        if self.trainer.snapshot() is None:
+            # no params to evaluate with (never trained): leave the eval
+            # tasks for peers that have state — grabbing one here would
+            # fail-requeue-regrab in a tight livelock
+            return False
+        executed = False
+        while True:
+            task = self.get_task(TaskType.EVALUATION)
+            if not task.shard_name:
+                break
+            self._process_eval_task(task)
+            executed = True
+        return executed
+
+    def _process_eval_task(self, task):
+        eval_info = self._task_data_service.get_validation_dataset(task)
+        if not eval_info:
+            return
+        dataset, model_version, task_id = eval_info
+        dataset = self._dataset_fn(
+            dataset,
+            Mode.EVALUATION,
+            self._task_data_service.data_reader.metadata,
+        )
+        dataset = dataset.batch(self._minibatch_size)
+        if self.trainer.snapshot() is None:
+            # fail the task so a worker that has trained state redoes it
+            self.report_task_result(
+                task_id, err_msg="no local train state for evaluation"
+            )
+            return
+        out_chunks, label_chunks = {}, []
+        for features, labels in dataset:
+            outputs = self._local_forward(features)
+            if not isinstance(outputs, dict):
+                outputs = {MetricsDictKey.MODEL_OUTPUT: outputs}
+            for k, v in outputs.items():
+                out_chunks.setdefault(k, []).append(np.asarray(v))
+            label_chunks.append(np.asarray(labels))
+        if out_chunks:
+            self._stub.report_evaluation_metrics(
+                model_version,
+                {k: np.concatenate(v) for k, v in out_chunks.items()},
+                np.concatenate(label_chunks),
+            )
+        self.report_task_result(task_id)
+
+    # -- export -------------------------------------------------------------
+
+    def _process_save_model_task_if_needed(self):
+        (
+            task,
+            _dataset,
+        ) = self._task_data_service.get_save_model_task_and_dataset()
+        if task is None:
+            return
+        saved_model_path = task.extended_config.get(
+            SaveModelConfig.SAVED_MODEL_PATH, "/tmp/edl_saved_model"
+        )
+        host_ts = self.trainer.snapshot()
+        if host_ts is None:
+            # never trained (no data ever assigned); let another worker
+            # with state pick the task up
+            self.report_task_result(
+                task.task_id, err_msg="no local train state to export"
+            )
+            return
+        saved_model_path = os.path.join(
+            saved_model_path, str(int(time.time()))
+        )
+        os.makedirs(saved_model_path, exist_ok=True)
+        save_checkpoint_to_file(
+            pytree_to_named_arrays(host_ts.params),
+            max(0, int(np.asarray(host_ts.version))),
+            os.path.join(saved_model_path, "model.chkpt"),
+        )
+        logger.info("Exported model to %s", saved_model_path)
+        self.report_task_result(task_id=task.task_id, err_msg="")
+
+    def _finalize(self):
+        if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+            try:
+                self._evaluate_only()
+            except Exception:
+                logger.warning("final eval round failed", exc_info=True)
+        self._process_save_model_task_if_needed()
+        from elasticdl_tpu.parallel import distributed
+
+        if distributed.current_spec() is not None:
+            distributed.leave_world()

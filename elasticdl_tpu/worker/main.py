@@ -24,9 +24,15 @@ def main():
     from elasticdl_tpu.common.model_utils import get_dict_from_params_str
 
     if args.distribution_strategy == "AllreduceStrategy":
-        from elasticdl_tpu.worker.allreduce_worker import AllReduceWorker
+        # a worker process under a master always runs the elastic
+        # multi-process plane (a world of one process is the degenerate
+        # case); the single-process AllReduceWorker remains the in-process
+        # form used by the local API mode
+        from elasticdl_tpu.worker.elastic_allreduce_worker import (
+            ElasticAllReduceWorker,
+        )
 
-        AllReduceWorker(
+        ElasticAllReduceWorker(
             worker_id=args.worker_id,
             job_type=args.job_type,
             minibatch_size=args.minibatch_size,
@@ -41,6 +47,7 @@ def main():
             data_reader_params=get_dict_from_params_str(
                 args.data_reader_params
             ),
+            comm_host=args.comm_host or None,
         ).run()
         return 0
 

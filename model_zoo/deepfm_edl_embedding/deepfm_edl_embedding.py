@@ -18,21 +18,50 @@ from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.data.example import FixedLenFeature, parse_example
 from elasticdl_tpu.metrics import AUC
 from elasticdl_tpu.nn.embedding import Embedding
+from elasticdl_tpu.nn.hbm_embedding import HbmEmbedding
+
+# frappe CTR vocabulary (reference data/recordio_gen/frappe_recordio_gen)
+VOCAB_SIZE = 5384
 
 
 class DeepFMEdl(nn.Module):
+    """DeepFM whose embedding plane picks its storage by strategy:
+
+    - ``mesh=None`` (PS mode): elastic Embedding — tables in the
+      master/PS host store, rows pulled per batch, sparse grads pushed.
+    - ``mesh`` set (ALLREDUCE mode): HbmEmbedding — tables row-sharded
+      over ``table_axis`` device HBM, all_to_all row routing, updated
+      inside the jitted step (the BASELINE.json north star).
+    """
+
     embedding_dim: int = 64
     input_length: int = 10
     fc_unit: int = 64
+    mesh: object = None
+    vocab_size: int = VOCAB_SIZE
+    table_axis: str = "data"
+    # force the HBM layer even without a mesh (single-device jnp.take —
+    # the dense numerics twin the sharded path is validated against)
+    force_hbm: bool = False
+
+    def _embedding(self, dim, name):
+        if self.mesh is None and not self.force_hbm:
+            return Embedding(output_dim=dim, mask_zero=True, name=name)
+        return HbmEmbedding(
+            vocab_size=self.vocab_size,
+            features=dim,
+            mesh=self.mesh,
+            axis=self.table_axis,
+            mask_zero=True,
+            name=name,
+        )
 
     @nn.compact
     def __call__(self, features, training=False):
         ids = features["feature"].astype(jnp.int32)  # (B, L)
         mask = (ids != 0).astype(jnp.float32)[..., None]
 
-        embeddings = Embedding(
-            output_dim=self.embedding_dim, mask_zero=True, name="embedding"
-        )(ids)
+        embeddings = self._embedding(self.embedding_dim, "embedding")(ids)
         embeddings = embeddings * mask
 
         emb_sum = embeddings.sum(axis=1)
@@ -40,9 +69,7 @@ class DeepFMEdl(nn.Module):
             jnp.square(emb_sum) - jnp.square(embeddings).sum(axis=1)
         ).sum(axis=1)
 
-        id_bias = Embedding(output_dim=1, mask_zero=True, name="id_bias")(
-            ids
-        )
+        id_bias = self._embedding(1, "id_bias")(ids)
         id_bias = id_bias * mask
         first_order = id_bias.sum(axis=(1, 2))
         fm_output = first_order + second_order
@@ -62,6 +89,24 @@ def custom_model(embedding_dim=64, input_length=10, fc_unit=64):
         input_length=input_length,
         fc_unit=fc_unit,
     )
+
+
+def build_distributed_model(mesh, table_axis="data", **params):
+    """ALLREDUCE-strategy hook: tables row-sharded over mesh HBM."""
+    return DeepFMEdl(mesh=mesh, table_axis=table_axis, **params)
+
+
+def param_shardings(mesh, table_axis="data"):
+    """PartitionSpecs for the HBM-resident tables; everything else
+    (dense layers, optimizer moments of dense layers) replicates, and
+    the tables' optimizer state co-shards with them automatically."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(table_axis, None)
+    return {
+        "embedding": {"table": spec},
+        "id_bias": {"table": spec},
+    }
 
 
 def loss(output, labels):

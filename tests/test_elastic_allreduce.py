@@ -1,0 +1,343 @@
+"""Elastic multi-process allreduce: the north-star behavior for the
+collective plane (BASELINE.md config 3).
+
+Rungs here mirror the reference test ladder (SURVEY.md §4.3): unit tests
+for the membership epochs and the weighted lockstep step in one process,
+then real OS-process jobs over gloo CPU collectives — including killing a
+worker mid-job and asserting the job completes with all records
+processed, i.e. ``test_elastic_job.py`` but for ALLREDUCE.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.master.local_instance_manager import LocalInstanceManager
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.master.membership_service import MembershipService
+from tests.test_utils import MODEL_ZOO_PATH, DatasetName, create_recordio_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- rung 1: units -----------------------------------------------------------
+
+
+def _poll_ready(m, worker_id):
+    """Poll until the two-phase formation reports ready (bounded)."""
+    for _ in range(10):
+        w = m.get_world(worker_id)
+        if w["ready"]:
+            return w
+    raise AssertionError("world never became ready for %d" % worker_id)
+
+
+def test_membership_epochs():
+    m = MembershipService(expected_workers=2, form_grace_secs=60)
+    assert m.get_world(0)["ready"] is False  # quorum not met
+    # two-phase: after the quorum registers, ready only once both
+    # members have polled (confirmed) the new epoch
+    m.get_world(1)
+    m.get_world(0)  # both members confirm the freshly-bumped epoch
+    w = _poll_ready(m, 1)
+    assert w["num_processes"] == 2
+    assert _poll_ready(m, 0)["process_id"] == 0
+    assert _poll_ready(m, 1)["process_id"] == 1
+    epoch = w["epoch"]
+
+    # death shrinks the world and bumps the epoch
+    m.remove(0)
+    w1 = _poll_ready(m, 1)
+    assert w1["epoch"] > epoch
+    assert w1["num_processes"] == 1 and w1["process_id"] == 0
+
+    # a relaunch (higher id) grows the world; survivor keeps rank 0
+    m.get_world(2)
+    m.get_world(1)  # survivor confirms the grown world
+    w2 = _poll_ready(m, 2)
+    assert w2["epoch"] > w1["epoch"]
+    assert w2["num_processes"] == 2 and w2["process_id"] == 1
+    assert _poll_ready(m, 1)["process_id"] == 0
+
+    # coordinator address rotates with the epoch
+    assert _poll_ready(m, 1)["coordinator"] != w["coordinator"]
+
+
+def test_membership_unconfirmed_member_dropped_after_timeout():
+    """A member that stops polling (wedged in a stale initialize) must
+    not block formation forever: after the confirm timeout the world
+    re-forms from the responsive members."""
+    m = MembershipService(
+        expected_workers=2, form_grace_secs=60, confirm_timeout_secs=0.3
+    )
+    m.get_world(0)
+    m.get_world(1)  # forms epoch 1, world [0, 1], awaiting confirmation
+    # only worker 1 keeps polling; 0 goes quiet for > 2 s
+    m._last_poll[0] = time.time() - 3.0
+    deadline = time.time() + 5
+    w = m.get_world(1)
+    while not w["ready"]:
+        assert time.time() < deadline
+        time.sleep(0.05)
+        w = m.get_world(1)
+    assert w["num_processes"] == 1 and w["process_id"] == 0
+
+
+def test_membership_grace_forms_partial_world():
+    m = MembershipService(expected_workers=3, form_grace_secs=0.2)
+    assert m.get_world(0)["ready"] is False
+    time.sleep(0.3)
+    w = m.get_world(0)
+    assert w["ready"] and w["num_processes"] == 1
+
+
+def test_weighted_step_matches_plain_and_drain_is_noop():
+    """Single process, 8 virtual devices: all-weights-1 must equal the
+    plain trainer's math (deterministic model — per-shard dropout draws
+    can't be expected to reproduce the global-batch draw), and a weight-0
+    (drain) step must change nothing."""
+    import flax.linen as nn
+    import jax
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from elasticdl_tpu.parallel.elastic import (
+        broadcast_from_device0,
+        host_copy,
+        make_elastic_train_step,
+    )
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+    from elasticdl_tpu.training.step import TrainState
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, inputs, training=False):
+            x = inputs["image"].reshape((inputs["image"].shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(10)(x)
+
+    def loss_fn(output, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            output, labels.reshape(-1)
+        ).mean()
+
+    model = MLP()
+    rng = np.random.default_rng(0)
+    features = {
+        "image": rng.random((16, 28, 28), dtype=np.float32),
+    }
+    labels = rng.integers(0, 10, size=(16, 1)).astype(np.int64)
+
+    variables = init_variables(
+        model, jax.random.PRNGKey(0), {"image": features["image"][:1]}
+    )
+    params, state = split_variables(variables)
+
+    opt = optax.sgd(0.1)
+    ts0 = TrainState.create(params, state, opt)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    ts = broadcast_from_device0(mesh, host_copy(ts0))
+    step = make_elastic_train_step(model, loss_fn, opt, mesh)
+
+    def put(tree, spec):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree
+        )
+
+    g_feat = put(features, P("data"))
+    g_lab = put(labels, P("data"))
+    ones = put(np.ones(8, np.float32), P("data"))
+    zeros = put(np.zeros(8, np.float32), P("data"))
+    key = jax.random.PRNGKey(7)
+
+    with mesh:
+        ts1, loss, n = step(ts, g_feat, g_lab, ones, key)
+    assert int(n) == 8 and np.isfinite(float(loss))
+    assert int(host_copy(ts1.version)) == 1
+
+    # plain reference step on the same host state
+    from elasticdl_tpu.training.step import make_train_step
+
+    plain = make_train_step(model, loss_fn, opt)
+    ts_plain, loss_plain = plain(ts0, features, labels, key)
+    np.testing.assert_allclose(float(loss), float(loss_plain), rtol=1e-5)
+    h1, hp = host_copy(ts1.params), host_copy(ts_plain.params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(h1), jax.tree_util.tree_leaves(hp)
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    # drain step: weight 0 everywhere is an exact no-op
+    with mesh:
+        ts2, _, n0 = step(ts1, g_feat, g_lab, zeros, key)
+    assert int(n0) == 0
+    assert int(host_copy(ts2.version)) == 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(host_copy(ts2.params)),
+        jax.tree_util.tree_leaves(host_copy(ts1.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- rung 2: real OS processes over gloo ------------------------------------
+
+
+def _master_for(data_dir, num_workers, num_epochs=2, extra=()):
+    args = parse_master_args(
+        [
+            "--job_name",
+            "elastic-ar-test",
+            "--model_zoo",
+            MODEL_ZOO_PATH,
+            "--model_def",
+            "mnist_subclass.mnist_subclass.CustomModel",
+            "--minibatch_size",
+            "16",
+            "--num_minibatches_per_task",
+            "4",
+            "--num_epochs",
+            str(num_epochs),
+            "--training_data",
+            data_dir,
+            "--num_workers",
+            str(num_workers),
+            "--num_ps_pods",
+            "0",
+            "--port",
+            "0",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+        ]
+        + list(extra)
+    )
+    master = Master(args)
+    master.prepare()
+    return master
+
+
+def _worker_command_for(master):
+    def worker_command(worker_id):
+        return [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.worker.main",
+            "--worker_id",
+            str(worker_id),
+            "--job_type",
+            "training_only",
+            "--master_addr",
+            "localhost:%d" % master.port,
+            "--model_zoo",
+            MODEL_ZOO_PATH,
+            "--model_def",
+            "mnist_subclass.mnist_subclass.CustomModel",
+            "--minibatch_size",
+            "16",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+            "--comm_host",
+            "localhost",
+        ]
+
+    return worker_command
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "EDL_DIST_PLATFORM": "cpu",
+            "EDL_LOCAL_DEVICES": "1",
+            "EDL_COMM_HOST": "localhost",
+            "EDL_WORLD_INIT_TIMEOUT": "60",
+            "EDL_HEARTBEAT_TIMEOUT": "10",
+            "EDL_SHUTDOWN_TIMEOUT": "10",
+        }
+    )
+    # the parent test process pins these for its own virtual mesh; they
+    # must not leak a conflicting device count into the workers
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_two_process_job(tmp_path):
+    create_recordio_file(
+        256, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+    )
+    master = _master_for(str(tmp_path), num_workers=2, num_epochs=1)
+    manager = LocalInstanceManager(
+        master.task_d,
+        2,
+        _worker_command_for(master),
+        env=_worker_env(),
+        membership=master.membership,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    manager.stop_relaunch_and_remove_all_pods()
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_survives_worker_kill(tmp_path):
+    create_recordio_file(
+        384, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+    )
+    master = _master_for(str(tmp_path), num_workers=3, num_epochs=2)
+
+    completed = []
+    orig_report = master.task_d.report
+
+    def counting_report(task_id, success):
+        if success:
+            completed.append(task_id)
+        return orig_report(task_id, success)
+
+    master.task_d.report = counting_report
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        3,
+        _worker_command_for(master),
+        env=_worker_env(),
+        membership=master.membership,
+        max_relaunches=10,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+
+    # wait for real collective progress, then kill a worker mid-job
+    deadline = time.time() + 240
+    while len(completed) < 2:
+        assert time.time() < deadline, "job made no progress"
+        assert runner.is_alive(), "master exited early"
+        time.sleep(0.5)
+    victims = manager.live_workers()
+    assert victims, "no live workers to kill"
+    manager.kill_worker(victims[-1])
+
+    runner.join(timeout=420)
+    assert not runner.is_alive(), "master did not finish after the kill"
+    assert master.task_d.finished()
+    # every task eventually completed despite the kill (3 workers,
+    # 384*2 records / 64 records-per-task = 12 tasks)
+    assert len(set(completed)) == 12
+    manager.stop_relaunch_and_remove_all_pods()

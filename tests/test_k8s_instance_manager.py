@@ -1,0 +1,231 @@
+"""k8s elasticity brain against a fake client (no cluster needed).
+
+The reference could only test its instance manager against a live
+minikube (reference tests/k8s_instance_manager_test.py, gated on
+K8S_TESTS); here the decision core is pure and the client is injected, so
+the full event matrix — worker deleted -> recover + fresh-id relaunch,
+PS deleted -> same-id relaunch, Succeeded -> no relaunch, relaunch budget
+exhaustion, membership epoch bumps — runs in-process.
+"""
+
+from types import SimpleNamespace
+
+from elasticdl_tpu.master.k8s_instance_manager import (
+    PS,
+    WORKER,
+    InstanceManager,
+    decide_on_exit,
+)
+from elasticdl_tpu.master.membership_service import MembershipService
+
+
+class FakeK8sClient:
+    """Records pod creations and lets tests fire watch events."""
+
+    def __init__(self):
+        self.created = []  # (kind, id, args)
+        self.deleted = []
+        self.services = []
+        self.labels = {}
+
+    def _pod(self, name):
+        return SimpleNamespace(
+            kind="Pod",
+            metadata=SimpleNamespace(name=name),
+            status=SimpleNamespace(phase="Pending"),
+        )
+
+    def create_worker(self, worker_id, args=None, **_):
+        self.created.append((WORKER, worker_id, args or []))
+        return self._pod("worker-%d" % worker_id)
+
+    def create_ps(self, ps_id, args=None, **_):
+        self.created.append((PS, ps_id, args or []))
+        return self._pod("ps-%d" % ps_id)
+
+    def create_ps_service(self, ps_id):
+        self.services.append(ps_id)
+
+    def get_ps_service_address(self, ps_id):
+        return "ps-svc-%d:3333" % ps_id
+
+    def get_master_pod_name(self):
+        return "the-master"
+
+    def patch_labels_to_pod(self, pod_name, labels_dict):
+        self.labels.setdefault(pod_name, {}).update(labels_dict)
+
+    def delete_worker(self, worker_id):
+        self.deleted.append((WORKER, worker_id))
+
+    def delete_ps(self, ps_id):
+        self.deleted.append((PS, ps_id))
+
+
+class FakeDispatcher:
+    def __init__(self):
+        self.recovered = []
+
+    def recover_tasks(self, worker_id):
+        self.recovered.append(worker_id)
+
+
+def _event(pod_name, phase, evt_type):
+    return {
+        "type": evt_type,
+        "object": SimpleNamespace(
+            kind="Pod",
+            metadata=SimpleNamespace(name=pod_name),
+            status=SimpleNamespace(phase=phase),
+        ),
+    }
+
+
+def _manager(num_workers=3, num_ps=2, membership=None, **kw):
+    client = FakeK8sClient()
+    task_d = FakeDispatcher()
+    manager = InstanceManager(
+        task_d,
+        num_workers=num_workers,
+        num_ps=num_ps,
+        worker_command=["python"],
+        ps_command=["python"],
+        membership=membership,
+        k8s_client=client,
+        **kw,
+    )
+    return manager, client, task_d
+
+
+def test_decide_on_exit_matrix():
+    d = decide_on_exit(WORKER, "Failed", True, 5)
+    assert d.recover and d.relaunch and d.new_id
+    # a worker that Succeeded is done, not dead
+    d = decide_on_exit(WORKER, "Succeeded", True, 5)
+    assert d.recover and not d.relaunch
+    # budget spent / relaunch disabled
+    assert not decide_on_exit(WORKER, "Failed", True, 0).relaunch
+    assert not decide_on_exit(WORKER, "Failed", False, 5).relaunch
+    # PS keeps its id and recovers nothing
+    d = decide_on_exit(PS, "Failed", True, 5)
+    assert not d.recover and d.relaunch and not d.new_id
+
+
+def test_worker_deleted_recovers_and_relaunches_fresh_id():
+    manager, client, task_d = _manager()
+    manager.start_all_ps()
+    manager.start_workers()
+    assert [c[:2] for c in client.created] == [
+        (PS, 0),
+        (PS, 1),
+        (WORKER, 0),
+        (WORKER, 1),
+        (WORKER, 2),
+    ]
+    assert client.services == [0, 1]
+    # workers get the PS addresses on their command line
+    assert "ps-svc-0:3333,ps-svc-1:3333" in client.created[2][2]
+
+    manager.handle_pod_event(_event("worker-1", "Failed", "DELETED"))
+    assert task_d.recovered == [1]
+    kind, new_id, _ = client.created[-1]
+    assert kind == WORKER and new_id == 3  # fresh id, not a reuse
+
+
+def test_ps_deleted_relaunches_same_id():
+    manager, client, task_d = _manager()
+    manager.start_all_ps()
+    manager.handle_pod_event(_event("ps-1", "Failed", "DELETED"))
+    assert task_d.recovered == []  # nothing to recover for PS
+    assert client.created[-1][:2] == (PS, 1)
+    # the replacement keeps the stable service (created once at launch +
+    # once on relaunch is fine; the DNS name is identical)
+    assert client.services.count(1) >= 1
+
+
+def test_succeeded_worker_not_relaunched():
+    manager, client, task_d = _manager()
+    manager.start_workers()
+    n = len(client.created)
+    manager.handle_pod_event(_event("worker-2", "Succeeded", "DELETED"))
+    assert task_d.recovered == [2]  # recover is harmless and uniform
+    assert len(client.created) == n  # no replacement
+
+
+def test_relaunch_budget_exhausts():
+    manager, client, _ = _manager(num_workers=1, max_relaunches=2)
+    manager.start_workers()
+    for wid in (0, 1, 2):
+        manager.handle_pod_event(
+            _event("worker-%d" % wid, "Failed", "DELETED")
+        )
+    # initial launch + 2 relaunches, then the budget is gone
+    worker_launches = [c for c in client.created if c[0] == WORKER]
+    assert len(worker_launches) == 3
+
+
+def test_stop_relaunch_and_remove_disables_replacements():
+    manager, client, _ = _manager()
+    manager.start_all_ps()
+    manager.start_workers()
+    manager.stop_relaunch_and_remove_all_pods()
+    assert (WORKER, 0) in client.deleted and (PS, 1) in client.deleted
+    n = len(client.created)
+    manager.handle_pod_event(_event("worker-0", "Failed", "DELETED"))
+    manager.handle_pod_event(_event("ps-0", "Failed", "DELETED"))
+    assert len(client.created) == n
+
+
+def test_worker_death_bumps_membership_epoch():
+    membership = MembershipService(expected_workers=2)
+    manager, client, _ = _manager(num_workers=2, membership=membership)
+    manager.start_workers()
+    membership.register(0)
+    membership.register(1)
+    epoch = membership.epoch
+    manager.handle_pod_event(_event("worker-0", "Failed", "DELETED"))
+    assert membership.epoch > epoch
+    w = membership.get_world(1)
+    assert w["num_processes"] == 1 and w["process_id"] == 0
+
+
+def test_phase_observation_and_status_label():
+    manager, client, _ = _manager(num_workers=2)
+    manager.start_workers()
+    manager.handle_pod_event(_event("worker-0", "Running", "MODIFIED"))
+    counter = manager.get_worker_counter()
+    assert counter["Running"] == 1
+    manager.update_status("Finished")
+    assert client.labels["the-master"] == {"status": "Finished"}
+
+
+def test_unknown_pod_event_ignored():
+    manager, client, task_d = _manager()
+    manager.start_workers()
+    n = len(client.created)
+    manager.handle_pod_event(_event("interloper-pod", "Failed", "DELETED"))
+    manager.handle_pod_event(_event("the-master", "Running", "MODIFIED"))
+    assert task_d.recovered == [] and len(client.created) == n
+
+
+def test_unresponsive_member_gets_fenced():
+    """A membership drop must delete the wedged worker's pod so its
+    tasks recover through the ordinary DELETED path."""
+    import time
+
+    membership = MembershipService(
+        expected_workers=2, confirm_timeout_secs=0.2
+    )
+    manager, client, _ = _manager(num_workers=2, membership=membership)
+    manager.start_workers()
+    membership.get_world(0)
+    membership.get_world(1)  # world [0, 1] formed, awaiting confirms
+    membership._last_poll[0] = time.time() - 3.0  # 0 goes quiet
+    deadline = time.time() + 5
+    while (WORKER, 0) not in client.deleted:
+        w = membership.get_world(1)
+        if w["ready"]:
+            break
+        assert time.time() < deadline
+        time.sleep(0.05)
+    assert (WORKER, 0) in client.deleted
