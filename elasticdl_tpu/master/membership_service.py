@@ -157,6 +157,24 @@ class MembershipService:
         """
         self.register(worker_id, host)
         now = time.time()
+        to_fence = []
+        try:
+            return self._get_world_locked(
+                worker_id, now, awaiting, to_fence
+            )
+        finally:
+            # fence outside the lock: a slow kill/pod-delete API call
+            # must not stall every other member's poll
+            if to_fence and self._fencer is not None:
+                for w in to_fence:
+                    try:
+                        self._fencer(w)
+                    except Exception:
+                        logger.warning(
+                            "fencing worker %d failed", w, exc_info=True
+                        )
+
+    def _get_world_locked(self, worker_id, now, awaiting, to_fence):
         with self._lock:
             self._last_poll[worker_id] = now
             if not self._formed_initial:
@@ -203,16 +221,7 @@ class MembershipService:
                         for w in lagging:
                             self._live.pop(w, None)
                         self._bump_locked()
-                        if self._fencer is not None:
-                            for w in lagging:
-                                try:
-                                    self._fencer(w)
-                                except Exception:
-                                    logger.warning(
-                                        "fencing worker %d failed",
-                                        w,
-                                        exc_info=True,
-                                    )
+                        to_fence.extend(lagging)
                         return {"epoch": self._epoch, "ready": False}
                     self._bump_time = now  # responsive but slow: wait on
                 if not self._world_ready:

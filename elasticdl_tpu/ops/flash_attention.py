@@ -1,4 +1,4 @@
-"""Fused flash-attention forward kernel (Pallas/TPU).
+"""Fused flash attention (Pallas/TPU): forward AND blockwise backward.
 
 The hot op of the transformer family: softmax(QK^T)V computed blockwise
 with the online-softmax recurrence, so neither the (L, L) score matrix nor
@@ -8,12 +8,20 @@ while the running max / normalizer / accumulator persist in VMEM scratch
 across the innermost k axis — the standard TPU flash pipeline.
 Accumulation is float32 while inputs may be bfloat16 (MXU native).
 
-Gradient support: ``flash_attention`` carries a ``jax.custom_vjp`` whose
-backward recomputes attention with the shared XLA reference
-(parallel/ring_attention.reference_attention) — the standard memory/FLOP
-trade (same role as ``jax.checkpoint``).
+Training path: the forward saves only (out, logsumexp) per row — O(L)
+extra — and the backward runs two more blockwise kernels that recompute
+``p = exp(qk^T - lse)`` per tile:
 
-On non-TPU backends the kernel runs in Pallas interpret mode (tests), so
+- q-major pass: ``dq += (p * (dO V^T - delta)) K`` accumulated over k
+  blocks,
+- k-major pass: ``dv += p^T dO`` and ``dk += (p * (dO V^T - delta))^T Q``
+  accumulated over q blocks,
+
+with ``delta = rowsum(dO * O)``. Peak memory in backward is O(block^2)
+per core — no (L, L) materialization anywhere (round-1 advisor finding:
+the previous backward re-ran dense reference attention).
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests), so
 numerics are identical everywhere.
 """
 
@@ -24,14 +32,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from elasticdl_tpu.parallel.ring_attention import reference_attention
-
 NEG_INF = -1e30
 _LANES = 128  # stats are broadcast across a full lane register
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, causal, scale
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    causal,
+    scale,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -84,13 +100,154 @@ def _fwd_kernel(
 
     @pl.when(kj == nk - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        l_fin = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / l_fin).astype(o_ref.dtype)
+        lse_ref[:] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(l_fin), lse_ref.shape
+        )
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
-    b, lq, h, d = q.shape
-    lk = k.shape[1]
+def _recompute_p(q_ref, k_ref, lse_ref, qi, kj, causal, scale):
+    """exp(qk^T * scale - lse) for one tile — shared by both bwd passes."""
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ()))) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return jnp.exp(s - lse_ref[0, :, :1])
+
+
+def _bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_acc,
+    *,
+    causal,
+    scale,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    def compute():
+        p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, causal, scale)
+        do = do_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ()))
+        )  # (block_q, block_k)
+        ds = p * (dp - delta_ref[0, :, :1])
+        dq_acc[:] += jax.lax.dot(ds, k_ref[0].astype(jnp.float32)) * scale
+
+    if causal:
+        @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+
+    else:
+        compute()
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc,
+    dv_acc,
+    *,
+    causal,
+    scale,
+):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    def compute():
+        p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, causal, scale)
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ()))
+        )  # p^T dO: (block_k, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ()))
+        )
+        ds = p * (dp - delta_ref[0, :, :1])
+        dk_acc[:] += (
+            jax.lax.dot_general(
+                ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ()))
+            )
+            * scale
+        )  # ds^T Q: (block_k, d)
+
+    if causal:
+        # q blocks entirely above the diagonal see this k block masked
+        @pl.when(qi * block_q + block_q - 1 >= kj * block_k)
+        def _():
+            compute()
+
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _fold_heads(x):
+    x = jnp.asarray(x)
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _unfold_heads(x, b, h):
+    bh, l, d = x.shape
+    return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+def divisible(lq, lk, block_q, block_k):
+    """True when the fused kernels can tile these lengths."""
+    return lq % min(block_q, lq) == 0 and lk % min(block_k, lk) == 0
+
+
+def _block_sizes(lq, lk, block_q, block_k):
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
@@ -98,25 +255,37 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             "sequence lengths (%d, %d) must divide block sizes (%d, %d)"
             % (lq, lk, block_q, block_k)
         )
+    return block_q, block_k
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block_q, block_k = _block_sizes(lq, lk, block_q, block_k)
     scale = d ** -0.5
-    # fold heads into the grid's leading axis: (B*H, L, D)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
 
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, lq, _LANES), jnp.float32),
+        ],
         grid=(b * h, lq // block_q, lk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda i, qi, kj: (i, qi, 0)
-        ),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0)),
+            pl.BlockSpec(
+                (1, block_q, _LANES),
+                lambda i, qi, kj: (i, qi, 0),
+            ),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -124,7 +293,86 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    return (
+        _unfold_heads(out, b, h),
+        lse[:, :, 0].reshape(b, h, lq),
+    )
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block_q, block_k = _block_sizes(lq, lk, block_q, block_k)
+    scale = d ** -0.5
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    dof = _fold_heads(g.astype(q.dtype))
+    outf = _fold_heads(out)
+    # delta = rowsum(dO * O): tiny elementwise reduce, plain XLA
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1
+    )  # (b*h, lq)
+    lse_l = jnp.broadcast_to(
+        lse.reshape(b * h, lq, 1), (b * h, lq, _LANES)
+    )
+    delta_l = jnp.broadcast_to(
+        delta[..., None], (b * h, lq, _LANES)
+    )
+
+    stat_spec_q = pl.BlockSpec(
+        (1, block_q, _LANES), lambda i, qi, kj: (i, qi, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, lq // block_q, lk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0)),
+            stat_spec_q,
+            stat_spec_q,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, qi, kj: (i, qi, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_l, delta_l)
+
+    stat_spec_kmajor = pl.BlockSpec(
+        (1, block_q, _LANES), lambda i, kj, qi: (i, qi, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        grid=(b * h, lk // block_k, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, kj, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, kj, qi: (i, qi, 0)),
+            stat_spec_kmajor,
+            stat_spec_kmajor,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_l, delta_l)
+    return (
+        _unfold_heads(dq, b, h),
+        _unfold_heads(dk, b, h),
+        _unfold_heads(dv, b, h),
+    )
 
 
 def _use_interpret():
@@ -132,24 +380,35 @@ def _use_interpret():
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
-    """(B, L, H, D) fused attention. Differentiable (recompute backward)."""
-    return _flash_fwd(
-        q, k, v, causal, block_q, block_k, _use_interpret()
-    )
+def flash_attention_with_lse(q, k, v, causal=False, block_q=128, block_k=128):
+    """(B, L, H, D) fused attention returning (out, lse).
+
+    ``lse`` is the per-row logsumexp (B, H, L) — the flash statistic that
+    makes partial attentions mergeable (ring attention combines per-block
+    (out, lse) pairs) and the only residual the blockwise backward needs.
+    """
+    return _flash_fwd(q, k, v, causal, block_q, block_k, _use_interpret())
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k):
-    out = flash_attention(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
-
-
-def _bwd_rule(causal, block_q, block_k, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: reference_attention(q, k, v, causal=causal), q, k, v
+    out, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, _use_interpret()
     )
-    return vjp(g)
+    return (out, lse), (q, k, v, out, lse)
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+def _bwd_rule(causal, block_q, block_k, residuals, cotangents):
+    q, k, v, out, lse = residuals
+    g, _ = cotangents  # lse cotangent unused (stat output, not a value)
+    return _flash_bwd(
+        q, k, v, out, lse, g, causal, block_q, block_k, _use_interpret()
+    )
+
+
+flash_attention_with_lse.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
+    """(B, L, H, D) fused attention; trains with the blockwise backward."""
+    out, _ = flash_attention_with_lse(q, k, v, causal, block_q, block_k)
+    return out

@@ -117,8 +117,10 @@ def make_elastic_train_step(module, loss_fn, optimizer, mesh, axis="data"):
         (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(
             ts.params
         )
-        n = jax.lax.psum(w, axis)
-        denom = jnp.maximum(n, 1.0)
+        # liveness (how many devices carried data) is separate from the
+        # weighted denominator: tail batches contribute fractional weight
+        n = jax.lax.psum((w > 0).astype(jnp.float32), axis)
+        denom = jnp.maximum(jax.lax.psum(w, axis), 1e-6)
 
         def wavg(x):
             if jnp.issubdtype(x.dtype, jnp.floating):
@@ -181,6 +183,11 @@ class ElasticDPTrainer:
         return (
             int(host_copy(self._ts.version)) if self._ts is not None else -1
         )
+
+    @property
+    def has_state(self):
+        """Cheap liveness check (no device->host transfer)."""
+        return self._ts is not None or self._host_ts is not None
 
     def establish(self, spec, example_batch=None):
         """Join ``spec``'s world and (re)place train state on its mesh.
@@ -266,9 +273,11 @@ class ElasticDPTrainer:
                 )
             local = self._last_local
         n_local = jax.local_device_count()
-        w_local = np.full(
-            (n_local,), 1.0 if has_data else 0.0, dtype=np.float32
-        )
+        # partial batches pad by repeating the last example; weighting the
+        # whole process by its true row fraction keeps a 1-row tail batch
+        # from contributing a full step's worth of gradient
+        w_value = min(1.0, count / rows) if has_data else 0.0
+        w_local = np.full((n_local,), w_value, dtype=np.float32)
         g_features = self._place_batch(local[0])
         g_labels = self._place_batch(local[1])
         g_weights = jax.make_array_from_process_local_data(

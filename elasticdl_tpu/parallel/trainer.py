@@ -44,14 +44,14 @@ class AllReduceTrainer:
         """``param_specs``: optional nested dict mirroring (a prefix of)
         the params tree whose leaves are PartitionSpecs — parameters it
         names shard over the mesh instead of replicating (HBM embedding
-        tables); their optimizer slots co-shard by shape."""
+        tables); their optimizer slots co-shard by tree-path suffix."""
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._batch_axis = batch_axis
         self._seed = seed
         self._param_specs = param_specs
-        self._sharded_shapes = {}
+        self._sharded_paths = {}
         self._step_fn = make_train_step(module, loss_fn, optimizer)
         self._mesh = mesh if mesh is not None else create_mesh(devices=devices)
         self._ts = None
@@ -73,41 +73,54 @@ class AllReduceTrainer:
     def version(self):
         return int(self._ts.version) if self._ts is not None else -1
 
-    def _collect_sharded_shapes(self, params):
-        """Map leaf shapes named by param_specs to their NamedShardings.
-
-        Shape-keyed matching lets the same map place optimizer slots
-        (param-shaped moment trees) without spec plumbing; vocab-sized
-        tables don't collide with dense-layer shapes in practice.
-        """
-        shapes = {}
+    def _collect_sharded_paths(self):
+        """Flatten param_specs into {path tuple: NamedSharding}."""
+        paths = {}
         if not self._param_specs:
-            return shapes
+            return paths
 
-        def walk(spec_tree, param_tree):
+        def walk(spec_tree, prefix):
             if hasattr(spec_tree, "items"):
                 for k, sub in spec_tree.items():
-                    if param_tree is not None and k in param_tree:
-                        walk(sub, param_tree[k])
+                    walk(sub, prefix + (k,))
             else:
-                for leaf in jax.tree_util.tree_leaves(param_tree):
-                    shapes[np.shape(leaf)] = NamedSharding(
-                        self._mesh, spec_tree
-                    )
+                paths[prefix] = NamedSharding(self._mesh, spec_tree)
 
-        walk(self._param_specs, params)
-        return shapes
+        walk(self._param_specs, ())
+        return paths
+
+    @staticmethod
+    def _key_names(key_path):
+        names = []
+        for k in key_path:
+            name = getattr(k, "key", None)
+            if name is None:
+                name = getattr(k, "name", None)
+            if name is None:
+                name = getattr(k, "idx", None)
+            names.append(str(name))
+        return tuple(names)
 
     def _place(self, tree):
-        """Place a host pytree: spec-named shapes shard, the rest
-        replicates."""
+        """Place a host pytree: leaves whose tree path *ends with* a
+        spec path shard, the rest replicates.
+
+        Suffix matching places both the parameters themselves (path ==
+        spec path) and their optimizer slots (optax moment trees nest the
+        same sub-structure under mu/nu/...), without false positives on
+        unrelated leaves that merely share a shape.
+        """
         rep = replicated(self._mesh)
+        specs = self._sharded_paths
 
-        def put(x):
-            sharding = self._sharded_shapes.get(np.shape(x), rep)
-            return jax.device_put(x, sharding)
+        def put(key_path, x):
+            names = self._key_names(key_path)
+            for spec_path, sharding in specs.items():
+                if names[-len(spec_path):] == spec_path:
+                    return jax.device_put(x, sharding)
+            return jax.device_put(x, rep)
 
-        return jax.tree_util.tree_map(put, tree)
+        return jax.tree_util.tree_map_with_path(put, tree)
 
     def init_from_batch(self, global_batch):
         """Create + place train state from one example batch."""
@@ -124,13 +137,13 @@ class AllReduceTrainer:
         )
         params, state = split_variables(variables)
         ts = TrainState.create(params, state, self._optimizer)
-        self._sharded_shapes = self._collect_sharded_shapes(params)
+        self._sharded_paths = self._collect_sharded_paths()
         self._ts = self._place(ts)
         return self._ts
 
     def load_state(self, ts):
         """Adopt an existing host/device train state (checkpoint restore)."""
-        self._sharded_shapes = self._collect_sharded_shapes(ts.params)
+        self._sharded_paths = self._collect_sharded_paths()
         self._ts = self._place(ts)
 
     def train_step(self, features, labels):
@@ -165,9 +178,7 @@ class AllReduceTrainer:
             self.num_devices,
         )
         if host_ts is not None:
-            self._sharded_shapes = self._collect_sharded_shapes(
-                host_ts.params
-            )
+            self._sharded_paths = self._collect_sharded_paths()
             self._ts = self._place(host_ts)
 
     def get_host_state(self):

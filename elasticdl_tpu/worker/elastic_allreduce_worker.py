@@ -326,7 +326,7 @@ class ElasticAllReduceWorker:
     def _evaluate_only(self):
         from elasticdl_tpu.common.constants import TaskType
 
-        if self.trainer.snapshot() is None:
+        if not self.trainer.has_state:
             # no params to evaluate with (never trained): leave the eval
             # tasks for peers that have state — grabbing one here would
             # fail-requeue-regrab in a tight livelock
@@ -351,7 +351,7 @@ class ElasticAllReduceWorker:
             self._task_data_service.data_reader.metadata,
         )
         dataset = dataset.batch(self._minibatch_size)
-        if self.trainer.snapshot() is None:
+        if not self.trainer.has_state:
             # fail the task so a worker that has trained state redoes it
             self.report_task_result(
                 task_id, err_msg="no local train state for evaluation"

@@ -91,7 +91,10 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.float32
     mesh: Any = None
     seq_axis: Any = None
-    use_flash: bool = False  # Pallas fused-attention kernel (single-chip)
+    # Pallas fused-attention kernel (single-chip path; the mesh/seq_axis
+    # path uses the fused ring). Trains blockwise since round 2 — the
+    # backward recomputes p per tile from the saved logsumexp.
+    use_flash: bool = True
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -102,17 +105,21 @@ class TransformerLM(nn.Module):
         b, l = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32), (b, l))
 
+        from elasticdl_tpu.ops.flash_attention import divisible
+
         if self.mesh is not None and self.seq_axis is not None:
             attention_fn = make_ring_attention(
-                self.mesh, self.seq_axis, causal=True
+                self.mesh, self.seq_axis, causal=True,
+                use_flash=self.use_flash,
             )
-        elif self.use_flash:
+        elif self.use_flash and divisible(l, l, 128, 128):
             from elasticdl_tpu.ops.flash_attention import flash_attention
 
             attention_fn = lambda q, k, v: flash_attention(  # noqa: E731
                 q, k, v, True
             )
         else:
+            # odd lengths the kernel can't tile keep the XLA path
             attention_fn = functools.partial(
                 reference_attention, causal=True
             )
@@ -149,7 +156,7 @@ def custom_model(
     dtype="float32",
     mesh=None,
     seq_axis=None,
-    use_flash=False,
+    use_flash=True,
 ):
     return TransformerLM(
         vocab_size=vocab_size,
