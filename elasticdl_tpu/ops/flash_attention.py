@@ -243,8 +243,18 @@ def _unfold_heads(x, b, h):
 
 
 def divisible(lq, lk, block_q, block_k):
-    """True when the fused kernels can tile these lengths."""
-    return lq % min(block_q, lq) == 0 and lk % min(block_k, lk) == 0
+    """True when the fused kernels can tile these lengths.
+
+    On real TPU hardware Mosaic additionally needs the (possibly
+    clamped) block sizes aligned to the 8-sublane register shape;
+    interpret mode (tests) has no such constraint.
+    """
+    bq, bk = min(block_q, lq), min(block_k, lk)
+    if lq % bq or lk % bk:
+        return False
+    if _use_interpret():
+        return True
+    return bq % 8 == 0 and bk % 8 == 0
 
 
 def _block_sizes(lq, lk, block_q, block_k):
@@ -299,7 +309,9 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     )
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+def _flash_bwd(
+    q, k, v, out, lse, g, causal, block_q, block_k, interpret, g_lse=None
+):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     block_q, block_k = _block_sizes(lq, lk, block_q, block_k)
@@ -307,10 +319,16 @@ def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     dof = _fold_heads(g.astype(q.dtype))
     outf = _fold_heads(out)
-    # delta = rowsum(dO * O): tiny elementwise reduce, plain XLA
+    # delta = rowsum(dO * O): tiny elementwise reduce, plain XLA.
+    # An lse cotangent folds in exactly here: d lse/d s = p, so
+    # ds = p * (dp - delta + g_lse) — pass delta_eff = delta - g_lse.
     delta = jnp.sum(
         dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1
     )  # (b*h, lq)
+    if g_lse is not None:
+        delta = delta - jnp.asarray(g_lse, jnp.float32).reshape(
+            b * h, lq
+        )
     lse_l = jnp.broadcast_to(
         lse.reshape(b * h, lq, 1), (b * h, lq, _LANES)
     )
@@ -399,9 +417,19 @@ def _fwd_rule(q, k, v, causal, block_q, block_k):
 
 def _bwd_rule(causal, block_q, block_k, residuals, cotangents):
     q, k, v, out, lse = residuals
-    g, _ = cotangents  # lse cotangent unused (stat output, not a value)
+    g, g_lse = cotangents
     return _flash_bwd(
-        q, k, v, out, lse, g, causal, block_q, block_k, _use_interpret()
+        q,
+        k,
+        v,
+        out,
+        lse,
+        g,
+        causal,
+        block_q,
+        block_k,
+        _use_interpret(),
+        g_lse=g_lse,
     )
 
 

@@ -169,6 +169,7 @@ class ElasticDPTrainer:
         self._mesh = None
         self._spec = None
         self._ts = None
+        self._checked_ts = None  # last fetch-validated device state
         self._host_ts = None  # latest host snapshot (re-form source)
         self._step_fn = None
         self._host_step = 0
@@ -213,6 +214,7 @@ class ElasticDPTrainer:
             ts = TrainState.create(params, state, self._optimizer)
             self._host_ts = host_copy(ts)
         self._ts = broadcast_from_device0(self._mesh, self._host_ts)
+        self._checked_ts = self._ts
         self._step_fn = make_elastic_train_step(
             self._module, self._loss_fn, self._optimizer, self._mesh
         )
@@ -251,10 +253,19 @@ class ElasticDPTrainer:
         n_local = jax.local_device_count()
         return -(-minibatch_size // n_local) * n_local
 
-    def train_step(self, features, labels, minibatch_size):
+    def train_step(self, features, labels, minibatch_size, sync=True):
         """One weighted lockstep step; ``features=None`` participates at
         weight 0 (drain mode). Returns (loss, n_active_devices, count)
-        where count is this process's true (unpadded) contribution."""
+        where count is this process's true (unpadded) contribution.
+
+        ``sync=False`` skips the device->host fetch and returns
+        (None, None, count): dispatch stays asynchronous, so the host
+        (task RPCs, input pipeline) runs ahead of the device instead of
+        stalling a round trip per step — on a multi-host DCN or a
+        tunneled dev chip that latency is ~10 ms/step. Unsynced steps
+        are validated at the next ``sync=True`` call; a collective
+        failure then rolls the snapshot back to the last validated
+        state (bounded by the caller's sync cadence)."""
         rows = self.local_rows(minibatch_size)
         has_data = features is not None
         if has_data:
@@ -293,18 +304,49 @@ class ElasticDPTrainer:
             new_ts, loss, n = self._step_fn(
                 self._ts, g_features, g_labels, g_weights, rng
             )
-        # commit only after the fetch proves the collectives completed:
-        # on a failed step self._ts keeps the valid pre-step state, which
-        # is exactly what the re-form snapshot needs
+        self._ts = new_ts
+        if not sync:
+            return None, None, count
+        # the fetch proves every dispatched collective up to here
+        # completed; checkpoint that state as the re-form fallback
         loss_v = float(host_copy(loss))
         n_v = int(host_copy(n))
-        self._ts = new_ts
+        self._checked_ts = new_ts
         return loss_v, n_v, count
 
+    def validate(self):
+        """Force-complete all dispatched work; True if it all succeeded.
+
+        On success the latest state becomes the checked (re-form
+        fallback) state; on failure the checked state is left at the
+        last validated point.
+        """
+        if self._ts is None:
+            return True
+        try:
+            host_copy(self._ts.version)
+        except Exception:
+            logger.warning("validation failed: a dispatched step errored")
+            return False
+        self._checked_ts = self._ts
+        return True
+
     def snapshot(self):
-        """Pull current state to host (the re-form / checkpoint source)."""
+        """Pull current state to host (the re-form / checkpoint source).
+
+        Falls back to the last fetch-validated state when the newest
+        buffers carry a failed collective (unsynced steps roll back)."""
         if self._ts is not None:
-            self._host_ts = host_copy(self._ts)
+            try:
+                self._host_ts = host_copy(self._ts)
+                return self._host_ts
+            except Exception:
+                logger.warning(
+                    "latest state poisoned by a failed collective; "
+                    "snapshotting the last validated state"
+                )
+            if self._checked_ts is not None:
+                self._host_ts = host_copy(self._checked_ts)
         return self._host_ts
 
     def host_params(self):
@@ -325,5 +367,6 @@ class ElasticDPTrainer:
             )
         distributed.leave_world()
         self._ts = None
+        self._checked_ts = None
         self._mesh = None
         self._step_fn = None

@@ -1,0 +1,97 @@
+"""Profiling / tracing hooks (SURVEY.md §5.1 first-class improvement).
+
+The reference has no profiler at all; here the standard JAX/XLA tools are
+wired behind one small surface so any worker, bench, or test can turn
+them on without plumbing:
+
+- :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace (``xplane.pb``) to a directory.
+- :func:`annotate` — named ``TraceAnnotation`` for host-side phases so
+  task pulls / input pipeline / step dispatch separate in the timeline.
+- :func:`enable_xla_dump` — set before the first compilation to dump HLO
+  (pre/post optimization) for compiler-level inspection.
+- :func:`step_timer` — lightweight wall-clock step statistics when a full
+  trace is too heavy (the bench uses it for its profile line).
+
+Env toggles (read by workers at startup): ``EDL_PROFILE_DIR`` enables
+tracing into that directory; ``EDL_XLA_DUMP_DIR`` enables HLO dumps.
+"""
+
+import contextlib
+import os
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+@contextlib.contextmanager
+def trace(log_dir, host_tracer_level=2):
+    """Capture a jax.profiler trace into ``log_dir``."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(
+        log_dir,
+        create_perfetto_link=False,
+        create_perfetto_trace=False,
+    )
+    logger.info("profiler trace started -> %s", log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)
+
+
+def annotate(name):
+    """Host-phase annotation visible in the profiler timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def enable_xla_dump(dump_dir):
+    """Dump HLO for every compilation (set BEFORE first jit)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_dump_to" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_dump_to=" + dump_dir
+        ).strip()
+    os.makedirs(dump_dir, exist_ok=True)
+
+
+def maybe_profile():
+    """Context from env: EDL_PROFILE_DIR -> trace, else no-op."""
+    log_dir = os.environ.get("EDL_PROFILE_DIR")
+    if log_dir:
+        return trace(log_dir)
+    return contextlib.nullcontext()
+
+
+class step_timer:
+    """Rolling wall-clock stats for the hot loop (mean/p50/p99 ms)."""
+
+    def __init__(self, capacity=1024):
+        self._times = []
+        self._capacity = capacity
+        self._last = None
+
+    def tick(self):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+            if len(self._times) > self._capacity:
+                self._times = self._times[-self._capacity :]
+        self._last = now
+
+    def stats(self):
+        if not self._times:
+            return {}
+        xs = sorted(self._times)
+        n = len(xs)
+        return {
+            "steps": n,
+            "mean_ms": 1e3 * sum(xs) / n,
+            "p50_ms": 1e3 * xs[n // 2],
+            "p99_ms": 1e3 * xs[min(n - 1, int(n * 0.99))],
+        }

@@ -148,6 +148,10 @@ class AllReduceWorker:
     def _train_batch(self, dataset_batch):
         features, labels = dataset_batch
         features, labels, count = self._pad_to_devices(features, labels)
+        # the per-step fetch keeps failure accounting exact (a failed
+        # step surfaces on the batch that failed, before its records are
+        # reported done); the multi-process elastic worker is the plane
+        # where deferred sync pays — it validates in windows instead
         loss = self.trainer.train_step(features, labels)
         return float(loss), count
 

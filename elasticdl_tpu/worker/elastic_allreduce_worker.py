@@ -69,11 +69,13 @@ class ElasticAllReduceWorker:
         seed=0,
         comm_host=None,
         epoch_poll_secs=10.0,
+        sync_every=8,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
         self._minibatch_size = minibatch_size
         self._stub = stub
+        self._sync_every = max(1, sync_every)
         self._host = comm_host or os.environ.get("EDL_COMM_HOST", "")
         if not self._host:
             # advertise an address peers can dial: on k8s the bare pod
@@ -127,6 +129,7 @@ class ElasticAllReduceWorker:
         )
         self._batch_gen = None
         self._retry_batch = None
+        self._unreported = []  # counts of consumed-but-unvalidated steps
         self._drained = False
         self._forward_fn = None
         self._eval_params_version = None
@@ -260,7 +263,18 @@ class ElasticAllReduceWorker:
                 return None
             time.sleep(0.2)
 
+    def _flush_unreported(self, err_msg=""):
+        """Report record counts held back while their steps were
+        unvalidated. With an err_msg the consumed-but-unapplied records
+        count as failures (per-task failure counters), and a task that
+        drains on the failing flush fail-reports + requeues — the
+        reference's failed-minibatch accounting semantics."""
+        pending, self._unreported = self._unreported, []
+        for count in pending:
+            self._task_data_service.report_record_done(count, err_msg)
+
     def _train_epoch(self, world, losses):
+        step_i = 0
         while True:
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                 self._evaluate_only()
@@ -273,30 +287,55 @@ class ElasticAllReduceWorker:
                     world.epoch,
                     w["epoch"],
                 )
+                # settle the sync window before leaving: validated steps
+                # report done, a failed window fail-reports (requeue)
+                ok = self.trainer.validate()
+                self._flush_unreported(
+                    "" if ok else "collective failed before validation"
+                )
                 self.trainer.leave()
                 return "reform"
             batch = self._next_batch()
-            err_msg = ""
+            step_i += 1
+            # syncing (a device->host round trip) every step stalls the
+            # dispatch pipeline; data steps sync every sync_every steps,
+            # drain steps always (their n_active drives the exit).
+            # Records consumed by unsynced steps are reported only once
+            # their window validates.
+            sync = batch is None or step_i % self._sync_every == 0
             try:
                 if batch is None:
                     loss, n_active, count = self.trainer.train_step(
-                        None, None, self._minibatch_size
+                        None, None, self._minibatch_size, sync=True
                     )
                 else:
                     features, labels = batch
                     loss, n_active, count = self.trainer.train_step(
-                        features, labels, self._minibatch_size
+                        features, labels, self._minibatch_size, sync=sync
                     )
-                    losses.append(loss)
+                    if loss is not None:
+                        losses.append(loss)
             except Exception:
                 logger.exception("collective step failed")
-                self._retry_batch = batch
+                # the whole unvalidated window (including this batch)
+                # fail-reports: its task drains + requeues, and the
+                # records are re-read by whichever worker picks it up —
+                # retrying the batch here would double-charge the
+                # requeued task's accounting
+                if batch is not None:
+                    leaf = batch[1]
+                    self._unreported.append(int(np.asarray(leaf).shape[0]))
+                self._flush_unreported(
+                    "collective failed before validation"
+                )
                 self.trainer.leave()
                 if not self._await_epoch_bump(world.epoch):
                     raise
                 return "reform"
             if batch is not None:
-                self._task_data_service.report_record_done(count, err_msg)
+                self._unreported.append(count)
+            if sync:
+                self._flush_unreported()
             if n_active == 0:
                 if self._drained:
                     return "done"

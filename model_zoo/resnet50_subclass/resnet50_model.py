@@ -59,7 +59,12 @@ class ResNet50(nn.Module):
     def __call__(self, x, training=False):
         if isinstance(x, dict):
             x = x["image"]
-        x = x.astype(self.dtype)
+        if x.dtype == jnp.uint8:
+            # normalize on device: the input pipeline ships raw uint8 so
+            # host->device traffic is 4x smaller than f32 images
+            x = x.astype(self.dtype) * (1.0 / 255.0)
+        else:
+            x = x.astype(self.dtype)
         x = nn.Conv(
             64,
             (7, 7),

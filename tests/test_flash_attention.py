@@ -125,3 +125,31 @@ def test_flash_with_lse_merges_like_ring():
     np.testing.assert_allclose(
         np.asarray(merged), np.asarray(want), rtol=2e-4, atol=2e-5
     )
+
+
+def test_flash_lse_cotangent_propagates():
+    """A loss that uses the lse output (e.g. a z-loss) must produce the
+    same gradients as the dense logsumexp."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops.flash_attention import flash_attention_with_lse
+
+    q, k, v = _qkv(l=32)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, False, 16, 16)
+        return (out ** 2).sum() + 0.1 * (lse ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out = reference_attention(q, k, v)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return (out ** 2).sum() + 0.1 * (lse ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4
+        )
