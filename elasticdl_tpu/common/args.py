@@ -312,6 +312,13 @@ def parse_worker_args(worker_args=None):
         "(the coordinator address when it is rank 0); defaults to "
         "$EDL_COMM_HOST or the hostname",
     )
+    # sharded (worker-side) checkpointing for the allreduce plane; the
+    # master relays its own values for these via the argv relay
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument(
+        "--keep_checkpoint_max", type=non_neg_int, default=0
+    )
     parser.add_argument(
         "--prediction_outputs_processor",
         default="PredictionOutputsProcessor",

@@ -1,0 +1,31 @@
+"""Small pytree helpers shared across the framework."""
+
+import jax
+
+
+def key_path_names(key_path):
+    """Normalize a jax tree key path to a tuple of name strings.
+
+    Handles DictKey (.key), GetAttrKey (.name), and SequenceKey (.idx) —
+    the one place the tree-path naming convention lives (used by both
+    sharded checkpoints and the trainer's param-sharding placement, so
+    save paths and placement paths can never drift apart).
+    """
+    names = []
+    for k in key_path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "name", None)
+        if name is None:
+            name = getattr(k, "idx", None)
+        names.append(str(name))
+    return tuple(names)
+
+
+def leaf_entries(tree):
+    """[(path-string, leaf)] with '/'-joined tree paths."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [
+        ("/".join(key_path_names(key_path)), leaf)
+        for key_path, leaf in flat
+    ]

@@ -1,0 +1,323 @@
+"""Sharded checkpointing: per-shard array files + a JSON manifest.
+
+The reference checkpoints one protobuf blob written by the master
+(reference master/checkpoint_service.py:47-72 + model_utils.py:138-150) —
+fine for host-PS models, wrong for device-resident state: a vocab-sharded
+embedding table would have to be gathered to one host first. Here each
+*process* writes exactly the array shards it holds (deduplicated by
+replica id, so replicated leaves are written once, by the process holding
+replica 0), and restore materializes arrays directly onto the target
+mesh with ``jax.make_array_from_callback`` — every device reads only the
+bytes its own shard needs, re-slicing across *different* mesh shapes or
+shardings when the world changed between save and restore. This is the
+OCDBT/TensorStore layout idea (SURVEY.md §7.1) in the framework's own
+dependency-free format.
+
+Directory layout (one directory per version)::
+
+    ckpt_v{N}/
+      manifest-{proc}.json   # leaves this process wrote: shape, dtype,
+                             #   per-shard global index -> data file
+      shard files *.npy      # one per (leaf, distinct shard index)
+
+Multi-host jobs point every process at a shared filesystem (the same
+requirement the reference's master checkpoint dir has on k8s volumes).
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.pytree import leaf_entries as _leaf_entries
+
+_MANIFEST_PREFIX = "manifest-"
+
+
+def _np_save(path, arr):
+    """np.save with a round-trippable encoding for non-native dtypes.
+
+    numpy serializes bfloat16 (an ml_dtypes extension type) as raw void
+    bytes that np.load cannot cast back; store the bit pattern as uint16
+    instead and view it back on read (same shape, itemsize 2).
+    """
+    arr = np.asarray(arr)
+    if arr.dtype.name == "bfloat16":
+        np.save(path, arr.view(np.uint16))
+    elif arr.dtype.kind == "V":
+        raise TypeError(
+            "cannot checkpoint dtype %s (no stable numpy encoding)"
+            % arr.dtype
+        )
+    else:
+        np.save(path, arr)
+
+
+def _np_load(path, dtype_name):
+    arr = np.load(path)
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _index_to_slices(index, shape):
+    """Normalized [(start, stop), ...] for a shard's global index."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        out.append((int(start), int(stop)))
+    return out
+
+
+def save_sharded(directory, tree, version=0):
+    """Write this process's shards of ``tree`` (a pytree of jax/np
+    arrays) into ``directory``. Every participating process must call it
+    (collective-free: pure local writes)."""
+    os.makedirs(directory, exist_ok=True)
+    pid = jax.process_index()
+    manifest = {"version": int(version), "leaves": {}}
+    for path, leaf in _leaf_entries(tree):
+        safe = path.replace("/", ".")
+        if not hasattr(leaf, "addressable_shards"):
+            # host array (numpy): process 0 owns it
+            if pid == 0:
+                fname = "%s.full.npy" % safe
+                _np_save(os.path.join(directory, fname), np.asarray(leaf))
+                manifest["leaves"][path] = {
+                    "shape": list(np.shape(leaf)),
+                    "dtype": str(np.asarray(leaf).dtype),
+                    "shards": [
+                        {
+                            "slices": _index_to_slices(
+                                (slice(None),) * np.ndim(leaf),
+                                np.shape(leaf),
+                            ),
+                            "file": fname,
+                        }
+                    ],
+                }
+            continue
+        entry = {
+            "shape": list(leaf.shape),
+            "dtype": str(leaf.dtype),
+            "shards": [],
+        }
+        for i, shard in enumerate(leaf.addressable_shards):
+            if shard.replica_id != 0:
+                continue  # replicated copy: someone else's replica 0 writes
+            fname = "%s.p%d.s%d.npy" % (safe, pid, i)
+            _np_save(
+                os.path.join(directory, fname), np.asarray(shard.data)
+            )
+            entry["shards"].append(
+                {
+                    "slices": _index_to_slices(shard.index, leaf.shape),
+                    "file": fname,
+                }
+            )
+        if entry["shards"]:
+            manifest["leaves"][path] = entry
+    # manifest written last and renamed into place: a crash mid-save
+    # leaves shard files but no manifest, and such directories are
+    # ignored by versions()/latest_dir()
+    manifest_path = os.path.join(
+        directory, "%s%d.json" % (_MANIFEST_PREFIX, pid)
+    )
+    tmp_path = manifest_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp_path, manifest_path)
+    logger.info(
+        "sharded checkpoint: process %d wrote %d leaves to %s",
+        pid,
+        len(manifest["leaves"]),
+        directory,
+    )
+
+
+def _merged_manifest(directory):
+    version, leaves = 0, {}
+    paths = sorted(
+        glob.glob(os.path.join(directory, _MANIFEST_PREFIX + "*.json"))
+    )
+    if not paths:
+        raise FileNotFoundError(
+            "no checkpoint manifests in %s" % directory
+        )
+    for p in paths:
+        with open(p) as f:
+            m = json.load(f)
+        version = max(version, m["version"])
+        for leaf_path, entry in m["leaves"].items():
+            merged = leaves.setdefault(
+                leaf_path,
+                {
+                    "shape": entry["shape"],
+                    "dtype": entry["dtype"],
+                    "shards": [],
+                },
+            )
+            merged["shards"].extend(entry["shards"])
+    return version, leaves
+
+
+class _LeafReader:
+    """Assembles any requested global slice from a leaf's shard files."""
+
+    def __init__(self, directory, entry):
+        self._dir = directory
+        self._entry = entry
+        self._cache = {}
+
+    def _shard_array(self, fname):
+        if fname not in self._cache:
+            self._cache[fname] = _np_load(
+                os.path.join(self._dir, fname), self._entry["dtype"]
+            )
+        return self._cache[fname]
+
+    def read(self, index):
+        shape = self._entry["shape"]
+        want = _index_to_slices(index, shape)
+        out = np.zeros(
+            [stop - start for start, stop in want],
+            dtype=_np_dtype(self._entry["dtype"]),
+        )
+        covered = 0
+        for shard in self._entry["shards"]:
+            have = [tuple(s) for s in shard["slices"]]
+            inter = [
+                (max(ws, hs), min(we, he))
+                for (ws, we), (hs, he) in zip(want, have)
+            ]
+            if any(s >= e for s, e in inter):
+                continue
+            src = self._shard_array(shard["file"])
+            src_sl = tuple(
+                slice(s - hs, e - hs)
+                for (s, e), (hs, _) in zip(inter, have)
+            )
+            dst_sl = tuple(
+                slice(s - ws, e - ws)
+                for (s, e), (ws, _) in zip(inter, want)
+            )
+            out[dst_sl] = src[src_sl]
+            covered += int(
+                np.prod([e - s for s, e in inter], dtype=np.int64)
+            )
+        total = int(np.prod(out.shape, dtype=np.int64))
+        if covered < total:
+            raise ValueError(
+                "checkpoint shards cover %d/%d elements of the requested "
+                "slice (missing process manifests?)" % (covered, total)
+            )
+        return out
+
+
+def load_sharded(directory, shardings):
+    """Restore a pytree onto device: ``shardings`` is a pytree (same
+    structure as saved) of ``jax.sharding.Sharding``; each device
+    materializes only its own slice bytes. Returns (version, tree)."""
+    version, leaves = _merged_manifest(directory)
+    flat_shardings = _leaf_entries(shardings)
+    out_flat = []
+    for path, sharding in flat_shardings:
+        if path not in leaves:
+            raise KeyError(
+                "leaf %s not present in checkpoint %s" % (path, directory)
+            )
+        entry = leaves[path]
+        reader = _LeafReader(directory, entry)
+        arr = jax.make_array_from_callback(
+            tuple(entry["shape"]),
+            sharding,
+            lambda index, r=reader: r.read(index),
+        )
+        out_flat.append(arr)
+    treedef = jax.tree_util.tree_structure(shardings)
+    return version, jax.tree_util.tree_unflatten(treedef, out_flat)
+
+
+def load_sharded_to_host(directory):
+    """Restore to host numpy (tooling / model export); full arrays."""
+    version, leaves = _merged_manifest(directory)
+    tree = {}
+    for path, entry in leaves.items():
+        reader = _LeafReader(directory, entry)
+        full = reader.read(
+            tuple(slice(0, d) for d in entry["shape"])
+        )
+        node = tree
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = full
+    return version, tree
+
+
+class ShardedCheckpointManager:
+    """Ring-retention directory manager (the CheckpointService semantics
+    — every checkpoint_steps versions, keep_max directories — for the
+    device-resident checkpoint format)."""
+
+    def __init__(self, base_dir, checkpoint_steps=0, keep_max=0):
+        self._base = base_dir
+        self._steps = checkpoint_steps
+        self._keep_max = keep_max
+
+    @property
+    def steps(self):
+        return self._steps
+
+    def is_enabled(self):
+        return bool(self._steps)
+
+    def need_to_checkpoint(self, version):
+        return self.is_enabled() and version % self._steps == 0
+
+    def _dir_for(self, version):
+        return os.path.join(self._base, "ckpt_v%d" % version)
+
+    def save(self, tree, version):
+        directory = self._dir_for(version)
+        save_sharded(directory, tree, version)
+        if self._keep_max and jax.process_index() == 0:
+            kept = sorted(self.versions())
+            while len(kept) > self._keep_max:
+                victim = self._dir_for(kept.pop(0))
+                for f in glob.glob(os.path.join(victim, "*")):
+                    os.remove(f)
+                os.rmdir(victim)
+        return directory
+
+    def versions(self):
+        """Versions with at least one complete manifest (a crash mid-save
+        leaves a manifest-less directory, which must not wedge resume)."""
+        out = []
+        for d in glob.glob(os.path.join(self._base, "ckpt_v*")):
+            if not glob.glob(os.path.join(d, _MANIFEST_PREFIX + "*.json")):
+                continue
+            try:
+                out.append(int(os.path.basename(d)[len("ckpt_v"):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def latest_dir(self):
+        versions = self.versions()
+        return self._dir_for(versions[-1]) if versions else None

@@ -356,6 +356,29 @@ class ElasticDPTrainer:
         """Adopt a checkpointed host TrainState before establish()."""
         self._host_ts = host_ts
 
+    def save_sharded(self, directory):
+        """Write this process's shards of the train state (no gather)."""
+        from elasticdl_tpu.common.sharded_checkpoint import save_sharded
+
+        save_sharded(directory, self._ts, version=self.version)
+
+    def restore_sharded(self, directory):
+        """Replace the established state with a sharded checkpoint,
+        materialized straight onto the current mesh placement."""
+        from elasticdl_tpu.common.sharded_checkpoint import load_sharded
+
+        shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding, self._ts
+        )
+        version, ts = load_sharded(directory, shardings)
+        self._ts = ts
+        self._checked_ts = ts
+        self._host_ts = host_copy(ts)
+        logger.info(
+            "restored sharded checkpoint v%d from %s", version, directory
+        )
+        return version
+
     def leave(self):
         """Snapshot and leave the world (graceful epoch boundary)."""
         try:

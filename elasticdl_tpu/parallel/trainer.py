@@ -91,15 +91,9 @@ class AllReduceTrainer:
 
     @staticmethod
     def _key_names(key_path):
-        names = []
-        for k in key_path:
-            name = getattr(k, "key", None)
-            if name is None:
-                name = getattr(k, "name", None)
-            if name is None:
-                name = getattr(k, "idx", None)
-            names.append(str(name))
-        return tuple(names)
+        from elasticdl_tpu.common.pytree import key_path_names
+
+        return key_path_names(key_path)
 
     def _place(self, tree):
         """Place a host pytree: leaves whose tree path *ends with* a
@@ -184,3 +178,21 @@ class AllReduceTrainer:
     def get_host_state(self):
         """Pull the train state to host memory (for checkpointing)."""
         return jax.tree_util.tree_map(np.asarray, self._ts)
+
+    def save_sharded(self, directory):
+        """Per-shard checkpoint: HBM-sharded parameters (embedding
+        tables) write one file per device shard — no dense gather."""
+        from elasticdl_tpu.common.sharded_checkpoint import save_sharded
+
+        save_sharded(directory, self._ts, version=self.version)
+
+    def restore_sharded(self, directory):
+        """Restore a sharded checkpoint onto the current placement
+        (state must be initialized first, e.g. via init_from_batch)."""
+        from elasticdl_tpu.common.sharded_checkpoint import load_sharded
+
+        shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding, self._ts
+        )
+        version, self._ts = load_sharded(directory, shardings)
+        return version

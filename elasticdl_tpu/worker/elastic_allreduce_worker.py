@@ -70,6 +70,9 @@ class ElasticAllReduceWorker:
         comm_host=None,
         epoch_poll_secs=10.0,
         sync_every=8,
+        checkpoint_dir="",
+        checkpoint_steps=0,
+        keep_checkpoint_max=0,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -127,6 +130,17 @@ class ElasticAllReduceWorker:
             self._job_type == JobType.TRAINING_WITH_EVALUATION,
             data_reader_params=data_reader_params,
         )
+        self._ckpt = None
+        if checkpoint_dir and checkpoint_steps:
+            from elasticdl_tpu.common.sharded_checkpoint import (
+                ShardedCheckpointManager,
+            )
+
+            self._ckpt = ShardedCheckpointManager(
+                checkpoint_dir, checkpoint_steps, keep_checkpoint_max
+            )
+        self._restore_attempted = False
+        self._last_ckpt_version = 0
         self._batch_gen = None
         self._retry_batch = None
         self._unreported = []  # counts of consumed-but-unvalidated steps
@@ -152,6 +166,14 @@ class ElasticAllReduceWorker:
         means the master has no more training work for this process.
         """
         while True:
+            if self._unreported:
+                # settle the sync window before the round rolls over:
+                # held-back reports keep the finished round's tasks
+                # "pending", which would wedge the next get_dataset
+                ok = self.trainer.validate()
+                self._flush_unreported(
+                    "" if ok else "collective failed before validation"
+                )
             dataset = self._task_data_service.get_dataset()
             if not dataset:
                 return
@@ -241,6 +263,16 @@ class ElasticAllReduceWorker:
             try:
                 example = self._retry_batch or self.trainer._last_local
                 self.trainer.establish(world, example_batch=example)
+                if self._ckpt is not None and not self._restore_attempted:
+                    self._restore_attempted = True
+                    # resume only when the WHOLE world is virgin (the
+                    # broadcast state carries version 0). A fresh process
+                    # joining a live job receives the survivors' state in
+                    # the broadcast; restoring a stale checkpoint over
+                    # just this replica would silently de-synchronize the
+                    # replicated parameters.
+                    if self.trainer.version == 0:
+                        self._restore_latest_checkpoint()
             except WorldBroken:
                 logger.warning(
                     "world %d broke during formation; re-polling", world.epoch
@@ -251,6 +283,24 @@ class ElasticAllReduceWorker:
                 break
         self._finalize()
         return losses
+
+    def _restore_latest_checkpoint(self):
+        """Resume from the newest restorable checkpoint; a partial or
+        corrupt directory falls back to the next-older one instead of
+        crash-looping the worker."""
+        for version in sorted(self._ckpt.versions(), reverse=True):
+            directory = self._ckpt._dir_for(version)
+            try:
+                self.trainer.restore_sharded(directory)
+                self._last_ckpt_version = self.trainer.version
+                return True
+            except Exception:
+                logger.warning(
+                    "checkpoint %s unrestorable; trying older",
+                    directory,
+                    exc_info=True,
+                )
+        return False
 
     def _prime(self):
         """Block until the first local batch is in hand (its shapes gate
@@ -336,6 +386,24 @@ class ElasticAllReduceWorker:
                 self._unreported.append(count)
             if sync:
                 self._flush_unreported()
+                if (
+                    self._ckpt is not None
+                    and world.process_id == 0
+                    and self._ckpt.is_enabled()
+                ):
+                    # checkpoints land at sync points, so the cadence is
+                    # "at least checkpoint_steps versions since the last
+                    # save" rather than an exact modulo (which would
+                    # silently degrade to lcm(sync_every, steps)). Rank 0
+                    # alone suffices on the replicated plane (it holds
+                    # replica 0 of every leaf); pure local writes.
+                    version = self.trainer.version
+                    if (
+                        version - self._last_ckpt_version
+                        >= self._ckpt.steps
+                    ):
+                        self._ckpt.save(self.trainer._ts, version)
+                        self._last_ckpt_version = version
             if n_active == 0:
                 if self._drained:
                     return "done"

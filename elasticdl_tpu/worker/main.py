@@ -48,6 +48,9 @@ def main():
                 args.data_reader_params
             ),
             comm_host=args.comm_host or None,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_steps=args.checkpoint_steps,
+            keep_checkpoint_max=args.keep_checkpoint_max,
         ).run()
         return 0
 

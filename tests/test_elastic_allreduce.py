@@ -221,7 +221,7 @@ def _master_for(data_dir, num_workers, num_epochs=2, extra=()):
     return master
 
 
-def _worker_command_for(master):
+def _worker_command_for(master, extra=()):
     def worker_command(worker_id):
         return [
             sys.executable,
@@ -243,7 +243,7 @@ def _worker_command_for(master):
             "AllreduceStrategy",
             "--comm_host",
             "localhost",
-        ]
+        ] + list(extra)
 
     return worker_command
 
@@ -341,3 +341,81 @@ def test_elastic_allreduce_survives_worker_kill(tmp_path):
     # 384*2 records / 64 records-per-task = 12 tasks)
     assert len(set(completed)) == 12
     manager.stop_relaunch_and_remove_all_pods()
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_resumes_from_sharded_checkpoint(tmp_path):
+    """Job 1 writes sharded checkpoints; job 2 (fresh master + fresh
+    workers, same checkpoint dir) must resume from them — its exported
+    model version continues past job 1's steps instead of restarting."""
+    from elasticdl_tpu.common.model_utils import load_from_checkpoint_file
+    from elasticdl_tpu.common.sharded_checkpoint import (
+        ShardedCheckpointManager,
+    )
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    create_recordio_file(
+        256, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(data_dir)
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+    out_dir = str(tmp_path / "export")
+
+    def run_job():
+        master = _master_for(
+            str(data_dir),
+            num_workers=2,
+            num_epochs=1,
+            extra=[
+                "--checkpoint_dir",
+                ckpt_dir,
+                "--checkpoint_steps",
+                "4",
+                "--output",
+                out_dir,
+            ],
+        )
+        manager = LocalInstanceManager(
+            master.task_d,
+            2,
+            _worker_command_for(
+                master,
+                extra=[
+                    "--checkpoint_dir",
+                    ckpt_dir,
+                    "--checkpoint_steps",
+                    "4",
+                ],
+            ),
+            env=_worker_env(),
+            membership=master.membership,
+        )
+        master.instance_manager = manager
+        manager.start_workers()
+        runner = threading.Thread(
+            target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+        )
+        runner.start()
+        runner.join(timeout=300)
+        assert not runner.is_alive(), "master did not finish"
+        assert master.task_d.finished()
+        manager.stop_relaunch_and_remove_all_pods()
+
+    run_job()
+    mgr = ShardedCheckpointManager(ckpt_dir)
+    v1 = mgr.versions()
+    assert v1, "job 1 wrote no sharded checkpoints"
+
+    run_job()
+    v2 = mgr.versions()
+    # job 2 resumed: its checkpoints continue past job 1's last version
+    assert max(v2) > max(v1), (v1, v2)
+    # and the exported model's version reflects the resumed counter
+    exports = []
+    for root, _, files in os.walk(out_dir):
+        for f in files:
+            if f.endswith(".chkpt"):
+                exports.append(os.path.join(root, f))
+    assert exports
+    versions = [load_from_checkpoint_file(p)[0] for p in exports]
+    assert max(versions) > max(v1), (versions, v1)
