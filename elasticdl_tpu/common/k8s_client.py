@@ -150,7 +150,7 @@ class Client:
             return self.client.patch_namespaced_pod(
                 name=pod_name, namespace=self.namespace, body=body
             )
-        except k8s_client.api_client.ApiException as e:
+        except k8s_client.rest.ApiException as e:
             logger.warning("Exception when patching labels to pod: %s" % e)
             return None
 
@@ -160,7 +160,7 @@ class Client:
             return self.client.read_namespaced_pod(
                 name=name, namespace=self.namespace
             )
-        except k8s_client.api_client.ApiException as e:
+        except k8s_client.rest.ApiException as e:
             logger.warning("Exception when reading pod %s: %s" % (name, e))
             return None
 
@@ -180,7 +180,7 @@ class Client:
                 name=self.get_ps_service_name(ps_id),
                 namespace=self.namespace,
             )
-        except k8s_client.api_client.ApiException as e:
+        except k8s_client.rest.ApiException as e:
             logger.warning("Exception when reading PS service: %s" % e)
             return None
 

@@ -158,22 +158,21 @@ class AllReduceTrainer:
         """Membership change: rebuild the mesh and re-place state.
 
         Survivor state is the source of truth (replaces the reference's
-        re-push-from-workers PS re-init, ps/servicer.py:70-79): parameters
-        are pulled to host from the old placement and re-replicated onto
-        the new mesh.
+        re-push-from-workers PS re-init, ps/servicer.py:70-79). The
+        re-placement is a direct ``device_put`` from the old placement to
+        the new mesh's shardings — the runtime moves buffers
+        device-to-device (ICI/DMA) where it can, instead of a forced
+        full HBM -> host -> HBM round trip of every parameter.
         """
-        if self._ts is not None:
-            host_ts = jax.tree_util.tree_map(np.asarray, self._ts)
-        else:
-            host_ts = None
+        old_ts = self._ts
         self._mesh = create_mesh(devices=devices)
         logger.info(
             "membership epoch: mesh re-formed over %d devices",
             self.num_devices,
         )
-        if host_ts is not None:
+        if old_ts is not None:
             self._sharded_paths = self._collect_sharded_paths()
-            self._ts = self._place(host_ts)
+            self._ts = self._place(old_ts)
 
     def get_host_state(self):
         """Pull the train state to host memory (for checkpointing)."""

@@ -1,0 +1,294 @@
+"""k8s client + TensorBoard client + image builder against fake SDKs.
+
+The reference gates its k8s tests on a live minikube and never tests the
+image builder at all; faking the `kubernetes` and `docker` modules makes
+pod/service construction, the label scheme, the tpu resource mapping,
+the cluster-spec plugin, TB service exposure, and the docker build/push
+flow all hermetically testable (round-1 verdict: ~700 untested lines).
+"""
+
+import os
+import sys
+import types
+from types import SimpleNamespace
+
+import pytest
+
+
+class _KubeObj(SimpleNamespace):
+    """Any V1* object: a namespace recording its constructor kwargs."""
+
+
+class _FakeApiException(Exception):
+    pass
+
+
+class _FakeKubeClient(types.ModuleType):
+    def __getattr__(self, name):
+        if name == "CoreV1Api":
+            return lambda: _CORE[0]
+        if name == "rest":
+            return SimpleNamespace(ApiException=_FakeApiException)
+
+        def ctor(*args, **kwargs):
+            return _KubeObj(_kind=name, **kwargs)
+
+        return ctor
+
+
+class FakeCoreV1Api:
+    def __init__(self):
+        self.pods = {}
+        self.services = {}
+
+    def create_namespaced_pod(self, namespace, pod):
+        self.pods[pod.metadata.name] = pod
+        return pod
+
+    def create_namespaced_service(self, namespace, service):
+        self.services[service.metadata.name] = service
+        return service
+
+    def read_namespaced_pod(self, name, namespace):
+        if name not in self.pods:
+            raise _FakeApiException(name)
+        return self.pods[name]
+
+    def read_namespaced_service(self, name, namespace):
+        if name not in self.services:
+            raise _FakeApiException(name)
+        return self.services[name]
+
+    def delete_namespaced_pod(self, name, namespace, body=None):
+        self.pods.pop(name, None)
+
+
+_CORE = [None]
+
+
+@pytest.fixture
+def fake_kube(monkeypatch):
+    _CORE[0] = FakeCoreV1Api()
+    kube = types.ModuleType("kubernetes")
+    kube.client = _FakeKubeClient("kubernetes.client")
+    config = types.ModuleType("kubernetes.config")
+    config.load_kube_config = lambda: None
+    config.load_incluster_config = lambda: None
+    kube.config = config
+    watch = types.ModuleType("kubernetes.watch")
+    kube.watch = watch
+    monkeypatch.setitem(sys.modules, "kubernetes", kube)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", kube.client)
+    monkeypatch.setitem(sys.modules, "kubernetes.config", config)
+    monkeypatch.setitem(sys.modules, "kubernetes.watch", watch)
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    return _CORE[0]
+
+
+def _client(**kw):
+    from elasticdl_tpu.common.k8s_client import Client
+
+    kw.setdefault("image_name", "img:latest")
+    kw.setdefault("namespace", "default")
+    kw.setdefault("job_name", "job1")
+    return Client(**kw)
+
+
+def test_worker_pod_labels_resources_and_tpu_mapping(fake_kube):
+    c = _client()
+    # master pod must exist for owner references
+    fake_kube.pods["elasticdl-job1-master"] = _KubeObj(
+        kind="Pod",
+        api_version="v1",
+        metadata=_KubeObj(name="elasticdl-job1-master", uid="u1"),
+    )
+    pod = c.create_worker(
+        worker_id=3,
+        resource_requests="cpu=2,memory=1024Mi,tpu=4",
+        resource_limits="",
+        pod_priority="",
+        volume="",
+        image_pull_policy="Always",
+        command=["python"],
+        args=["-m", "x"],
+        restart_policy="Never",
+        envs=None,
+    )
+    labels = pod.metadata.labels
+    assert labels["elasticdl-job-name"] == "job1"
+    assert labels["elasticdl-replica-type"] == "worker"
+    assert labels["elasticdl-replica-index"] == "3"
+    container = pod.spec.containers[0]
+    req = container.resources.requests
+    assert req["google.com/tpu"] == "4" and "tpu" not in req
+    assert req["cpu"] == "2"
+    # owner reference ties the pod to the master for cascade deletion
+    assert pod.metadata.owner_references[0].name == "elasticdl-job1-master"
+
+
+def test_ps_service_has_stable_dns_and_selector(fake_kube):
+    c = _client()
+    fake_kube.pods["elasticdl-job1-master"] = _KubeObj(
+        kind="Pod",
+        api_version="v1",
+        metadata=_KubeObj(name="elasticdl-job1-master", uid="u1"),
+    )
+    c.create_ps(
+        ps_id=1,
+        resource_requests="cpu=1,memory=512Mi",
+        resource_limits="",
+        pod_priority="",
+        volume="",
+        image_pull_policy="Always",
+        command=["python"],
+        args=[],
+        restart_policy="Never",
+        envs=None,
+    )
+    c.create_ps_service(1)
+    addr = c.get_ps_service_address(1)
+    name = addr.split(":")[0].split(".")[0]
+    svc = fake_kube.services[name]
+    assert svc.spec.selector["elasticdl-replica-type"] == "ps"
+    assert svc.spec.selector["elasticdl-replica-index"] == "1"
+    # relaunch keeps the same service name (stable DNS)
+    assert c.get_ps_service_address(1) == addr
+
+
+def test_cluster_spec_plugin_rewrites_pods(fake_kube, tmp_path):
+    plugin = tmp_path / "cluster_spec.py"
+    plugin.write_text(
+        "class _C:\n"
+        "    def with_pod(self, pod):\n"
+        "        pod.metadata.labels['patched'] = 'yes'\n"
+        "        return pod\n"
+        "    def with_service(self, service):\n"
+        "        return service\n"
+        "cluster = _C()\n"
+    )
+    c = _client(cluster_spec=str(plugin))
+    fake_kube.pods["elasticdl-job1-master"] = _KubeObj(
+        kind="Pod",
+        api_version="v1",
+        metadata=_KubeObj(name="elasticdl-job1-master", uid="u1"),
+    )
+    pod = c.create_worker(
+        worker_id=0,
+        resource_requests="cpu=1,memory=1Mi",
+        resource_limits="",
+        pod_priority="",
+        volume="",
+        image_pull_policy="Always",
+        command=["python"],
+        args=[],
+        restart_policy="Never",
+        envs=None,
+    )
+    assert pod.metadata.labels["patched"] == "yes"
+
+
+def test_tensorboard_service_targets_master(fake_kube):
+    from elasticdl_tpu.common.k8s_tensorboard_client import TensorBoardClient
+
+    fake_kube.pods["elasticdl-job1-master"] = _KubeObj(
+        kind="Pod",
+        api_version="v1",
+        metadata=_KubeObj(name="elasticdl-job1-master", uid="u1"),
+    )
+    tb = TensorBoardClient(
+        image_name="img", namespace="default", job_name="job1"
+    )
+    tb.create_tensorboard_service()
+    svc = fake_kube.services["tensorboard-job1"]
+    assert svc.spec.type == "LoadBalancer"
+    assert svc.spec.selector["elasticdl-replica-type"] == "master"
+    assert svc.spec.ports[0].target_port == 6006
+
+
+# -- image builder -----------------------------------------------------------
+
+
+class FakeDockerAPIClient:
+    instances = []
+
+    def __init__(self, base_url=None, tls=None):
+        self.base_url = base_url
+        self.built = []
+        self.pushed = []
+        self.context = None
+        FakeDockerAPIClient.instances.append(self)
+
+    def build(self, path=None, tag=None, decode=True, rm=True):
+        self.context = sorted(
+            os.path.relpath(os.path.join(root, f), path)
+            for root, _, files in os.walk(path)
+            for f in files
+        )
+        self.built.append(tag)
+        yield {"stream": "Step 1/5 : FROM python\n"}
+
+    def push(self, tag, stream=True, decode=True):
+        self.pushed.append(tag)
+        yield {"status": "pushed"}
+
+
+@pytest.fixture
+def fake_docker(monkeypatch):
+    mod = types.ModuleType("docker")
+    mod.APIClient = FakeDockerAPIClient
+    mod.tls = types.SimpleNamespace(
+        TLSConfig=lambda client_cert: client_cert
+    )
+    monkeypatch.setitem(sys.modules, "docker", mod)
+    FakeDockerAPIClient.instances = []
+    return mod
+
+
+def test_image_build_context_and_push(fake_docker, tmp_path):
+    from elasticdl_tpu.image_builder import build_and_push_docker_image
+
+    zoo = tmp_path / "zoo"
+    (zoo / "m").mkdir(parents=True)
+    (zoo / "m" / "model.py").write_text("x = 1\n")
+    image = build_and_push_docker_image(
+        model_zoo=str(zoo), docker_image_repository="reg.example.com/team"
+    )
+    assert image.startswith("reg.example.com/team/elasticdl:")
+    client = FakeDockerAPIClient.instances[-1]
+    assert client.built == [image] and client.pushed == [image]
+    # the build context embeds the framework, the user zoo, a Dockerfile
+    assert "Dockerfile" in client.context
+    assert "model_zoo/m/model.py" in client.context
+    assert any(
+        f.startswith("framework/elasticdl_tpu/") for f in client.context
+    )
+
+
+def test_image_build_error_propagates(fake_docker, tmp_path, monkeypatch):
+    from elasticdl_tpu.image_builder import build_and_push_docker_image
+
+    def failing_build(self, **kw):
+        yield {"error": "no space left"}
+
+    monkeypatch.setattr(FakeDockerAPIClient, "build", failing_build)
+    zoo = tmp_path / "zoo"
+    zoo.mkdir()
+    with pytest.raises(RuntimeError, match="no space left"):
+        build_and_push_docker_image(
+            model_zoo=str(zoo), docker_image_repository="r"
+        )
+
+
+def test_dockerfile_generation_variants():
+    from elasticdl_tpu.image_builder import _generate_dockerfile
+
+    plain = _generate_dockerfile("")
+    assert plain.startswith("FROM python:3.11")
+    assert "cluster_spec" not in plain
+    full = _generate_dockerfile(
+        "my/base:1", extra_pypi_index="http://pypi.internal",
+        cluster_spec="spec.py",
+    )
+    assert "FROM my/base:1" in full
+    assert "--extra-index-url http://pypi.internal" in full
+    assert "COPY cluster_spec /cluster_spec" in full

@@ -1,0 +1,268 @@
+"""End-to-end sparse-path correctness (reference
+optimizer_wrapper_test.py:576-812 pattern): a full elastic-embedding
+training job through the master store must produce the same weights as
+plain dense training on the identical batch stream — sync AND async —
+and the sparse path must survive a PS kill mid-job.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from elasticdl_tpu.common.constants import JobType
+from elasticdl_tpu.data.example import encode_example
+from elasticdl_tpu.data.recordio import RecordIOWriter
+from elasticdl_tpu.master.checkpoint_service import CheckpointService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.nn.model_api import init_variables, split_variables
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+from elasticdl_tpu.training.step import TrainState, make_train_step
+from elasticdl_tpu.worker.worker import Worker
+from model_zoo.deepfm_edl_embedding import deepfm_edl_embedding as zoo
+from tests.in_process_master import InProcessMaster
+from tests.test_utils import MODEL_ZOO_PATH
+
+VOCAB = 60
+DIM = 8
+LR = 0.1
+BATCH = 16
+RECORDS = 64
+EPOCHS = 2
+
+
+@pytest.fixture
+def fixed_data(tmp_path):
+    """Deterministic frappe-style records; ids < VOCAB."""
+    rng = np.random.default_rng(5)
+    path = str(tmp_path / "sparse.edlr")
+    records = []
+    with RecordIOWriter(path) as w:
+        for _ in range(RECORDS):
+            ids = rng.integers(0, VOCAB, size=(10,)).astype(np.int64)
+            label = np.array([rng.integers(0, 2)], np.int64)
+            records.append((ids, label))
+            w.write(encode_example({"feature": ids, "label": label}))
+    return path, records
+
+
+@pytest.fixture
+def no_shuffle(monkeypatch):
+    """Deterministic batch order: identical for both trainings."""
+    from elasticdl_tpu.data.dataset import Dataset
+
+    monkeypatch.setattr(Dataset, "shuffle", lambda self, *a, **k: self)
+
+
+def _run_elastic_job(data_file, use_async):
+    """Train deepfm through the elastic-embedding master store; returns
+    (initial_rows, final_rows, initial_dense, final_dense)."""
+    task_d = TaskDispatcher(
+        {data_file: (0, RECORDS)}, {}, {}, RECORDS, EPOCHS
+    )
+    master = MasterServicer(
+        1,
+        BATCH,
+        optax.sgd(LR),
+        task_d,
+        checkpoint_service=CheckpointService("", 0, 0, False),
+        use_async=use_async,
+    )
+    # pre-init every row so the initial tables are observable (lazy init
+    # would otherwise interleave with training)
+    master.push_embedding_info(
+        [
+            EmbeddingTableInfo("embedding", DIM, "uniform"),
+            EmbeddingTableInfo("id_bias", 1, "uniform"),
+        ]
+    )
+    all_ids = np.arange(VOCAB)
+    init_rows = {
+        "embedding": master.pull_embedding_vectors(
+            "embedding", all_ids
+        ).copy(),
+        "id_bias": master.pull_embedding_vectors("id_bias", all_ids).copy(),
+    }
+    worker = Worker(
+        worker_id=1,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=BATCH,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=(
+            "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+        ),
+        model_params="embedding_dim=%d,fc_unit=8" % DIM,
+        stub=None,
+    )
+    worker._stub = InProcessMaster(master)
+    worker.run()
+    assert task_d.finished()
+    final_rows = {
+        "embedding": master.pull_embedding_vectors("embedding", all_ids),
+        "id_bias": master.pull_embedding_vectors("id_bias", all_ids),
+    }
+    _, final_dense = master.get_model(master.get_model_version())
+    return init_rows, final_rows, final_dense
+
+
+def _run_dense_twin(records, init_rows):
+    """Plain dense training (jnp.take tables) on the identical batches."""
+    model = zoo.DeepFMEdl(
+        embedding_dim=DIM, fc_unit=8, vocab_size=VOCAB, force_hbm=True
+    )
+    first = {"feature": np.stack([r[0] for r in records[:1]])}
+    variables = init_variables(model, jax.random.PRNGKey(0), first)
+    params, state = split_variables(variables)
+    params["embedding"]["table"] = init_rows["embedding"].astype(
+        np.float32
+    )
+    params["id_bias"]["table"] = init_rows["id_bias"].astype(np.float32)
+    opt = optax.sgd(LR)
+    ts = TrainState.create(params, state, opt)
+    step = make_train_step(model, zoo.loss, opt)
+    key = jax.random.PRNGKey(9)
+    for _ in range(EPOCHS):
+        for i in range(0, RECORDS, BATCH):
+            chunk = records[i : i + BATCH]
+            feats = {"feature": np.stack([r[0] for r in chunk])}
+            labels = np.stack([r[1] for r in chunk])
+            ts, _ = step(ts, feats, labels, key)
+    return jax.tree_util.tree_map(np.asarray, ts.params)
+
+
+@pytest.mark.parametrize("use_async", [False, True])
+def test_elastic_embedding_training_matches_dense(
+    fixed_data, no_shuffle, use_async
+):
+    data_file, records = fixed_data
+    init_rows, final_rows, final_dense = _run_elastic_job(
+        data_file, use_async
+    )
+    twin = _run_dense_twin(records, init_rows)
+
+    np.testing.assert_allclose(
+        final_rows["embedding"],
+        twin["embedding"]["table"],
+        rtol=2e-4,
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        final_rows["id_bias"],
+        twin["id_bias"]["table"],
+        rtol=2e-4,
+        atol=2e-5,
+    )
+    # dense (non-embedding) weights agree as well
+    twin_flat = {
+        "/".join(p): v
+        for p, v in (
+            (
+                [str(getattr(k, "key", getattr(k, "name", "?"))) for k in kp],
+                np.asarray(leaf),
+            )
+            for kp, leaf in jax.tree_util.tree_flatten_with_path(twin)[0]
+        )
+    }
+    for name, value in final_dense.items():
+        match = [
+            v for k, v in twin_flat.items() if k == name or name in k
+        ]
+        assert match, (name, list(twin_flat))
+        np.testing.assert_allclose(
+            value, match[0], rtol=2e-4, atol=2e-5, err_msg=name
+        )
+
+
+def test_ps_kill_mid_job_sparse_path(fixed_data, no_shuffle):
+    """Sparse training over a real-gRPC PS fleet survives killing and
+    relaunching a PS shard mid-job (reference
+    worker_ps_interaction_test.py:84-91, extended to the sparse path).
+    Embedding rows on the dead shard are lost and lazily re-initialize —
+    the reference's exact semantics (its replicated-PS design was never
+    built)."""
+    from elasticdl_tpu.ps.parameter_server import ParameterServer
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from tests.test_utils import PserverArgs
+
+    data_file, _ = fixed_data
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+
+    def start_ps(ps_id, port=0):
+        args = PserverArgs(
+            grads_to_wait=1,
+            use_async=True,
+            port=port,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def=model_def,
+        )
+        args.ps_id = ps_id
+        args.lr_staleness_modulation = False
+        ps = ParameterServer(args)
+        ps.prepare()
+        return ps, ps._server._edl_port
+
+    servers, addrs = [], []
+    for ps_id in range(2):
+        ps, port = start_ps(ps_id)
+        servers.append(ps)
+        addrs.append("localhost:%d" % port)
+
+    task_d = TaskDispatcher(
+        {data_file: (0, RECORDS)}, {}, {}, BATCH, EPOCHS
+    )
+    master = MasterServicer(
+        1,
+        BATCH,
+        None,  # params live on the PS fleet
+        task_d,
+        checkpoint_service=CheckpointService("", 0, 0, False),
+        use_async=True,
+    )
+    worker = Worker(
+        worker_id=1,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=BATCH,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=model_def,
+        model_params="embedding_dim=%d,fc_unit=8" % DIM,
+        ps_client=PSClient([BoundPS(a) for a in addrs]),
+    )
+    worker._stub = InProcessMaster(master)
+
+    # kill + relaunch PS 1 (same port = same stable address) after the
+    # first few batches, from a callback on the worker's report path
+    state = {"reports": 0, "killed": False}
+    orig_report = worker.report_gradient
+
+    def report_and_kill(*a, **k):
+        out = orig_report(*a, **k)
+        state["reports"] += 1
+        if state["reports"] == 3 and not state["killed"]:
+            state["killed"] = True
+            port = int(addrs[1].split(":")[1])
+            servers[1].stop()
+            ps, _ = start_ps(1, port=port)
+            servers[1] = ps
+        return out
+
+    worker.report_gradient = report_and_kill
+    try:
+        worker.run()
+        assert state["killed"], "kill never triggered"
+        assert task_d.finished()
+        # dense params were re-pushed to the fresh shard and training
+        # continued: both shards hold initialized state again
+        total_dense = sum(
+            len(ps.parameters.non_embedding_params) for ps in servers
+        )
+        assert total_dense > 0
+        rows = worker._ps_client.pull_embedding_vectors(
+            "embedding", np.arange(VOCAB)
+        )
+        assert rows.shape == (VOCAB, DIM)
+        assert np.isfinite(rows).all()
+    finally:
+        for ps in servers:
+            ps.stop()
