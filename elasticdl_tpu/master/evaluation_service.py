@@ -1,20 +1,24 @@
-"""Evaluation job management on the master.
+"""Evaluation rounds on the master.
 
-Parity: reference master/evaluation_service.py — an ``_EvaluationJob``
-accumulates metrics over worker-reported model outputs + labels for one
-pinned (checkpointed) model version; evaluation tasks are created either on
-a timer thread (``_EvaluationTrigger``) or every ``eval_steps`` model
-versions; the evaluated snapshot is an *eval checkpoint* so training racing
-ahead never contaminates the metrics.
+Role parity with the reference's evaluation service: workers report raw
+model outputs + labels for a *pinned* (checkpointed) model version and
+the master aggregates metrics, so training racing ahead never
+contaminates a round; rounds start either from a timer (time-based) or
+every ``eval_steps`` model versions (step-based).
 
-Metric objects come from ``eval_metrics_fn`` of the model-zoo module;
-plain callables are normalized to Mean-aggregated metrics
-(elasticdl_tpu/metrics/as_metric), mirroring keras MeanMetricWrapper.
+Internals here are organized differently from the reference: metric
+aggregation lives in a flat :class:`MetricsAccumulator` (normalized once
+into (output, name, metric) triples), rounds are plain state on the
+service guarded by one lock, and the timer is a generic
+:class:`PeriodicTrigger` utility. Metric objects come from the model
+zoo's ``eval_metrics_fn``; bare callables are wrapped into
+Mean-aggregated metrics (elasticdl_tpu/metrics/as_metric), mirroring
+keras MeanMetricWrapper.
 """
 
 import threading
 import time
-from threading import Thread
+from collections import deque
 
 import numpy as np
 
@@ -23,109 +27,107 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.metrics import Metric, as_metric
 
 
-class _EvaluationJob:
-    """One evaluation round over a pinned model version."""
+class MetricsAccumulator:
+    """Streaming metric aggregation over worker-reported batches.
 
-    def __init__(self, metrics_dict, model_version, total_tasks=-1):
-        self.model_version = model_version
-        self._total_tasks = total_tasks
-        self._completed_tasks = 0
-        self._init_metrics_dict(metrics_dict)
+    Accepts either ``{metric_name: metric}`` (single-output models, keyed
+    under MetricsDictKey.MODEL_OUTPUT) or ``{output_name: {name: metric}}``
+    and normalizes both into a flat triple list up front.
+    """
 
-    def _init_metrics_dict(self, metrics_dict):
-        if not metrics_dict:
+    def __init__(self, metrics_spec):
+        if not metrics_spec:
             raise ValueError(
                 "Evaluation metrics dictionary must not be empty."
             )
-        first = next(iter(metrics_dict.values()))
-        if isinstance(first, dict):
-            # multi-output model: {output_name: {metric_name: metric}}
-            self._model_have_multiple_outputs = True
-            self._metrics_dict = metrics_dict
-        else:
-            self._model_have_multiple_outputs = False
-            self._metrics_dict = {MetricsDictKey.MODEL_OUTPUT: metrics_dict}
-        for metrics in self._metrics_dict.values():
-            for name in list(metrics):
-                if not isinstance(metrics[name], Metric):
-                    metrics[name] = as_metric(name, metrics[name])
+        self.nested = isinstance(next(iter(metrics_spec.values())), dict)
+        spec = (
+            metrics_spec
+            if self.nested
+            else {MetricsDictKey.MODEL_OUTPUT: metrics_spec}
+        )
+        self._triples = []
+        for output_key, metrics in spec.items():
+            for name, metric in metrics.items():
+                if not isinstance(metric, Metric):
+                    metric = as_metric(name, metric)
+                self._triples.append((output_key, name, metric))
 
-    def complete_task(self):
-        self._completed_tasks += 1
-
-    def finished(self):
-        return self._completed_tasks >= self._total_tasks
-
-    def report_evaluation_metrics(
-        self, evaluation_version, model_outputs, labels
-    ):
-        """model_outputs: {output_name: ndarray}; labels: ndarray."""
-        if (
-            self.model_version >= 0
-            and evaluation_version != self.model_version
-        ):
-            logger.error(
-                "Drop a wrong version evaluation: request %d, receive %d"
-                % (self.model_version, evaluation_version)
-            )
-            return False
+    def update(self, model_outputs, labels):
         labels = np.asarray(labels)
-        for key, outputs in model_outputs.items():
-            metrics = self._metrics_dict.get(key)
-            if not metrics:
-                continue
-            outputs = np.asarray(outputs)
-            for metric_inst in metrics.values():
-                metric_inst.update_state(labels, outputs)
-        return True
+        for output_key, _, metric in self._triples:
+            outputs = model_outputs.get(output_key)
+            if outputs is not None:
+                metric.update_state(labels, np.asarray(outputs))
 
-    def get_evaluation_summary(self):
-        if self._model_have_multiple_outputs:
-            return {
-                output_name: {
-                    name: metric.result() for name, metric in metrics.items()
-                }
-                for output_name, metrics in self._metrics_dict.items()
-            }
+    def summary(self):
+        if self.nested:
+            out = {}
+            for output_key, name, metric in self._triples:
+                out.setdefault(output_key, {})[name] = metric.result()
+            return out
         return {
-            name: metric.result()
-            for name, metric in self._metrics_dict[
-                MetricsDictKey.MODEL_OUTPUT
-            ].items()
+            name: metric.result() for _, name, metric in self._triples
         }
 
 
-class _EvaluationTrigger(Thread):
-    """Generates time-based evaluation tasks (reference :108-140)."""
+class _EvaluationJob:
+    """One round: a pinned version + its accumulator + task countdown."""
 
-    def __init__(self, eval_service, start_delay_secs, throttle_secs):
-        Thread.__init__(self, daemon=True)
-        self._eval_service = eval_service
-        self._stopper = threading.Event()
-        self._throttle_secs = throttle_secs
-        self._eval_min_time = time.time() + start_delay_secs
+    def __init__(self, metrics_dict, model_version, total_tasks=-1):
+        self.model_version = model_version
+        self._remaining = total_tasks
+        self._acc = MetricsAccumulator(metrics_dict)
 
-    def stop(self):
-        self._stopper.set()
+    def complete_task(self):
+        self._remaining -= 1
 
-    def _wait_enough_time(self, cur_time_secs, previous_round_start_secs):
-        if cur_time_secs < self._eval_min_time:
+    def finished(self):
+        return self._remaining <= 0
+
+    def report_evaluation_metrics(self, version, model_outputs, labels):
+        if self.model_version >= 0 and version != self.model_version:
+            logger.error(
+                "Drop a wrong version evaluation: request %d, receive %d"
+                % (self.model_version, version)
+            )
             return False
-        if (
-            previous_round_start_secs != -1
-            and cur_time_secs - previous_round_start_secs < self._throttle_secs
-        ):
-            return False
+        self._acc.update(model_outputs, labels)
         return True
 
-    def run(self):
-        previous_round_start_secs = -1
-        while not self._stopper.is_set():
-            time_now = time.time()
-            if self._wait_enough_time(time_now, previous_round_start_secs):
-                self._eval_service.add_evaluation_task(is_time_based_eval=True)
-                previous_round_start_secs = time_now
-            self._stopper.wait(5)
+    def get_evaluation_summary(self):
+        return self._acc.summary()
+
+
+class PeriodicTrigger:
+    """Fire ``fn`` at most once per ``interval_secs``, starting after
+    ``delay_secs``; 5 s poll granularity, stoppable."""
+
+    def __init__(self, fn, delay_secs, interval_secs, poll_secs=5):
+        self._fn = fn
+        self._not_before = time.time() + delay_secs
+        self._interval = interval_secs
+        self._poll = poll_secs
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        last_fired = None
+        while not self._stop.is_set():
+            now = time.time()
+            due = now >= self._not_before and (
+                last_fired is None or now - last_fired >= self._interval
+            )
+            if due:
+                self._fn()
+                last_fired = now
+            self._stop.wait(self._poll)
 
 
 class EvaluationService:
@@ -143,39 +145,58 @@ class EvaluationService:
         self._checkpoint_service = checkpoint_service
         self._tensorboard_service = tensorboard_service
         self._task_d = task_d
-        self._lock = threading.Lock()
-        self._eval_job = None
-        self.trigger = _EvaluationTrigger(
-            self, start_delay_secs, throttle_secs
-        )
-        self._time_based_eval = throttle_secs > 0
-        self._eval_steps = eval_steps
-        self._eval_checkpoint_versions = []
-        self._last_eval_checkpoint_version = -1
-        self._eval_only = eval_only
         self._eval_metrics_fn = eval_metrics_fn
+        self._eval_steps = eval_steps
+        self._eval_only = eval_only
         self._master_servicer = None
 
+        self._lock = threading.Lock()
+        self._round = None  # the running _EvaluationJob, if any
+        self._pending_versions = deque()  # checkpointed, awaiting a round
+        self._last_snapshot_version = -1
+
+        self._timer = (
+            PeriodicTrigger(
+                lambda: self.add_evaluation_task(is_time_based_eval=True),
+                start_delay_secs,
+                throttle_secs,
+            )
+            if throttle_secs > 0 and not eval_only
+            else None
+        )
+        # None when time-based eval is off (throttle_secs<=0 or eval_only)
+        self.trigger = self._timer
+
     def start(self):
-        if self._time_based_eval and not self._eval_only:
-            self.trigger.start()
+        if self._timer:
+            self._timer.start()
 
     def stop(self):
-        if self._time_based_eval and not self._eval_only:
-            self.trigger.stop()
+        if self._timer:
+            self._timer.stop()
 
     def set_master_servicer(self, master_servicer):
         self._master_servicer = master_servicer
 
+    # -- round creation ------------------------------------------------------
+
     def init_eval_only_job(self, num_task):
-        self._eval_job = _EvaluationJob(self._eval_metrics_fn(), -1, num_task)
+        self._round = _EvaluationJob(self._eval_metrics_fn(), -1, num_task)
+
+    def add_evaluation_task_if_needed(self, master_locking):
+        """Step-based trigger: a round every ``eval_steps`` versions."""
+        version = self._master_servicer.get_model_version()
+        if self._eval_steps and version % self._eval_steps == 0:
+            self.add_evaluation_task(
+                is_time_based_eval=False, master_locking=master_locking
+            )
 
     def add_evaluation_task(self, is_time_based_eval, master_locking=True):
-        """Checkpoint the current model and queue an eval round on it.
+        """Snapshot the current model and queue a round on it.
 
-        The version guard, the eval-checkpoint write, and the guard update
-        all run under the master servicer's model lock so the time-based
-        trigger thread and the step-based path (gradient threads, which
+        The version guard, the eval-checkpoint write, and the guard
+        update all run under the master servicer's model lock so the
+        timer thread and the step-based path (gradient threads, which
         already hold that lock and pass master_locking=False) can't both
         pass the guard for the same version and queue duplicate rounds.
         Reusing the servicer's lock — rather than a second lock — keeps a
@@ -185,87 +206,83 @@ class EvaluationService:
             return
         if master_locking:
             with self._master_servicer.lock:
-                queued = self._checkpoint_for_eval_locked()
+                queued = self._snapshot_model_locked()
         else:
-            queued = self._checkpoint_for_eval_locked()
+            queued = self._snapshot_model_locked()
         if queued:
             self.try_to_create_new_job()
 
-    def _checkpoint_for_eval_locked(self):
-        """Guard + eval-checkpoint; caller holds the master model lock."""
-        model_version = self._master_servicer.get_model_version()
-        if model_version == self._last_eval_checkpoint_version:
+    def _snapshot_model_locked(self):
+        """Pin the model into an eval checkpoint (master lock held)."""
+        version = self._master_servicer.get_model_version()
+        if version == self._last_snapshot_version:
             return False
-        checkpoint_version = self._master_servicer.save_eval_checkpoint(
-            locking=False
-        )
-        if checkpoint_version is None:
-            # checkpoint write failed; do not queue an eval round on it
-            return False
+        snapshot = self._master_servicer.save_eval_checkpoint(locking=False)
+        if snapshot is None:
+            return False  # write failed: nothing to evaluate against
         with self._lock:
-            self._eval_checkpoint_versions.append(checkpoint_version)
-        self._last_eval_checkpoint_version = checkpoint_version
+            self._pending_versions.append(snapshot)
+        self._last_snapshot_version = snapshot
         return True
 
     def try_to_create_new_job(self):
-        """Start the next queued eval round if none is running."""
+        """Promote the oldest pending snapshot to the running round."""
         with self._lock:
-            if self._eval_job is None and self._eval_checkpoint_versions:
-                checkpoint_version = self._eval_checkpoint_versions.pop(0)
-                # create the job BEFORE publishing tasks so a fast worker
-                # can never complete a task while _eval_job is None, and
-                # count tasks from create_tasks' return (reading _eval_todo
-                # after publication is racy with concurrent get_eval_task)
-                task_count = self._task_d.count_tasks(TaskType.EVALUATION)
-                self._eval_job = _EvaluationJob(
-                    self._eval_metrics_fn(), checkpoint_version, task_count
-                )
-                self._task_d.create_tasks(
-                    TaskType.EVALUATION, checkpoint_version
-                )
-                return True
-        return False
-
-    def add_evaluation_task_if_needed(self, master_locking):
-        """Step-based evaluation trigger (reference :223-231)."""
-        model_version = self._master_servicer.get_model_version()
-        if self._eval_steps and model_version % self._eval_steps == 0:
-            self.add_evaluation_task(
-                is_time_based_eval=False, master_locking=master_locking
+            if self._round is not None or not self._pending_versions:
+                return False
+            version = self._pending_versions.popleft()
+            # publish the round BEFORE its tasks so a fast worker can
+            # never complete a task while no round exists; the task count
+            # comes from the dispatcher's pre-publication count (reading
+            # the queue after publication races concurrent get_eval_task)
+            task_count = self._task_d.count_tasks(TaskType.EVALUATION)
+            self._round = _EvaluationJob(
+                self._eval_metrics_fn(), version, task_count
             )
+            self._task_d.create_tasks(TaskType.EVALUATION, version)
+            return True
 
-    def report_evaluation_metrics(
-        self, evaluation_version, model_outputs, labels
-    ):
-        if self._eval_job is None:
+    # -- worker-facing reporting --------------------------------------------
+
+    @property
+    def _eval_job(self):
+        # legacy alias (round-1 name), used by a few tests
+        return self._round
+
+    def report_evaluation_metrics(self, version, model_outputs, labels):
+        round_ = self._round
+        if round_ is None:
             return False
-        return self._eval_job.report_evaluation_metrics(
-            evaluation_version, model_outputs, labels
+        return round_.report_evaluation_metrics(
+            version, model_outputs, labels
         )
 
     def complete_task(self):
-        if self._eval_job is None:
+        round_ = self._round
+        if round_ is None:
             return
-        self._eval_job.complete_task()
-        if not self._eval_job.finished():
+        round_.complete_task()
+        if not round_.finished():
             return
-        evaluation_metrics = self._eval_job.get_evaluation_summary()
-        if self._tensorboard_service and evaluation_metrics:
-            self._tensorboard_service.write_dict_to_summary(
-                evaluation_metrics, version=self._eval_job.model_version
-            )
-        logger.info(
-            "Evaluation metrics[v=%d]: %s"
-            % (
-                self._eval_job.model_version
-                if self._eval_job.model_version >= 0
-                else self._master_servicer.get_model_version(),
-                str(evaluation_metrics),
-            )
-        )
+        self._publish_summary(round_)
         if not self._eval_only:
             self._checkpoint_service.remove_eval_checkpoint(
-                self._eval_job.model_version
+                round_.model_version
             )
-            self._eval_job = None
+            self._round = None
             self.try_to_create_new_job()
+
+    def _publish_summary(self, round_):
+        metrics = round_.get_evaluation_summary()
+        if self._tensorboard_service and metrics:
+            self._tensorboard_service.write_dict_to_summary(
+                metrics, version=round_.model_version
+            )
+        shown_version = (
+            round_.model_version
+            if round_.model_version >= 0
+            else self._master_servicer.get_model_version()
+        )
+        logger.info(
+            "Evaluation metrics[v=%d]: %s" % (shown_version, metrics)
+        )

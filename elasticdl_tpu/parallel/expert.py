@@ -1,0 +1,127 @@
+"""Expert parallelism: MoE dispatch over an ``expert`` mesh axis.
+
+The reference has no expert parallelism (SURVEY.md §2.2: absent). This
+is the TPU-native form: each device along the ``expert`` axis owns one
+(or more) experts' parameters; tokens are gated top-1, packed into
+capacity-bounded per-expert buckets, shipped to their expert with
+``lax.all_to_all``, transformed, and shipped back — the same explicit
+routing fabric as the HBM embedding plane (nn/hbm_embedding.py), which
+is exactly the point: on TPU, "expert parallel" and "vocab-sharded
+lookup" are the same all_to_all pattern over ICI with different
+per-shard compute.
+
+Capacity semantics follow the standard MoE recipe: each expert accepts
+at most ``capacity`` tokens per shard per step; overflow tokens bypass
+the experts (identity/zero contribution), weighted out by their gate.
+Gradients flow through dispatch, experts, combine, and the gate (via the
+gate-probability scaling).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.ring_attention import shard_map
+
+
+def top1_gate(logits):
+    """(T, E) gate logits -> (expert_idx (T,), gate_prob (T,))."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    return idx, jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+
+
+def moe_apply(expert_fn, expert_params, x, gate_logits, axis_name, capacity):
+    """Route tokens to experts over ``axis_name``; call inside shard_map.
+
+    - ``expert_fn(params, x) -> y``: one expert's computation (same
+      in/out feature width).
+    - ``expert_params``: this device's expert's parameter slice (leading
+      dim 1, squeezed internally).
+    - ``x``: (T, D) local tokens; ``gate_logits``: (T, E).
+
+    Returns (T, D): gate-weighted expert outputs, overflow tokens zero.
+    """
+    n_exp = jax.lax.psum(1, axis_name)
+    params = jax.tree_util.tree_map(
+        lambda p: jnp.squeeze(p, axis=0), expert_params
+    )
+    t_local, d = x.shape
+    cap = min(capacity, t_local)
+
+    expert_idx, gate = top1_gate(gate_logits)
+
+    # position of each token within its expert's bucket (stable order)
+    order = jnp.argsort(expert_idx, stable=True)
+    sorted_expert = expert_idx[order]
+    counts = jnp.bincount(expert_idx, length=n_exp)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(t_local) - starts[sorted_expert]
+    ok = pos < cap
+    slot = jnp.where(ok, pos, cap)  # overflow -> trash column
+
+    # (E, cap+1, D) send buffer; row e = tokens for expert e
+    send = jnp.zeros((n_exp, cap + 1, d), x.dtype)
+    send = send.at[sorted_expert, slot].set(x[order])[:, :cap]
+    recv = jax.lax.all_to_all(
+        send, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )  # (E, cap, D): row p = tokens shard p sent to THIS expert
+
+    y = expert_fn(params, recv.reshape(n_exp * cap, d))
+    y = y.reshape(n_exp, cap, d)
+    back = jax.lax.all_to_all(
+        y, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )  # (E, cap, D): row e = this shard's tokens back from expert e
+
+    # un-permute; overflow tokens contribute zero
+    gathered = jnp.where(
+        ok[:, None],
+        back[sorted_expert, jnp.where(ok, pos, 0)],
+        0.0,
+    )
+    inv = jnp.argsort(order, stable=True)
+    routed = gathered[inv]
+    return routed * gate[:, None].astype(x.dtype)
+
+
+def make_moe_fn(
+    mesh, expert_fn, expert_axis="expert", batch_axis=None, capacity_factor=2.0
+):
+    """Global wrapper: ``(stacked_expert_params, x, gate_logits) -> y``.
+
+    ``stacked_expert_params`` leaves are (E, ...) sharded over
+    ``expert_axis``; ``x`` is (T, D) tokens (optionally sharded over
+    ``batch_axis``), ``gate_logits`` (T, E) likewise. Capacity per
+    expert = ceil(T_local / E) * capacity_factor.
+    """
+
+    def _capacity(t_local, n_exp):
+        return max(1, int(-(-t_local // n_exp) * capacity_factor))
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(expert_axis), P(batch_axis), P(batch_axis)),
+        out_specs=P(batch_axis),
+        check_rep=False,
+    )
+    def _moe(stacked_params, x, gate_logits):
+        cap = _capacity(x.shape[0], int(mesh.shape[expert_axis]))
+        return moe_apply(
+            expert_fn, stacked_params, x, gate_logits, expert_axis, cap
+        )
+
+    return _moe
+
+
+def reference_moe(expert_fn, per_expert_params, x, gate_logits):
+    """Dense semantics the routed form must match (tests): every expert
+    runs every token, outputs selected by the top-1 gate."""
+    idx, gate = top1_gate(gate_logits)
+    outs = jnp.stack(
+        [expert_fn(p, x) for p in per_expert_params]
+    )  # (E, T, D)
+    picked = outs[idx, jnp.arange(x.shape[0])]
+    return picked * gate[:, None].astype(x.dtype)

@@ -1,0 +1,145 @@
+"""Pipeline parallelism: layer stages over a ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2: absent).
+This is the TPU-native form: the network's layers are grouped into S
+stages, stage s's parameters live only on the devices at ``pipe`` index
+s, and microbatches flow through the stage ring with
+``lax.ppermute`` — the GPipe schedule expressed as a ``lax.scan`` over
+S + M - 1 ticks inside ``shard_map``. XLA overlaps each tick's
+stage compute with the activation rotation (async collectives over
+ICI), and reverse-mode AD through scan + ppermute yields the matching
+1F1B-shaped backward without any hand-written schedule.
+
+Composes with the other axes on one mesh: ``data`` shards the batch,
+``pipe`` shards depth. Stage parameters arrive *stacked* on a leading
+stage dimension (leaf shape (S, ...) sharded P('pipe', ...)), the layout
+:func:`stack_stage_params` builds and
+:func:`elasticdl_tpu.parallel.trainer.AllReduceTrainer` can place via
+param_specs.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.ring_attention import shard_map
+
+
+def stack_stage_params(per_stage_params):
+    """[params_stage0, ...] -> one pytree with a leading (S,) stage dim."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name):
+    """Run the stage ring over microbatches; call inside shard_map.
+
+    - ``stage_fn(params, x) -> y``: one stage's computation; every stage
+      must map the same activation shape to itself (classic pipeline
+      constraint — embed/head layers live outside the ring).
+    - ``stage_params``: this device's slice of the stacked stage params
+      (leading dim 1, squeezed internally).
+    - ``microbatches``: (M, mb, ...) activations, replicated along
+      ``axis_name`` (every stage sees the input stream; only stage 0
+      consumes it).
+
+    Returns (M, mb, ...) outputs, valid on the LAST stage (callers take
+    index S-1; the shard_map wrapper below does).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.squeeze(x, axis=0), stage_params
+    )
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        held, outputs = carry
+        # stage 0 ingests microbatch t (if any remain); others keep the
+        # activation that just rotated in
+        feed = jnp.where(
+            t < m,
+            jax.lax.dynamic_index_in_dim(
+                microbatches, jnp.minimum(t, m - 1), keepdims=False
+            ),
+            jnp.zeros(mb_shape, microbatches.dtype),
+        )
+        x = jnp.where(stage == 0, feed, held)
+        y = stage_fn(params, x)
+        # the last stage's result for microbatch (t - (S-1)) is ready
+        out_idx = t - (n_stages - 1)
+        outputs = jnp.where(
+            (out_idx >= 0) & (out_idx < m),
+            jax.lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(out_idx, 0, m - 1), axis=0
+            ),
+            outputs,
+        )
+        held_next = jax.lax.ppermute(y, axis_name, perm)
+        return (held_next, outputs), None
+
+    held0 = jnp.zeros(mb_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        tick,
+        (held0, outputs0),
+        jnp.arange(m + n_stages - 1),
+    )
+    return outputs
+
+
+def make_pipeline_fn(mesh, stage_fn, pipe_axis="pipe", batch_axis=None):
+    """Global-array wrapper: ``(stacked_params, microbatches) -> out``.
+
+    ``stacked_params`` leaves are (S, ...) sharded over ``pipe_axis``;
+    ``microbatches`` is (M, mb, ...) (optionally batch-sharded over
+    ``batch_axis`` on dim 1 for dp x pp). Output matches microbatches'
+    shape/sharding: the last stage's results, broadcast over the pipe
+    axis so downstream (loss) code sees ordinary replicated activations.
+    """
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(pipe_axis),
+            P(None, batch_axis),
+        ),
+        out_specs=P(None, batch_axis),
+        check_rep=False,
+    )
+    def _pipe(stacked_params, microbatches):
+        out = pipeline_apply(
+            stage_fn, stacked_params, microbatches, pipe_axis
+        )
+        # broadcast the last stage's outputs to every pipe rank so the
+        # result is replicated along the pipe axis
+        n_stages = jax.lax.psum(1, pipe_axis)
+        stage = jax.lax.axis_index(pipe_axis)
+        mask = (stage == n_stages - 1).astype(out.dtype)
+        return jax.lax.psum(out * mask, pipe_axis)
+
+    return _pipe
+
+
+def stage_param_sharding(mesh, pipe_axis="pipe"):
+    """NamedSharding for stacked stage parameters."""
+    return NamedSharding(mesh, P(pipe_axis))
+
+
+def reference_pipeline(stage_fn, per_stage_params, microbatches):
+    """Sequential semantics the ring must match (tests)."""
+    outs = []
+    for x in np.asarray(microbatches):
+        y = jnp.asarray(x)
+        for params in per_stage_params:
+            y = stage_fn(params, y)
+        outs.append(y)
+    return jnp.stack(outs)

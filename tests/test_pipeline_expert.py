@@ -1,0 +1,196 @@
+"""Pipeline (pp) and expert (ep) parallelism on the virtual 8-dev mesh.
+
+Neither axis exists in the reference (SURVEY §2.2); both are built on
+the same seam as dp/tp/sp — mesh axes + shard_map + explicit
+collectives — so the elastic scheduler above is untouched.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.parallel.expert import (
+    make_moe_fn,
+    reference_moe,
+    top1_gate,
+)
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.pipeline import (
+    make_pipeline_fn,
+    reference_pipeline,
+    stack_stage_params,
+)
+
+D = 16
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stage_params(n_stages, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": rng.standard_normal((D, D)).astype(np.float32) * 0.3,
+            "b": rng.standard_normal((D,)).astype(np.float32) * 0.1,
+        }
+        for _ in range(n_stages)
+    ]
+
+
+def test_pipeline_matches_sequential():
+    mesh = create_mesh({"pipe": 4}, axis_names=("pipe",))
+    stages = _stage_params(4)
+    rng = np.random.default_rng(1)
+    micro = rng.standard_normal((6, 8, D)).astype(np.float32)
+
+    pipe = make_pipeline_fn(mesh, _stage_fn)
+    stacked = stack_stage_params(stages)
+    with mesh:
+        got = np.asarray(jax.jit(pipe)(stacked, micro))
+    want = np.asarray(reference_pipeline(_stage_fn, stages, micro))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_gradients_match_sequential():
+    mesh = create_mesh({"pipe": 4}, axis_names=("pipe",))
+    stages = _stage_params(4, seed=2)
+    rng = np.random.default_rng(3)
+    micro = rng.standard_normal((4, 8, D)).astype(np.float32)
+    pipe = make_pipeline_fn(mesh, _stage_fn)
+
+    def loss_ring(stacked):
+        return (pipe(stacked, micro) ** 2).sum()
+
+    def loss_seq(per_stage):
+        out = reference_pipeline(_stage_fn, per_stage, micro)
+        return (out ** 2).sum()
+
+    with mesh:
+        g_ring = jax.jit(jax.grad(loss_ring))(stack_stage_params(stages))
+    g_seq = jax.grad(loss_seq)(stages)
+    for s in range(4):
+        np.testing.assert_allclose(
+            np.asarray(g_ring["w"][s]),
+            np.asarray(g_seq[s]["w"]),
+            rtol=3e-4,
+            atol=3e-5,
+        )
+
+
+def test_pipeline_composes_with_data_parallel():
+    mesh = create_mesh(
+        {"data": 2, "pipe": 4}, axis_names=("data", "pipe")
+    )
+    stages = _stage_params(4, seed=4)
+    rng = np.random.default_rng(5)
+    micro = rng.standard_normal((3, 8, D)).astype(np.float32)
+    pipe = make_pipeline_fn(mesh, _stage_fn, batch_axis="data")
+    with mesh:
+        got = np.asarray(jax.jit(pipe)(stack_stage_params(stages), micro))
+    want = np.asarray(reference_pipeline(_stage_fn, stages, micro))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def _expert_fn(params, x):
+    return jax.nn.relu(x @ params["w"]) @ params["wo"]
+
+
+def _expert_params(n_experts, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": rng.standard_normal((D, 32)).astype(np.float32) * 0.2,
+            "wo": rng.standard_normal((32, D)).astype(np.float32) * 0.2,
+        }
+        for _ in range(n_experts)
+    ]
+
+
+def test_moe_matches_dense_when_under_capacity():
+    mesh = create_mesh({"expert": 8}, axis_names=("expert",))
+    experts = _expert_params(8)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((64, D)).astype(np.float32)
+    logits = rng.standard_normal((64, 8)).astype(np.float32)
+
+    moe = make_moe_fn(mesh, _expert_fn, capacity_factor=8.0)  # no overflow
+    stacked = stack_stage_params(experts)
+    with mesh:
+        got = np.asarray(jax.jit(moe)(stacked, x, logits))
+    want = np.asarray(reference_moe(_expert_fn, experts, x, logits))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_gradients_flow_to_experts_and_gate():
+    mesh = create_mesh({"expert": 4}, axis_names=("expert",))
+    experts = _expert_params(4, seed=2)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((32, D)).astype(np.float32)
+    logits = rng.standard_normal((32, 4)).astype(np.float32)
+    moe = make_moe_fn(mesh, _expert_fn, capacity_factor=8.0)
+
+    def loss_routed(stacked, logits):
+        return (moe(stacked, x, logits) ** 2).sum()
+
+    def loss_dense(per_expert, logits):
+        return (
+            reference_moe(_expert_fn, per_expert, x, logits) ** 2
+        ).sum()
+
+    with mesh:
+        g_stack, g_gate = jax.jit(jax.grad(loss_routed, argnums=(0, 1)))(
+            stack_stage_params(experts), logits
+        )
+    g_dense, g_gate_ref = jax.grad(loss_dense, argnums=(0, 1))(
+        experts, logits
+    )
+    for e in range(4):
+        np.testing.assert_allclose(
+            np.asarray(g_stack["w"][e]),
+            np.asarray(g_dense[e]["w"]),
+            rtol=3e-4,
+            atol=3e-5,
+        )
+    np.testing.assert_allclose(
+        np.asarray(g_gate), np.asarray(g_gate_ref), rtol=3e-4, atol=3e-5
+    )
+
+
+def test_moe_overflow_tokens_bypass():
+    """capacity 1 with all tokens gated to one expert: only the first
+    token per shard-bucket is served, the rest contribute zero."""
+    mesh = create_mesh({"expert": 4}, axis_names=("expert",))
+    experts = _expert_params(4, seed=6)
+    x = np.ones((8, D), np.float32)
+    logits = np.zeros((8, 4), np.float32)
+    logits[:, 2] = 5.0  # everyone wants expert 2
+
+    moe = make_moe_fn(mesh, _expert_fn, capacity_factor=1e-9)  # cap -> 1
+    with mesh:
+        got = np.asarray(
+            jax.jit(moe)(stack_stage_params(experts), x, logits)
+        )
+    nonzero = np.abs(got).sum(axis=1) > 0
+    assert nonzero.sum() == 1  # one token served, overflow bypassed
+    idx, gate = top1_gate(jnp.asarray(logits))
+    assert int(idx[0]) == 2
+
+
+def test_moe_composes_with_data_parallel():
+    mesh = create_mesh(
+        {"data": 2, "expert": 4}, axis_names=("data", "expert")
+    )
+    experts = _expert_params(4, seed=7)
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((32, D)).astype(np.float32)
+    logits = rng.standard_normal((32, 4)).astype(np.float32)
+    moe = make_moe_fn(
+        mesh, _expert_fn, batch_axis="data", capacity_factor=8.0
+    )
+    with mesh:
+        got = np.asarray(jax.jit(moe)(stack_stage_params(experts), x, logits))
+    want = np.asarray(reference_moe(_expert_fn, experts, x, logits))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
