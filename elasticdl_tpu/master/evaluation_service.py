@@ -78,6 +78,8 @@ class _EvaluationJob:
         self.model_version = model_version
         self._remaining = total_tasks
         self._acc = MetricsAccumulator(metrics_dict)
+        self._report_lock = threading.Lock()
+        self.published = False
 
     def complete_task(self):
         self._remaining -= 1
@@ -92,7 +94,10 @@ class _EvaluationJob:
                 % (self.model_version, version)
             )
             return False
-        self._acc.update(model_outputs, labels)
+        # concurrent worker reports: metric accumulators are
+        # read-modify-write state
+        with self._report_lock:
+            self._acc.update(model_outputs, labels)
         return True
 
     def get_evaluation_summary(self):
@@ -258,18 +263,24 @@ class EvaluationService:
         )
 
     def complete_task(self):
-        round_ = self._round
-        if round_ is None:
-            return
-        round_.complete_task()
-        if not round_.finished():
-            return
+        # the countdown is read-modify-write from concurrent gRPC report
+        # threads: decrement under the lock and let exactly one thread
+        # own the finish transition (clearing/publishing the round)
+        with self._lock:
+            round_ = self._round
+            if round_ is None:
+                return
+            round_.complete_task()
+            if not round_.finished() or round_.published:
+                return
+            round_.published = True
+            if not self._eval_only:
+                self._round = None
         self._publish_summary(round_)
         if not self._eval_only:
             self._checkpoint_service.remove_eval_checkpoint(
                 round_.model_version
             )
-            self._round = None
             self.try_to_create_new_job()
 
     def _publish_summary(self, round_):

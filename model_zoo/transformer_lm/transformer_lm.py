@@ -45,12 +45,83 @@ def _rotary(x, positions):
     ).astype(x.dtype)
 
 
+class MoEMlp(nn.Module):
+    """Mixture-of-experts MLP: top-1 routing over the ``expert`` mesh
+    axis (parallel/expert.py). Expert parameters are stacked on a leading
+    (E,) dim sharded over the axis; the dense fallback (no mesh / no
+    ``expert`` axis) computes every expert and selects by gate — the
+    routed form's reference semantics."""
+
+    num_experts: int
+    mlp_dim: int
+    dtype: Any
+    mesh: Any = None
+    capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, h):
+        from elasticdl_tpu.parallel.expert import make_moe_fn, reference_moe
+
+        d = h.shape[-1]
+        e = self.num_experts
+        gate_logits = nn.Dense(
+            e, use_bias=False, dtype=self.dtype, name="gate"
+        )(h)
+        w_up = self.param(
+            "experts_up",
+            nn.initializers.lecun_normal(),
+            (e, d, self.mlp_dim),
+        )
+        w_down = self.param(
+            "experts_down",
+            nn.initializers.lecun_normal(),
+            (e, self.mlp_dim, d),
+        )
+
+        def expert_fn(params, tokens):
+            up = tokens.astype(self.dtype) @ params["up"].astype(self.dtype)
+            return nn.gelu(up) @ params["down"].astype(self.dtype)
+
+        stacked = {"up": w_up, "down": w_down}
+        tokens = h.reshape(-1, d)
+        logits_flat = gate_logits.reshape(-1, e)
+        use_routed = (
+            self.mesh is not None and "expert" in self.mesh.axis_names
+        )
+        if use_routed:
+            # shard the token stream over the data axis when present so
+            # dp replicas route only their own slice (a P(None) spec
+            # would all-gather and redo the MoE per replica)
+            batch_axis = (
+                "data" if "data" in self.mesh.axis_names else None
+            )
+            moe = make_moe_fn(
+                self.mesh,
+                expert_fn,
+                expert_axis="expert",
+                batch_axis=batch_axis,
+                capacity_factor=self.capacity_factor,
+            )
+            out = moe(stacked, tokens, logits_flat)
+        else:
+            per_expert = [
+                {"up": w_up[i], "down": w_down[i]} for i in range(e)
+            ]
+            out = reference_moe(
+                expert_fn, per_expert, tokens, logits_flat
+            )
+        return out.reshape(h.shape).astype(h.dtype)
+
+
 class Block(nn.Module):
     num_heads: int
     head_dim: int
     mlp_dim: int
     dtype: Any
     attention_fn: Any
+    num_experts: int = 0
+    mesh: Any = None
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, positions):
@@ -75,9 +146,19 @@ class Block(nn.Module):
         )(attn)
         x = x + attn
         h = nn.RMSNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
+        if self.num_experts:
+            h = MoEMlp(
+                num_experts=self.num_experts,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                mesh=self.mesh,
+                capacity_factor=self.moe_capacity_factor,
+                name="moe_mlp",
+            )(h)
+        else:
+            h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
         return x + h
 
 
@@ -95,6 +176,10 @@ class TransformerLM(nn.Module):
     # path uses the fused ring). Trains blockwise since round 2 — the
     # backward recomputes p per tile from the saved logsumexp.
     use_flash: bool = True
+    # >0 turns every block's MLP into a top-1 MoE; expert parameters
+    # shard over the mesh's 'expert' axis when present (parallel/expert)
+    num_experts: int = 0
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -138,6 +223,9 @@ class TransformerLM(nn.Module):
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 attention_fn=attention_fn,
+                num_experts=self.num_experts,
+                mesh=self.mesh,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name="block_%d" % i,
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype)(x)
@@ -157,6 +245,8 @@ def custom_model(
     mesh=None,
     seq_axis=None,
     use_flash=True,
+    num_experts=0,
+    moe_capacity_factor=2.0,
 ):
     return TransformerLM(
         vocab_size=vocab_size,
@@ -169,6 +259,8 @@ def custom_model(
         mesh=mesh,
         seq_axis=seq_axis,
         use_flash=use_flash,
+        num_experts=num_experts,
+        moe_capacity_factor=moe_capacity_factor,
     )
 
 
