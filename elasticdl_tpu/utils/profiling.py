@@ -24,9 +24,11 @@ import time
 from elasticdl_tpu.common.log_utils import default_logger as logger
 
 
-@contextlib.contextmanager
-def trace(log_dir, host_tracer_level=2):
-    """Capture a jax.profiler trace into ``log_dir``."""
+_trace_dir = None  # active trace's directory, None when no trace is open
+
+
+def _start(log_dir):
+    global _trace_dir
     import jax
 
     os.makedirs(log_dir, exist_ok=True)
@@ -35,12 +37,32 @@ def trace(log_dir, host_tracer_level=2):
         create_perfetto_link=False,
         create_perfetto_trace=False,
     )
+    _trace_dir = log_dir
     logger.info("profiler trace started -> %s", log_dir)
+
+
+def _stop():
+    global _trace_dir
+    if _trace_dir is None:
+        return
+    import jax
+
+    log_dir, _trace_dir = _trace_dir, None
+    try:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)
+    except Exception:
+        logger.warning("stopping profiler trace failed", exc_info=True)
+
+
+@contextlib.contextmanager
+def trace(log_dir, host_tracer_level=2):
+    """Capture a jax.profiler trace into ``log_dir``."""
+    _start(log_dir)
     try:
         yield log_dir
     finally:
-        jax.profiler.stop_trace()
-        logger.info("profiler trace written to %s", log_dir)
+        _stop()
 
 
 def annotate(name):
@@ -61,11 +83,35 @@ def enable_xla_dump(dump_dir):
 
 
 def maybe_profile():
-    """Context from env: EDL_PROFILE_DIR -> trace, else no-op."""
+    """Context from env: EDL_PROFILE_DIR -> trace, else no-op.
+
+    CAUTION: starting a trace initializes the JAX backend. Processes that
+    call ``jax.distributed.initialize`` (elastic allreduce workers) must
+    use :func:`maybe_start_trace` *after* their world forms instead.
+    """
     log_dir = os.environ.get("EDL_PROFILE_DIR")
     if log_dir:
         return trace(log_dir)
     return contextlib.nullcontext()
+
+
+def maybe_start_trace():
+    """Start the env-selected trace mid-run (no-op if active/unset).
+
+    Traces are per membership epoch: callers stop before tearing down a
+    jax.distributed world (the session must not outlive its backends)
+    and restart after the next one forms, yielding one trace segment per
+    world.
+    """
+    log_dir = os.environ.get("EDL_PROFILE_DIR")
+    if not log_dir or _trace_dir is not None:
+        return False
+    _start(log_dir)
+    return True
+
+
+def maybe_stop_trace():
+    _stop()
 
 
 class step_timer:

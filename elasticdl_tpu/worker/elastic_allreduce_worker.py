@@ -247,6 +247,16 @@ class ElasticAllReduceWorker:
     # -- the run loop --------------------------------------------------------
 
     def run(self):
+        from elasticdl_tpu.utils.profiling import maybe_stop_trace
+
+        try:
+            return self._run()
+        finally:
+            # flush any open trace even on the exception path — the run
+            # that crashed is the one whose profile matters most
+            maybe_stop_trace()
+
+    def _run(self):
         losses = []
         self._batch_gen = self._batches()
         first = self._prime()
@@ -278,6 +288,9 @@ class ElasticAllReduceWorker:
                     "world %d broke during formation; re-polling", world.epoch
                 )
                 continue
+            from elasticdl_tpu.utils.profiling import maybe_start_trace
+
+            maybe_start_trace()  # safe only now: the backend is world-aware
             outcome = self._train_epoch(world, losses)
             if outcome == "done":
                 break
@@ -343,6 +356,9 @@ class ElasticAllReduceWorker:
                 self._flush_unreported(
                     "" if ok else "collective failed before validation"
                 )
+                from elasticdl_tpu.utils.profiling import maybe_stop_trace
+
+                maybe_stop_trace()  # the trace must not outlive its world
                 self.trainer.leave()
                 return "reform"
             batch = self._next_batch()
@@ -378,6 +394,9 @@ class ElasticAllReduceWorker:
                 self._flush_unreported(
                     "collective failed before validation"
                 )
+                from elasticdl_tpu.utils.profiling import maybe_stop_trace
+
+                maybe_stop_trace()  # the trace must not outlive its world
                 self.trainer.leave()
                 if not self._await_epoch_bump(world.epoch):
                     raise
@@ -519,6 +538,9 @@ class ElasticAllReduceWorker:
             except Exception:
                 logger.warning("final eval round failed", exc_info=True)
         self._process_save_model_task_if_needed()
+        from elasticdl_tpu.utils.profiling import maybe_stop_trace
+
+        maybe_stop_trace()
         from elasticdl_tpu.parallel import distributed
 
         if distributed.current_spec() is not None:

@@ -14,6 +14,18 @@ from elasticdl_tpu.worker.worker import Worker
 
 def main():
     args = parse_worker_args()
+    if args.distribution_strategy == "AllreduceStrategy":
+        # the elastic worker must not touch the JAX backend before its
+        # jax.distributed world forms; it starts the env-selected trace
+        # itself after the first establish
+        return _run(args)
+    from elasticdl_tpu.utils.profiling import maybe_profile
+
+    with maybe_profile():
+        return _run(args)
+
+
+def _run(args):
     stub = MasterClient(args.master_addr) if args.master_addr else None
     ps_client = None
     if args.ps_addrs:
