@@ -1,0 +1,103 @@
+"""Background-thread checkpoint writes (orbax-style async saving).
+
+The reference writes every checkpoint synchronously on the master thread
+(reference master/checkpoint_service.py:47-72): training stalls for the
+full serialize+disk time. On TPU the state lives in HBM, so a save
+naturally splits into two phases with very different costs:
+
+1. device->host snapshot — bounded by PCIe/DMA, must happen before the
+   next train step because training/step.py *donates* the TrainState
+   buffers (the arrays are invalidated the moment the next step is
+   dispatched);
+2. disk IO — the slow part, with no dependency on device state at all.
+
+``AsyncCheckpointer`` runs phase 2 on a single worker thread: saves stay
+ordered (version N hits disk before N+1, ring eviction is serialized),
+training only ever blocks for phase 1. Errors from the worker are stored
+and re-raised on the training thread at the next ``save``/``wait`` so a
+failing disk never fails silently.
+"""
+
+import queue
+import threading
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class AsyncCheckpointer:
+    """Runs submitted IO jobs on one background thread, in order.
+
+    ``max_pending`` bounds the queue: if disk IO falls behind, ``submit``
+    blocks rather than accumulating unbounded host snapshots (each queued
+    job pins a full model copy in host memory).
+    """
+
+    def __init__(self, max_pending=2, name="async-ckpt"):
+        self._queue = queue.Queue(maxsize=max_pending)
+        self._error = None
+        self._error_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._closed = False
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            job = self._queue.get()
+            label = ""
+            try:
+                if job is None:
+                    return
+                fn, label = job
+                fn()
+            except Exception as e:  # noqa: BLE001 - relayed to caller
+                logger.error("async checkpoint %s failed: %s", label, e)
+                with self._error_lock:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                # drop the closure before blocking on the next get():
+                # fn pins the snapshot (a full host model copy), which
+                # must not sit in RAM for the whole inter-checkpoint
+                # window
+                job = fn = None
+                self._queue.task_done()
+
+    def _raise_pending(self):
+        with self._error_lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def submit(self, fn, label=""):
+        """Enqueue ``fn`` (pure IO, no device access) for the worker.
+
+        Raises any error from a previously submitted job first, so a
+        broken checkpoint directory surfaces on the training thread at
+        the next checkpoint attempt rather than at job teardown.
+        """
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_pending()
+        self._queue.put((fn, label))
+
+    def wait(self):
+        """Block until every submitted job finished; re-raise failures.
+
+        Call before restoring from the same directory, at job teardown,
+        and before any membership change that might re-run the save path
+        for the same version.
+        """
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self):
+        """Drain outstanding jobs and stop the worker thread."""
+        if self._closed:
+            return
+        self._queue.join()
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join()
+        self._raise_pending()

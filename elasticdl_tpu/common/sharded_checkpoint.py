@@ -84,28 +84,76 @@ def _index_to_slices(index, shape):
     return out
 
 
-def save_sharded(directory, tree, version=0):
-    """Write this process's shards of ``tree`` (a pytree of jax/np
-    arrays) into ``directory``. Every participating process must call it
-    (collective-free: pure local writes)."""
-    os.makedirs(directory, exist_ok=True)
-    pid = jax.process_index()
-    manifest = {"version": int(version), "leaves": {}}
+def _snapshot_entries(tree, copy_host=False):
+    """Lazily yield ``(path, shape, dtype, shards, full)`` save records,
+    materializing one leaf's host bytes at a time (so the streaming sync
+    writer's peak host memory stays ~one leaf)."""
     for path, leaf in _leaf_entries(tree):
-        safe = path.replace("/", ".")
         if not hasattr(leaf, "addressable_shards"):
+            # copy_host: a host ndarray leaf must be COPIED when the
+            # write happens later/off-thread, or in-place mutation
+            # during the background write tears the snapshot
+            arr = np.array(leaf) if copy_host else np.asarray(leaf)
+            yield (path, arr.shape, arr.dtype, None, arr)
+            continue
+        shards = [
+            (_index_to_slices(s.index, leaf.shape), i, np.asarray(s.data))
+            for i, s in enumerate(leaf.addressable_shards)
+            if s.replica_id == 0
+        ]
+        yield (path, tuple(leaf.shape), leaf.dtype, shards, None)
+
+
+def snapshot_tree(tree):
+    """Phase 1 of an async save: capture this process's shard bytes on
+    host.
+
+    Enqueues every device->host copy first (``copy_to_host_async``) so
+    the transfers overlap each other, then materializes numpy views. The
+    result is self-contained host data: the caller may immediately feed
+    the original arrays back into a donating ``jit`` (training/step.py
+    donates the whole TrainState) while phase 2 —
+    :func:`write_snapshot`, which does only disk IO — runs on a
+    background thread (see async_checkpoint.AsyncCheckpointer).
+    """
+    for _, leaf in _leaf_entries(tree):
+        if hasattr(leaf, "addressable_shards"):
+            for shard in leaf.addressable_shards:
+                if shard.replica_id == 0 and hasattr(
+                    shard.data, "copy_to_host_async"
+                ):
+                    shard.data.copy_to_host_async()
+    return list(_snapshot_entries(tree, copy_host=True))
+
+
+def write_snapshot(directory, snap, version=0, process_index=None):
+    """Phase 2 of a save: write save records' shard files + manifest.
+
+    ``snap`` is any iterable of :func:`_snapshot_entries` records — a
+    materialized list (async path: pure file IO, safe on a background
+    thread) or a lazy generator (sync path: each leaf's device->host
+    bytes are pulled, written, and dropped one at a time).
+    ``process_index`` is captured by the caller (jax.process_index is
+    not thread-safe to first-call off-thread)."""
+    os.makedirs(directory, exist_ok=True)
+    pid = (
+        jax.process_index() if process_index is None else process_index
+    )
+    manifest = {"version": int(version), "leaves": {}}
+    for path, shape, dtype, shards, full in snap:
+        safe = path.replace("/", ".")
+        if shards is None:
             # host array (numpy): process 0 owns it
             if pid == 0:
                 fname = "%s.full.npy" % safe
-                _np_save(os.path.join(directory, fname), np.asarray(leaf))
+                _np_save(os.path.join(directory, fname), full)
                 manifest["leaves"][path] = {
-                    "shape": list(np.shape(leaf)),
-                    "dtype": str(np.asarray(leaf).dtype),
+                    "shape": list(shape),
+                    "dtype": str(dtype),
                     "shards": [
                         {
                             "slices": _index_to_slices(
-                                (slice(None),) * np.ndim(leaf),
-                                np.shape(leaf),
+                                (slice(None),) * len(shape), shape
                             ),
                             "file": fname,
                         }
@@ -113,23 +161,14 @@ def save_sharded(directory, tree, version=0):
                 }
             continue
         entry = {
-            "shape": list(leaf.shape),
-            "dtype": str(leaf.dtype),
+            "shape": list(shape),
+            "dtype": str(dtype),
             "shards": [],
         }
-        for i, shard in enumerate(leaf.addressable_shards):
-            if shard.replica_id != 0:
-                continue  # replicated copy: someone else's replica 0 writes
+        for slices, i, data in shards:
             fname = "%s.p%d.s%d.npy" % (safe, pid, i)
-            _np_save(
-                os.path.join(directory, fname), np.asarray(shard.data)
-            )
-            entry["shards"].append(
-                {
-                    "slices": _index_to_slices(shard.index, leaf.shape),
-                    "file": fname,
-                }
-            )
+            _np_save(os.path.join(directory, fname), data)
+            entry["shards"].append({"slices": slices, "file": fname})
         if entry["shards"]:
             manifest["leaves"][path] = entry
     # manifest written last and renamed into place: a crash mid-save
@@ -148,6 +187,14 @@ def save_sharded(directory, tree, version=0):
         len(manifest["leaves"]),
         directory,
     )
+
+
+def save_sharded(directory, tree, version=0):
+    """Write this process's shards of ``tree`` (a pytree of jax/np
+    arrays) into ``directory``. Every participating process must call it
+    (collective-free: pure local writes). Streams leaf-by-leaf: peak
+    host memory is ~one leaf, not the whole local model."""
+    write_snapshot(directory, _snapshot_entries(tree), version=version)
 
 
 def _merged_manifest(directory):
@@ -273,12 +320,26 @@ def load_sharded_to_host(directory):
 class ShardedCheckpointManager:
     """Ring-retention directory manager (the CheckpointService semantics
     — every checkpoint_steps versions, keep_max directories — for the
-    device-resident checkpoint format)."""
+    device-resident checkpoint format).
 
-    def __init__(self, base_dir, checkpoint_steps=0, keep_max=0):
+    With ``async_io=True`` saves block only for the device->host
+    snapshot; file writes and ring eviction run on a background thread
+    (see async_checkpoint.AsyncCheckpointer). Call :meth:`wait` before
+    restoring or tearing down."""
+
+    def __init__(
+        self, base_dir, checkpoint_steps=0, keep_max=0, async_io=False
+    ):
         self._base = base_dir
         self._steps = checkpoint_steps
         self._keep_max = keep_max
+        self._async = None
+        if async_io:
+            from elasticdl_tpu.common.async_checkpoint import (
+                AsyncCheckpointer,
+            )
+
+            self._async = AsyncCheckpointer()
 
     @property
     def steps(self):
@@ -293,17 +354,43 @@ class ShardedCheckpointManager:
     def _dir_for(self, version):
         return os.path.join(self._base, "ckpt_v%d" % version)
 
+    def _evict(self):
+        kept = sorted(self.versions())
+        while len(kept) > self._keep_max:
+            victim = self._dir_for(kept.pop(0))
+            for f in glob.glob(os.path.join(victim, "*")):
+                os.remove(f)
+            os.rmdir(victim)
+
     def save(self, tree, version):
         directory = self._dir_for(version)
+        pid = jax.process_index()
+        if self._async is not None:
+            snap = snapshot_tree(tree)
+
+            def _write():
+                write_snapshot(
+                    directory, snap, version=version, process_index=pid
+                )
+                if self._keep_max and pid == 0:
+                    self._evict()
+
+            self._async.submit(_write, label="ckpt_v%d" % version)
+            return directory
         save_sharded(directory, tree, version)
-        if self._keep_max and jax.process_index() == 0:
-            kept = sorted(self.versions())
-            while len(kept) > self._keep_max:
-                victim = self._dir_for(kept.pop(0))
-                for f in glob.glob(os.path.join(victim, "*")):
-                    os.remove(f)
-                os.rmdir(victim)
+        if self._keep_max and pid == 0:
+            self._evict()
         return directory
+
+    def wait(self):
+        """Drain in-flight async saves (no-op in sync mode)."""
+        if self._async is not None:
+            self._async.wait()
+
+    def close(self):
+        if self._async is not None:
+            self._async.close()
+            self._async = None
 
     def versions(self):
         """Versions with at least one complete manifest (a crash mid-save

@@ -136,8 +136,13 @@ class ElasticAllReduceWorker:
                 ShardedCheckpointManager,
             )
 
+            # async_io: saves block only for the HBM->host snapshot;
+            # file writes overlap the next training window
             self._ckpt = ShardedCheckpointManager(
-                checkpoint_dir, checkpoint_steps, keep_checkpoint_max
+                checkpoint_dir,
+                checkpoint_steps,
+                keep_checkpoint_max,
+                async_io=True,
             )
         self._restore_attempted = False
         self._last_ckpt_version = 0
@@ -255,6 +260,11 @@ class ElasticAllReduceWorker:
             # flush any open trace even on the exception path — the run
             # that crashed is the one whose profile matters most
             maybe_stop_trace()
+            # a crash path skips _finalize; queued async checkpoint
+            # writes must still land (save() already returned and
+            # advanced the cadence — dropping them here would lose up
+            # to checkpoint_steps of durable progress)
+            self._drain_ckpt()
 
     def _run(self):
         losses = []
@@ -301,6 +311,7 @@ class ElasticAllReduceWorker:
         """Resume from the newest restorable checkpoint; a partial or
         corrupt directory falls back to the next-older one instead of
         crash-looping the worker."""
+        self._ckpt.wait()  # an in-flight async save must land first
         for version in sorted(self._ckpt.versions(), reverse=True):
             directory = self._ckpt._dir_for(version)
             try:
@@ -531,7 +542,21 @@ class ElasticAllReduceWorker:
         logger.info("Exported model to %s", saved_model_path)
         self.report_task_result(task_id=task.task_id, err_msg="")
 
+    def _drain_ckpt(self):
+        """Land queued async checkpoint writes; surface IO errors as a
+        warning (teardown must not mask the original failure)."""
+        if self._ckpt is None:
+            return
+        try:
+            self._ckpt.close()
+        except Exception:
+            logger.warning(
+                "async checkpoint writes failed at teardown",
+                exc_info=True,
+            )
+
     def _finalize(self):
+        self._drain_ckpt()
         if self._job_type == JobType.TRAINING_WITH_EVALUATION:
             try:
                 self._evaluate_only()
