@@ -167,6 +167,8 @@ def _run_local_job(args):
                 data_reader_params=get_dict_from_params_str(
                     args.data_reader_params
                 ),
+                accum_steps=getattr(args, "grad_accum_steps", 1),
+                precision=getattr(args, "precision_policy", "") or None,
             ).run()
             return master.run(poll_secs=0.2)
 
@@ -188,7 +190,11 @@ def _run_local_job(args):
             data_reader_params=get_dict_from_params_str(
                 args.data_reader_params
             ),
+            precision=getattr(args, "precision_policy", "") or None,
         )
+        from elasticdl_tpu.common.args import warn_accum_unsupported
+
+        warn_accum_unsupported(args, "the in-process PS worker")
         worker.run()
         rc = master.run(poll_secs=0.2)
         return rc

@@ -12,6 +12,26 @@ entirely via argv (args.py:622-643).
 import argparse
 
 
+def warn_accum_unsupported(args, plane="this training plane"):
+    """Log when --grad_accum_steps is set on a plane that ignores it.
+
+    Accumulation lives in the fused train step
+    (training/step.py:make_train_step), used by the single-process
+    ALLREDUCE path; the PS grad fn and the multi-process weighted
+    lockstep step run without it, and silence would let a user believe
+    their activation memory was bounded when it was not."""
+    if getattr(args, "grad_accum_steps", 1) > 1:
+        from elasticdl_tpu.common.log_utils import default_logger
+
+        default_logger.warning(
+            "--grad_accum_steps=%d is only honored by the "
+            "single-process ALLREDUCE train step; %s runs WITHOUT "
+            "gradient accumulation",
+            args.grad_accum_steps,
+            plane,
+        )
+
+
 def pos_int(arg):
     res = int(arg)
     if res <= 0:
@@ -255,6 +275,22 @@ def add_common_args_between_master_and_worker(parser):
         help="ParameterServerStrategy keeps the reference's host-PS "
         "semantics; AllreduceStrategy is the TPU-native in-step XLA "
         "collective path",
+    )
+    parser.add_argument(
+        "--grad_accum_steps",
+        type=pos_int,
+        default=1,
+        help="Gradient accumulation: split each minibatch into this "
+        "many microbatches inside the jitted step (activation memory "
+        "drops to one microbatch; one optimizer update per minibatch)",
+    )
+    parser.add_argument(
+        "--precision_policy",
+        default="",
+        choices=["", "float32", "mixed_bfloat16", "bfloat16"],
+        help="Mixed-precision policy for the train step (default: the "
+        "model's own dtype behavior; mixed_bfloat16 = f32 master "
+        "weights, bf16 compute — the standard TPU recipe)",
     )
 
 

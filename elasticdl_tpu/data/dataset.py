@@ -149,6 +149,43 @@ class Dataset:
 
         return Dataset(gen)
 
+    def device_prefetch(self, buffer_size=2, placement=None):
+        """Move elements to device ahead of consumption (double buffering).
+
+        ``jax.device_put`` dispatches asynchronously, so keeping
+        ``buffer_size`` batches in flight overlaps host->device transfer
+        with the device compute consuming the previous batch — the role
+        ``flax.jax_utils.prefetch_to_device`` plays in pmap pipelines.
+        ``placement`` is an optional ``jax.sharding.Sharding`` (or
+        device) for multi-chip batch layouts; default is the default
+        device.
+
+        Call it LAST in the pipeline (after ``batch``/``prefetch``):
+        downstream host-side transforms on device arrays would bounce
+        every element back. No TPU-memory risk at sane sizes: in-flight
+        elements are bounded by ``buffer_size``.
+        """
+
+        def gen():
+            import collections
+
+            import jax
+
+            def put(x):
+                if placement is None:
+                    return jax.device_put(x)
+                return jax.device_put(x, placement)
+
+            buf = collections.deque()
+            for x in self._gen_factory():
+                buf.append(put(x))
+                if len(buf) > max(1, buffer_size):
+                    yield buf.popleft()
+            while buf:
+                yield buf.popleft()
+
+        return Dataset(gen)
+
     def __iter__(self):
         return iter(self._gen_factory())
 

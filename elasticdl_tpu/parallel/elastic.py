@@ -92,7 +92,9 @@ def broadcast_from_device0(mesh, host_tree):
     return pick0(stacked)
 
 
-def make_elastic_train_step(module, loss_fn, optimizer, mesh, axis="data"):
+def make_elastic_train_step(
+    module, loss_fn, optimizer, mesh, axis="data", precision=None
+):
     """Weighted lockstep step: ``(ts, features, labels, weights, rng) ->
     (ts', loss, n_active)``.
 
@@ -101,7 +103,14 @@ def make_elastic_train_step(module, loss_fn, optimizer, mesh, axis="data"):
     over ``axis`` divided by the live-device count; with zero live devices
     the state passes through unchanged and ``version`` does not advance,
     so drain-mode dummy steps are exact no-ops.
+
+    ``precision``: a training.precision.Policy (or preset name); master
+    weights, gradients, and the weighted psum math stay in
+    ``param_dtype`` — only the forward/backward compute casts down.
     """
+    from elasticdl_tpu.training.precision import get_policy
+
+    pol = get_policy(precision)
 
     def per_device(ts, features, labels, weights, rng):
         w = weights[0].astype(jnp.float32)
@@ -109,9 +118,16 @@ def make_elastic_train_step(module, loss_fn, optimizer, mesh, axis="data"):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
         def loss_of(p):
+            if pol is not None:
+                p = pol.cast_to_compute(p)
+                features_c = pol.cast_to_compute(features)
+            else:
+                features_c = features
             output, new_state = apply_model(
-                module, p, ts.state, features, training=True, rng=rng
+                module, p, ts.state, features_c, training=True, rng=rng
             )
+            if pol is not None:
+                output = pol.cast_output(output)
             return loss_fn(output, labels), new_state
 
         (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(
@@ -161,11 +177,12 @@ def make_elastic_train_step(module, loss_fn, optimizer, mesh, axis="data"):
 class ElasticDPTrainer:
     """Per-process handle on the global elastic DP training plane."""
 
-    def __init__(self, module, loss_fn, optimizer, seed=0):
+    def __init__(self, module, loss_fn, optimizer, seed=0, precision=None):
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._seed = seed
+        self._precision = precision
         self._mesh = None
         self._spec = None
         self._ts = None
@@ -216,7 +233,11 @@ class ElasticDPTrainer:
         self._ts = broadcast_from_device0(self._mesh, self._host_ts)
         self._checked_ts = self._ts
         self._step_fn = make_elastic_train_step(
-            self._module, self._loss_fn, self._optimizer, self._mesh
+            self._module,
+            self._loss_fn,
+            self._optimizer,
+            self._mesh,
+            precision=self._precision,
         )
         logger.info(
             "elastic plane established: epoch=%d rank=%d/%d devices=%d",

@@ -40,11 +40,16 @@ class AllReduceTrainer:
         seed=0,
         mesh=None,
         param_specs=None,
+        accum_steps=1,
+        precision=None,
     ):
         """``param_specs``: optional nested dict mirroring (a prefix of)
         the params tree whose leaves are PartitionSpecs — parameters it
         names shard over the mesh instead of replicating (HBM embedding
-        tables); their optimizer slots co-shard by tree-path suffix."""
+        tables); their optimizer slots co-shard by tree-path suffix.
+        ``accum_steps``/``precision`` forward to
+        :func:`training.step.make_train_step` (gradient accumulation and
+        the mixed-precision policy)."""
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -52,7 +57,13 @@ class AllReduceTrainer:
         self._seed = seed
         self._param_specs = param_specs
         self._sharded_paths = {}
-        self._step_fn = make_train_step(module, loss_fn, optimizer)
+        self._step_fn = make_train_step(
+            module,
+            loss_fn,
+            optimizer,
+            accum_steps=accum_steps,
+            precision=precision,
+        )
         self._mesh = mesh if mesh is not None else create_mesh(devices=devices)
         self._ts = None
         self._host_step = 0

@@ -1,0 +1,83 @@
+"""Mixed-precision policy: one switch for the framework's dtype story.
+
+The reference trains f32 end-to-end (tf.keras default; no dtype policy
+anywhere in reference worker.py). On TPU the MXU wants bfloat16 inputs,
+while optimizer math wants f32 master weights — so the rebuild makes the
+split explicit and uniform instead of leaving each zoo model to cast
+internally:
+
+- ``param_dtype``  — what lives in HBM between steps (master weights).
+- ``compute_dtype`` — what enters ``module.apply`` (matmul/conv inputs).
+- ``output_dtype`` — what the loss sees (upcast so reductions/softmax
+  statistics don't round in bf16).
+
+Casting params down inside the step is differentiable: the backward pass
+re-upcasts, so gradients and optimizer state stay in ``param_dtype``.
+bf16 master weights (param_dtype=bfloat16) are supported but lose update
+precision below ~2^-8 relative steps; the default keeps f32 masters, the
+standard TPU recipe.
+
+Usage::
+
+    policy = get_policy("mixed_bfloat16")
+    step = make_train_step(model, loss, opt, precision=policy)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def _cast_floats(tree, dtype):
+    def cast(leaf):
+        a = jnp.asarray(leaf)
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != dtype:
+            return a.astype(dtype)
+        return a
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Float-leaf casting rules; integer/bool leaves pass through."""
+
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.bfloat16
+    output_dtype: object = jnp.float32
+
+    def cast_to_compute(self, tree):
+        """Params/features entering the model's forward pass."""
+        return _cast_floats(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        """Back to storage dtype (e.g. restored checkpoints)."""
+        return _cast_floats(tree, self.param_dtype)
+
+    def cast_output(self, tree):
+        """Model output entering the loss."""
+        return _cast_floats(tree, self.output_dtype)
+
+
+_PRESETS = {
+    # f32 everywhere (the reference's behavior)
+    "float32": Policy(jnp.float32, jnp.float32, jnp.float32),
+    # the standard TPU recipe: f32 masters, bf16 matmuls, f32 loss
+    "mixed_bfloat16": Policy(jnp.float32, jnp.bfloat16, jnp.float32),
+    # bf16 masters too: halves param HBM, loses small-update precision
+    "bfloat16": Policy(jnp.bfloat16, jnp.bfloat16, jnp.float32),
+}
+
+
+def get_policy(name_or_policy):
+    """Resolve a preset name (or pass a Policy through). None -> None."""
+    if name_or_policy is None or isinstance(name_or_policy, Policy):
+        return name_or_policy
+    try:
+        return _PRESETS[name_or_policy]
+    except KeyError:
+        raise ValueError(
+            "unknown precision policy %r (have: %s)"
+            % (name_or_policy, ", ".join(sorted(_PRESETS)))
+        )

@@ -47,20 +47,31 @@ class TrainState:
         )
 
 
-def make_grad_fn(module, loss_fn):
+def make_grad_fn(module, loss_fn, precision=None):
     """Jitted ``(params, state, features, labels, rng) ->
     (loss, grads, new_state, output)``.
 
     The PS-mode worker computes gradients on device, then ships them to the
     master/PS over the control plane (reference worker.py:545-568 +
-    report_gradient) — so this step stops at gradients.
+    report_gradient) — so this step stops at gradients. ``precision`` as
+    in :func:`make_train_step` (grads leave the chip in ``param_dtype``).
     """
+    from elasticdl_tpu.training.precision import get_policy
+
+    pol = get_policy(precision)
 
     def step(params, state, features, labels, rng):
         def loss_of(p):
+            if pol is not None:
+                p = pol.cast_to_compute(p)
+                features_c = pol.cast_to_compute(features)
+            else:
+                features_c = features
             output, new_state = apply_model(
-                module, p, state, features, training=True, rng=rng
+                module, p, state, features_c, training=True, rng=rng
             )
+            if pol is not None:
+                output = pol.cast_output(output)
             return loss_fn(output, labels), (output, new_state)
 
         (loss, (output, new_state)), grads = jax.value_and_grad(
@@ -71,7 +82,14 @@ def make_grad_fn(module, loss_fn):
     return jax.jit(step)
 
 
-def make_train_step(module, loss_fn, optimizer, pmean_axis=None):
+def make_train_step(
+    module,
+    loss_fn,
+    optimizer,
+    pmean_axis=None,
+    accum_steps=1,
+    precision=None,
+):
     """Jitted fused step ``(train_state, features, labels, rng) ->
     (train_state, loss)`` with donated state.
 
@@ -81,18 +99,82 @@ def make_train_step(module, loss_fn, optimizer, pmean_axis=None):
     (master/servicer.py:382-426). With jit-over-sharded-batch the collective
     is inserted automatically; the explicit pmean form is used under
     shard_map.
-    """
 
-    def step(ts, features, labels, rng):
+    ``accum_steps > 1``: gradient accumulation. The incoming batch's
+    leading dim must be ``accum_steps * micro``; a ``lax.scan`` runs the
+    forward/backward per microbatch (bounding activation memory to one
+    microbatch) and one optimizer update applies the mean gradient —
+    effective batch size beyond what activations fit in HBM. Model state
+    (BatchNorm stats) threads through the scan sequentially.
+
+    ``precision``: a training.precision.Policy (or preset name) — params
+    are cast to ``compute_dtype`` inside the differentiated function (so
+    gradients and optimizer math stay in ``param_dtype``), the model
+    output is upcast to ``output_dtype`` before the loss.
+    """
+    from elasticdl_tpu.training.precision import get_policy
+
+    pol = get_policy(precision)
+
+    def grads_of(params, state, features, labels, rng):
         def loss_of(p):
+            if pol is not None:
+                p = pol.cast_to_compute(p)
+                features_c = pol.cast_to_compute(features)
+            else:
+                features_c = features
             output, new_state = apply_model(
-                module, p, ts.state, features, training=True, rng=rng
+                module, p, state, features_c, training=True, rng=rng
             )
+            if pol is not None:
+                output = pol.cast_output(output)
             return loss_fn(output, labels), new_state
 
-        (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            ts.params
-        )
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(params)
+        return loss, grads, new_state
+
+    def step(ts, features, labels, rng):
+        if accum_steps == 1:
+            loss, grads, new_state = grads_of(
+                ts.params, ts.state, features, labels, rng
+            )
+        else:
+
+            def split(leaf):
+                n = leaf.shape[0]
+                if n % accum_steps:
+                    raise ValueError(
+                        "batch dim %d not divisible by accum_steps %d"
+                        % (n, accum_steps)
+                    )
+                return leaf.reshape(
+                    (accum_steps, n // accum_steps) + leaf.shape[1:]
+                )
+
+            micro = jax.tree_util.tree_map(split, (features, labels))
+
+            def body(carry, scanned):
+                state, grad_sum, loss_sum, i = carry
+                f, l = scanned
+                loss_i, grads_i, state = grads_of(
+                    ts.params, state, f, l, jax.random.fold_in(rng, i)
+                )
+                grad_sum = jax.tree_util.tree_map(
+                    jnp.add, grad_sum, grads_i
+                )
+                return (state, grad_sum, loss_sum + loss_i, i + 1), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
+            (new_state, grad_sum, loss_sum, _), _ = jax.lax.scan(
+                body, (ts.state, zeros, jnp.float32(0.0), 0), micro
+            )
+            inv = 1.0 / accum_steps
+            grads = jax.tree_util.tree_map(
+                lambda g: g * jnp.asarray(inv, g.dtype), grad_sum
+            )
+            loss = loss_sum * inv
         if pmean_axis is not None:
             grads = jax.lax.pmean(grads, pmean_axis)
             loss = jax.lax.pmean(loss, pmean_axis)

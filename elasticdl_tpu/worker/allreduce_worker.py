@@ -58,6 +58,8 @@ class AllReduceWorker:
         devices=None,
         data_reader_params=None,
         seed=0,
+        accum_steps=1,
+        precision=None,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -100,6 +102,7 @@ class AllReduceWorker:
         self.trainer = AllReduceTrainer(
             model, spec.loss, spec.optimizer(), mesh=mesh,
             param_specs=param_specs, seed=seed,
+            accum_steps=accum_steps, precision=precision,
         )
         self._forward_fn = None
         self._model = model

@@ -73,6 +73,7 @@ class ElasticAllReduceWorker:
         checkpoint_dir="",
         checkpoint_steps=0,
         keep_checkpoint_max=0,
+        precision=None,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -123,7 +124,11 @@ class ElasticAllReduceWorker:
                 "single-process ALLREDUCE strategy" % model_def
             )
         self.trainer = ElasticDPTrainer(
-            spec.model, spec.loss, spec.optimizer(), seed=seed
+            spec.model,
+            spec.loss,
+            spec.optimizer(),
+            seed=seed,
+            precision=precision,
         )
         self._task_data_service = TaskDataService(
             self,

@@ -7,7 +7,10 @@ task loop to completion.
 
 import sys
 
-from elasticdl_tpu.common.args import parse_worker_args
+from elasticdl_tpu.common.args import (
+    parse_worker_args,
+    warn_accum_unsupported,
+)
 from elasticdl_tpu.master.rpc_service import MasterClient
 from elasticdl_tpu.worker.worker import Worker
 
@@ -44,6 +47,7 @@ def _run(args):
             ElasticAllReduceWorker,
         )
 
+        warn_accum_unsupported(args, "the multi-process elastic plane")
         ElasticAllReduceWorker(
             worker_id=args.worker_id,
             job_type=args.job_type,
@@ -63,9 +67,11 @@ def _run(args):
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_steps=args.checkpoint_steps,
             keep_checkpoint_max=args.keep_checkpoint_max,
+            precision=args.precision_policy or None,
         ).run()
         return 0
 
+    warn_accum_unsupported(args, "the parameter-server worker")
     worker = Worker(
         worker_id=args.worker_id,
         job_type=args.job_type,
@@ -84,6 +90,7 @@ def _run(args):
         data_reader_params=get_dict_from_params_str(
             args.data_reader_params
         ),
+        precision=args.precision_policy or None,
     )
     worker.run()
     return 0

@@ -85,6 +85,7 @@ class Worker:
         max_minibatch_retry_num=MAX_MINIBATCH_RETRY_NUM,
         data_reader_params=None,
         seed=0,
+        precision=None,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -120,7 +121,9 @@ class Worker:
         self._var_created = False
         self._step_count = 0
 
-        self._grad_fn = make_grad_fn(self._model, self._loss)
+        self._grad_fn = make_grad_fn(
+            self._model, self._loss, precision=precision
+        )
         self._forward_fn = make_forward_fn(self._model)
         # elastic embedding layers (populated at variable creation)
         self._embedding_dims = {}  # {path_tuple: dim}
@@ -583,6 +586,14 @@ class Worker:
                 self._task_data_service.data_reader.metadata,
             )
             dataset = dataset.batch(self._minibatch_size).prefetch(1)
+            if self._var_created and not self._embedding_dims:
+                # double-buffer batches onto the device so host->device
+                # transfer overlaps the previous step's compute. Gated
+                # off for elastic-embedding models: their id capture
+                # (_prepare_embedding_batch) reads ids on host, and for
+                # the first round (variables not yet created) where the
+                # init pass also wants host arrays.
+                dataset = dataset.device_prefetch()
             batches_seen = 0
             for dataset_batch in dataset:
                 batches_seen += 1

@@ -60,6 +60,56 @@ class DatasetTest(unittest.TestCase):
         ds = Dataset.from_tensors(range(3)).map(lambda x: x + 1)
         self.assertEqual(list(ds), list(ds))
 
+    def test_device_prefetch_yields_device_arrays_in_order(self):
+        import jax
+        import numpy as np
+
+        elems = [
+            {"x": np.full((2, 3), i, np.float32), "y": np.int32(i)}
+            for i in range(7)
+        ]
+        out = list(Dataset.from_tensors(elems).device_prefetch())
+        self.assertEqual(len(out), 7)
+        for i, e in enumerate(out):
+            self.assertIsInstance(e["x"], jax.Array)
+            np.testing.assert_array_equal(
+                np.asarray(e["x"]), np.full((2, 3), i, np.float32)
+            )
+
+    def test_device_prefetch_bounds_in_flight_elements(self):
+        produced = []
+
+        def gen():
+            for i in range(10):
+                produced.append(i)
+                yield i
+
+        it = iter(Dataset.from_generator(gen).device_prefetch(buffer_size=2))
+        next(it)
+        # one yielded + buffer_size in flight
+        self.assertLessEqual(len(produced), 4)
+
+    def test_device_prefetch_respects_sharding(self):
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elasticdl_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh({"data": 8}, axis_names=("data",))
+        placement = NamedSharding(mesh, P("data"))
+        batches = [np.arange(16, dtype=np.float32) + i for i in range(3)]
+        out = list(
+            Dataset.from_tensors(batches).device_prefetch(
+                placement=placement
+            )
+        )
+        for i, arr in enumerate(out):
+            self.assertEqual(arr.sharding, placement)
+            np.testing.assert_array_equal(
+                np.asarray(arr), np.arange(16, dtype=np.float32) + i
+            )
+
 
 if __name__ == "__main__":
     unittest.main()
