@@ -15,17 +15,17 @@ import argparse
 def warn_accum_unsupported(args, plane="this training plane"):
     """Log when --grad_accum_steps is set on a plane that ignores it.
 
-    Accumulation lives in the fused train step
-    (training/step.py:make_train_step), used by the single-process
-    ALLREDUCE path; the PS grad fn and the multi-process weighted
-    lockstep step run without it, and silence would let a user believe
-    their activation memory was bounded when it was not."""
+    Accumulation lives in the jitted steps of both ALLREDUCE planes
+    (training/step.py:make_train_step,
+    parallel/elastic.py:make_elastic_train_step); the PS grad fn runs
+    without it, and silence would let a user believe their activation
+    memory was bounded when it was not."""
     if getattr(args, "grad_accum_steps", 1) > 1:
         from elasticdl_tpu.common.log_utils import default_logger
 
         default_logger.warning(
-            "--grad_accum_steps=%d is only honored by the "
-            "single-process ALLREDUCE train step; %s runs WITHOUT "
+            "--grad_accum_steps=%d is only honored by the ALLREDUCE "
+            "strategy (single- and multi-process); %s runs WITHOUT "
             "gradient accumulation",
             args.grad_accum_steps,
             plane,

@@ -93,7 +93,13 @@ def broadcast_from_device0(mesh, host_tree):
 
 
 def make_elastic_train_step(
-    module, loss_fn, optimizer, mesh, axis="data", precision=None
+    module,
+    loss_fn,
+    optimizer,
+    mesh,
+    axis="data",
+    precision=None,
+    accum_steps=1,
 ):
     """Weighted lockstep step: ``(ts, features, labels, weights, rng) ->
     (ts', loss, n_active)``.
@@ -107,6 +113,13 @@ def make_elastic_train_step(
     ``precision``: a training.precision.Policy (or preset name); master
     weights, gradients, and the weighted psum math stay in
     ``param_dtype`` — only the forward/backward compute casts down.
+
+    ``accum_steps > 1``: each device scans its local batch in
+    microbatches before the weighted reduction (semantics of
+    training/step.py:make_train_step accumulation; the participation
+    weight applies to the accumulated mean, so elasticity/tail-batch
+    weighting is unchanged). The trainer pads local rows to a multiple
+    of ``accum_steps * local_devices``.
     """
     from elasticdl_tpu.training.precision import get_policy
 
@@ -117,22 +130,41 @@ def make_elastic_train_step(
         # decorrelate stochastic layers (dropout) across the batch shards
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
-        def loss_of(p):
-            if pol is not None:
-                p = pol.cast_to_compute(p)
-                features_c = pol.cast_to_compute(features)
-            else:
-                features_c = features
-            output, new_state = apply_model(
-                module, p, ts.state, features_c, training=True, rng=rng
-            )
-            if pol is not None:
-                output = pol.cast_output(output)
-            return loss_fn(output, labels), new_state
+        def grads_of(state, features_mb, labels_mb, rng_mb):
+            def loss_of(p):
+                if pol is not None:
+                    p = pol.cast_to_compute(p)
+                    features_c = pol.cast_to_compute(features_mb)
+                else:
+                    features_c = features_mb
+                output, new_state = apply_model(
+                    module, p, state, features_c, training=True, rng=rng_mb
+                )
+                if pol is not None:
+                    output = pol.cast_output(output)
+                return loss_fn(output, labels_mb), new_state
 
-        (loss, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            ts.params
-        )
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(ts.params)
+            return loss, grads, new_state
+
+        if accum_steps == 1:
+            loss, grads, new_state = grads_of(
+                ts.state, features, labels, rng
+            )
+        else:
+            from elasticdl_tpu.training.step import accumulate_gradients
+
+            loss, grads, new_state = accumulate_gradients(
+                grads_of,
+                ts.state,
+                features,
+                labels,
+                rng,
+                accum_steps,
+                ts.params,
+            )
         # liveness (how many devices carried data) is separate from the
         # weighted denominator: tail batches contribute fractional weight
         n = jax.lax.psum((w > 0).astype(jnp.float32), axis)
@@ -177,12 +209,21 @@ def make_elastic_train_step(
 class ElasticDPTrainer:
     """Per-process handle on the global elastic DP training plane."""
 
-    def __init__(self, module, loss_fn, optimizer, seed=0, precision=None):
+    def __init__(
+        self,
+        module,
+        loss_fn,
+        optimizer,
+        seed=0,
+        precision=None,
+        accum_steps=1,
+    ):
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._seed = seed
         self._precision = precision
+        self._accum_steps = max(1, accum_steps)
         self._mesh = None
         self._spec = None
         self._ts = None
@@ -238,6 +279,7 @@ class ElasticDPTrainer:
             self._optimizer,
             self._mesh,
             precision=self._precision,
+            accum_steps=self._accum_steps,
         )
         logger.info(
             "elastic plane established: epoch=%d rank=%d/%d devices=%d",
@@ -270,9 +312,10 @@ class ElasticDPTrainer:
         return jax.tree_util.tree_map(pad, tree)
 
     def local_rows(self, minibatch_size):
-        """Fixed per-process rows: minibatch padded to the local devices."""
-        n_local = jax.local_device_count()
-        return -(-minibatch_size // n_local) * n_local
+        """Fixed per-process rows: minibatch padded so each local device
+        holds a whole number of microbatches."""
+        chunk = jax.local_device_count() * self._accum_steps
+        return -(-minibatch_size // chunk) * chunk
 
     def train_step(self, features, labels, minibatch_size, sync=True):
         """One weighted lockstep step; ``features=None`` participates at

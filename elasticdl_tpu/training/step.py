@@ -47,6 +47,50 @@ class TrainState:
         )
 
 
+def accumulate_gradients(
+    grads_of, init_state, features, labels, rng, accum_steps, params_template
+):
+    """Microbatch gradient accumulation shared by both step builders.
+
+    ``grads_of(state, features_mb, labels_mb, rng_mb) ->
+    (loss, grads, new_state)`` runs under ``lax.scan`` over
+    ``accum_steps`` equal microbatches split from the leading batch dim;
+    returns the mean ``(loss, grads, final_state)``. ``params_template``
+    only shapes the gradient accumulator."""
+
+    def split(leaf):
+        n = leaf.shape[0]
+        if n % accum_steps:
+            raise ValueError(
+                "batch dim %d not divisible by accum_steps %d"
+                % (n, accum_steps)
+            )
+        return leaf.reshape(
+            (accum_steps, n // accum_steps) + leaf.shape[1:]
+        )
+
+    micro = jax.tree_util.tree_map(split, (features, labels))
+
+    def body(carry, scanned):
+        state, grad_sum, loss_sum, i = carry
+        f, l = scanned
+        loss_i, grads_i, state = grads_of(
+            state, f, l, jax.random.fold_in(rng, i)
+        )
+        grad_sum = jax.tree_util.tree_map(jnp.add, grad_sum, grads_i)
+        return (state, grad_sum, loss_sum + loss_i, i + 1), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params_template)
+    (new_state, grad_sum, loss_sum, _), _ = jax.lax.scan(
+        body, (init_state, zeros, jnp.float32(0.0), 0), micro
+    )
+    inv = 1.0 / accum_steps
+    grads = jax.tree_util.tree_map(
+        lambda g: g * jnp.asarray(inv, g.dtype), grad_sum
+    )
+    return loss_sum * inv, grads, new_state
+
+
 def make_grad_fn(module, loss_fn, precision=None):
     """Jitted ``(params, state, features, labels, rng) ->
     (loss, grads, new_state, output)``.
@@ -141,40 +185,15 @@ def make_train_step(
                 ts.params, ts.state, features, labels, rng
             )
         else:
-
-            def split(leaf):
-                n = leaf.shape[0]
-                if n % accum_steps:
-                    raise ValueError(
-                        "batch dim %d not divisible by accum_steps %d"
-                        % (n, accum_steps)
-                    )
-                return leaf.reshape(
-                    (accum_steps, n // accum_steps) + leaf.shape[1:]
-                )
-
-            micro = jax.tree_util.tree_map(split, (features, labels))
-
-            def body(carry, scanned):
-                state, grad_sum, loss_sum, i = carry
-                f, l = scanned
-                loss_i, grads_i, state = grads_of(
-                    ts.params, state, f, l, jax.random.fold_in(rng, i)
-                )
-                grad_sum = jax.tree_util.tree_map(
-                    jnp.add, grad_sum, grads_i
-                )
-                return (state, grad_sum, loss_sum + loss_i, i + 1), None
-
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
-            (new_state, grad_sum, loss_sum, _), _ = jax.lax.scan(
-                body, (ts.state, zeros, jnp.float32(0.0), 0), micro
+            loss, grads, new_state = accumulate_gradients(
+                lambda state, f, l, r: grads_of(ts.params, state, f, l, r),
+                ts.state,
+                features,
+                labels,
+                rng,
+                accum_steps,
+                ts.params,
             )
-            inv = 1.0 / accum_steps
-            grads = jax.tree_util.tree_map(
-                lambda g: g * jnp.asarray(inv, g.dtype), grad_sum
-            )
-            loss = loss_sum * inv
         if pmean_axis is not None:
             grads = jax.lax.pmean(grads, pmean_axis)
             loss = jax.lax.pmean(loss, pmean_axis)
@@ -193,7 +212,7 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
-def make_embedding_grad_fn(module, loss_fn):
+def make_embedding_grad_fn(module, loss_fn, precision=None):
     """Jitted grad step for models with elastic embedding layers.
 
     ``(params, rows_tree, state, idx_tree, features, labels, rng) ->
@@ -204,11 +223,19 @@ def make_embedding_grad_fn(module, loss_fn):
     (nn/embedding.py). Differentiating w.r.t. the rows collection yields
     the per-layer batch-embedding-tensor gradients the reference captures
     with ``tape.watch`` (reference layers/embedding.py:200-214).
+    ``precision`` as in :func:`make_train_step`; param AND row grads
+    leave in ``param_dtype`` (the PS row update is f32 host math).
     """
     from elasticdl_tpu.nn.embedding import IDX_COLLECTION, ROWS_COLLECTION
+    from elasticdl_tpu.training.precision import get_policy
+
+    pol = get_policy(precision)
 
     def step(params, rows_tree, state, idx_tree, features, labels, rng):
         def loss_of(p, rows):
+            if pol is not None:
+                p = pol.cast_to_compute(p)
+                rows = pol.cast_to_compute(rows)
             variables = {
                 "params": p,
                 ROWS_COLLECTION: rows,
@@ -231,6 +258,8 @@ def make_embedding_grad_fn(module, loss_fn):
                     variables, features, training=True, rngs=rngs
                 )
                 new_state = state
+            if pol is not None:
+                output = pol.cast_output(output)
             return loss_fn(output, labels), (output, new_state)
 
         (loss, (output, new_state)), (param_grads, row_grads) = (

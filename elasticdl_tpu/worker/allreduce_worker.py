@@ -64,6 +64,7 @@ class AllReduceWorker:
         self._worker_id = worker_id
         self._job_type = job_type
         self._minibatch_size = minibatch_size
+        self._accum_steps = max(1, accum_steps)
         self._stub = stub
         spec = get_model_spec(
             model_zoo=model_zoo,
@@ -123,15 +124,16 @@ class AllReduceWorker:
     # -- steps --------------------------------------------------------------
 
     def _pad_to_devices(self, features, labels):
-        """Pad a partial batch up to a multiple of the mesh size.
+        """Pad a partial batch up to a multiple of mesh size x
+        accum_steps (each device must hold whole microbatches).
 
         Padding repeats the final example; the padded rows slightly
         re-weight the last partial batch of a task (bounded by
-        n_devices/batch) — the price of static shapes on the mesh.
+        n_devices*accum/batch) — the price of static shapes on the mesh.
         """
         import jax
 
-        n = self.trainer.num_devices
+        n = self.trainer.num_devices * self._accum_steps
         leaf = jax.tree_util.tree_leaves(features)[0]
         b = np.asarray(leaf).shape[0]
         pad = (-b) % n

@@ -74,6 +74,7 @@ class ElasticAllReduceWorker:
         checkpoint_steps=0,
         keep_checkpoint_max=0,
         precision=None,
+        accum_steps=1,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -129,6 +130,7 @@ class ElasticAllReduceWorker:
             spec.optimizer(),
             seed=seed,
             precision=precision,
+            accum_steps=accum_steps,
         )
         self._task_data_service = TaskDataService(
             self,

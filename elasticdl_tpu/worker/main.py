@@ -47,7 +47,6 @@ def _run(args):
             ElasticAllReduceWorker,
         )
 
-        warn_accum_unsupported(args, "the multi-process elastic plane")
         ElasticAllReduceWorker(
             worker_id=args.worker_id,
             job_type=args.job_type,
@@ -68,6 +67,7 @@ def _run(args):
             checkpoint_steps=args.checkpoint_steps,
             keep_checkpoint_max=args.keep_checkpoint_max,
             precision=args.precision_policy or None,
+            accum_steps=args.grad_accum_steps,
         ).run()
         return 0
 

@@ -121,6 +121,7 @@ class Worker:
         self._var_created = False
         self._step_count = 0
 
+        self._precision = precision
         self._grad_fn = make_grad_fn(
             self._model, self._loss, precision=precision
         )
@@ -275,7 +276,7 @@ class Worker:
                     path: info[1] for path, info in layer_info.items()
                 }
                 self._emb_grad_fn = make_embedding_grad_fn(
-                    self._model, self._loss
+                    self._model, self._loss, precision=self._precision
                 )
                 self._emb_forward_fn = make_embedding_forward_fn(self._model)
         if not self._var_created:

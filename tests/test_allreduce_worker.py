@@ -50,6 +50,36 @@ def test_allreduce_worker_completes_job():
     assert all(np.isfinite(losses))
 
 
+def test_allreduce_worker_accum_survives_tail_batches():
+    """Tail batches must pad to devices x accum_steps, not just devices
+    — otherwise the microbatch split rejects every task's last batch and
+    the job wedges in a fail-report/requeue loop."""
+    f = create_recordio_file(120, DatasetName.IMAGE_DEFAULT, (28, 28))
+    task_d = TaskDispatcher({f: (0, 120)}, {}, {}, 64, 1)
+    master = MasterServicer(
+        1,
+        16,
+        None,
+        task_d,
+        checkpoint_service=CheckpointService("", 0, 0, False),
+        use_async=True,
+    )
+    worker = AllReduceWorker(
+        worker_id=0,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=16,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def="mnist_subclass.mnist_subclass.CustomModel",
+        stub=InProcessMaster(master),
+        accum_steps=4,
+    )
+    losses = worker.run()
+    assert task_d.finished()
+    # 120 records / batch 16 = 8 batches (incl. one 8-row tail)
+    assert worker.trainer.version == 8
+    assert all(np.isfinite(losses))
+
+
 def test_allreduce_worker_elastic_resize_mid_job():
     task_d, master, worker = _job(num_epochs=1)
     # consume the first dataset round manually: train a few batches then

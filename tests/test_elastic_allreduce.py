@@ -185,6 +185,75 @@ def test_weighted_step_matches_plain_and_drain_is_noop():
         np.testing.assert_array_equal(a, b)
 
 
+def test_weighted_step_with_accumulation_matches_plain():
+    """accum_steps=2 on the weighted plane must equal the plain
+    full-batch step (16 rows -> 8 devices x 2 microbatches of 1)."""
+    import flax.linen as nn
+    import jax
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+    from elasticdl_tpu.parallel.elastic import (
+        broadcast_from_device0,
+        host_copy,
+        make_elastic_train_step,
+    )
+    from elasticdl_tpu.training.step import TrainState, make_train_step
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, inputs, training=False):
+            x = inputs["image"].reshape((inputs["image"].shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(10)(x)
+
+    def loss_fn(output, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            output, labels.reshape(-1)
+        ).mean()
+
+    model = MLP()
+    rng = np.random.default_rng(3)
+    features = {"image": rng.random((16, 28, 28), dtype=np.float32)}
+    labels = rng.integers(0, 10, size=(16, 1)).astype(np.int64)
+    variables = init_variables(
+        model, jax.random.PRNGKey(0), {"image": features["image"][:1]}
+    )
+    params, state = split_variables(variables)
+    opt = optax.sgd(0.1)
+    ts0 = TrainState.create(params, state, opt)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    ts = broadcast_from_device0(mesh, host_copy(ts0))
+    step = make_elastic_train_step(model, loss_fn, opt, mesh, accum_steps=2)
+
+    def put(tree, spec):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree
+        )
+
+    key = jax.random.PRNGKey(7)
+    with mesh:
+        ts1, loss, n = step(
+            ts,
+            put(features, P("data")),
+            put(labels, P("data")),
+            put(np.ones(8, np.float32), P("data")),
+            key,
+        )
+    assert int(n) == 8
+
+    plain = make_train_step(model, loss_fn, opt)
+    ts_plain, loss_plain = plain(ts0, features, labels, key)
+    np.testing.assert_allclose(float(loss), float(loss_plain), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(host_copy(ts1.params)),
+        jax.tree_util.tree_leaves(host_copy(ts_plain.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
 # -- rung 2: real OS processes over gloo ------------------------------------
 
 
