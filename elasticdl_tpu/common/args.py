@@ -292,6 +292,14 @@ def add_common_args_between_master_and_worker(parser):
         "model's own dtype behavior; mixed_bfloat16 = f32 master "
         "weights, bf16 compute — the standard TPU recipe)",
     )
+    parser.add_argument(
+        "--wire_dtype",
+        default="",
+        choices=["", "bfloat16"],
+        help="Compress f32 model pulls and gradient pushes to this "
+        "dtype on the wire (PS-mode hot path); receivers upcast back "
+        "to f32 before any optimizer math",
+    )
 
 
 def parse_master_args(master_args=None):
@@ -326,6 +334,9 @@ def parse_ps_args(ps_args=None):
     parser.add_argument("--grads_to_wait", type=pos_int, default=1)
     add_bool_param(parser, "--use_async", False, "")
     add_bool_param(parser, "--lr_staleness_modulation", False, "")
+    parser.add_argument(
+        "--wire_dtype", default="", choices=["", "bfloat16"]
+    )
     parser.add_argument(
         "--log_level",
         default="INFO",

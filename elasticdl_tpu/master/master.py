@@ -294,7 +294,9 @@ class Master:
         port = self.args.port if self.args.port is not None else 50001
         self._server = serve(
             MasterRpcService(
-                self.master_servicer, membership=self.membership
+                self.master_servicer,
+                membership=self.membership,
+                wire_dtype=getattr(self.args, "wire_dtype", ""),
             ).rpc_methods(),
             port,
         )

@@ -15,11 +15,16 @@ from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
 
 
 class MasterRpcService:
-    """Server side: dict-message handlers around a MasterServicer."""
+    """Server side: dict-message handlers around a MasterServicer.
 
-    def __init__(self, servicer, membership=None):
+    ``wire_dtype="bfloat16"`` halves model-pull wire bytes (see
+    rpc/wire_compression.py); gradient decompression is driven by the
+    request's own ``compressed_f32`` field, so it works regardless."""
+
+    def __init__(self, servicer, membership=None, wire_dtype=""):
         self._s = servicer
         self._membership = membership
+        self._wire_dtype = wire_dtype
 
     def get_task(self, req):
         task_type = req.get("task_type")
@@ -39,13 +44,20 @@ class MasterRpcService:
         }
 
     def get_model(self, req):
+        from elasticdl_tpu.rpc.wire_compression import compress_tensors
+
         version, named = self._s.get_model(
             req.get("version", 0),
             GetModelMethod(req.get("method", 0)),
         )
+        params, compressed = compress_tensors(
+            [Tensor(n, v) for n, v in sorted(named.items())],
+            self._wire_dtype,
+        )
         return {
             "version": version,
-            "params": [Tensor(n, v) for n, v in sorted(named.items())],
+            "params": params,
+            "compressed_f32": compressed,
         }
 
     def report_variable(self, req):
@@ -55,8 +67,13 @@ class MasterRpcService:
         return {}
 
     def report_gradient(self, req):
+        from elasticdl_tpu.rpc.wire_compression import decompress_tensors
+
         accepted, version = self._s.report_gradient(
-            req.get("gradients", []), req.get("model_version", -1)
+            decompress_tensors(
+                req.get("gradients", []), req.get("compressed_f32")
+            ),
+            req.get("model_version", -1),
         )
         return {"accepted": accepted, "version": version}
 
@@ -120,10 +137,11 @@ class MasterRpcService:
 class MasterClient:
     """Worker side: the servicer method surface over an rpc.core channel."""
 
-    def __init__(self, addr):
+    def __init__(self, addr, wire_dtype=""):
         from elasticdl_tpu.rpc.core import Client
 
         self._client = Client(addr)
+        self._wire_dtype = wire_dtype
 
     def get_task(self, worker_id, task_type=None):
         resp = self._client.call(
@@ -143,12 +161,15 @@ class MasterClient:
         )
 
     def get_model(self, version, method=GetModelMethod.MINIMUM):
+        from elasticdl_tpu.rpc.wire_compression import decompress_tensors
+
         resp = self._client.call(
             "get_model", version=int(version), method=int(method)
         )
-        return resp["version"], {
-            t.name: t.values for t in resp.get("params", [])
-        }
+        params = decompress_tensors(
+            resp.get("params", []), resp.get("compressed_f32")
+        )
+        return resp["version"], {t.name: t.values for t in params}
 
     def report_variable(self, named_arrays):
         self._client.call(
@@ -157,10 +178,16 @@ class MasterClient:
         )
 
     def report_gradient(self, gradients, model_version):
+        from elasticdl_tpu.rpc.wire_compression import compress_tensors
+
+        grads, compressed = compress_tensors(
+            list(gradients), self._wire_dtype
+        )
         resp = self._client.call(
             "report_gradient",
-            gradients=list(gradients),
+            gradients=grads,
             model_version=int(model_version),
+            compressed_f32=compressed,
         )
         return resp["accepted"], resp["version"]
 

@@ -33,6 +33,7 @@ class ParameterServer:
             self._optimizer,
             lr_staleness_modulation=bool(args.lr_staleness_modulation),
             use_async=args.use_async,
+            wire_dtype=getattr(args, "wire_dtype", ""),
         )
 
     def prepare(self):

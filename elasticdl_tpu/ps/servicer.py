@@ -32,9 +32,11 @@ class PserverServicer:
         optimizer,
         lr_staleness_modulation=False,
         use_async=False,
+        wire_dtype="",
     ):
         self._parameters = parameters
         self._grads_to_wait = grads_to_wait
+        self._wire_dtype = wire_dtype
         self._lock = threading.Lock()
         self._use_async = use_async
         self._version_lock = threading.Lock()
@@ -52,13 +54,20 @@ class PserverServicer:
 
     def pull_variable(self, req):
         """All non-embedding params + init status (reference :36-57)."""
+        from elasticdl_tpu.rpc.wire_compression import compress_tensors
+
         if not self._parameters.initialized:
             return {"model_init_status": False, "version": -1}
         named = self._parameters.to_named_arrays()
+        params, compressed = compress_tensors(
+            [Tensor(n, v) for n, v in sorted(named.items())],
+            self._wire_dtype,
+        )
         return {
             "model_init_status": True,
             "version": self._parameters.version,
-            "params": [Tensor(n, v) for n, v in sorted(named.items())],
+            "params": params,
+            "compressed_f32": compressed,
         }
 
     def pull_embedding_vector(self, req):
@@ -94,8 +103,12 @@ class PserverServicer:
 
     def push_gradient(self, req):
         """Sync/async gradient apply (reference :88-150)."""
+        from elasticdl_tpu.rpc.wire_compression import decompress_tensors
+
         version = int(req.get("model_version", -1))
-        gradients = req.get("gradients", [])
+        gradients = decompress_tensors(
+            req.get("gradients", []), req.get("compressed_f32")
+        )
         if self._use_async:
             self._apply(gradients, version)
             return {"accepted": True, "version": self._parameters.version}

@@ -1,0 +1,59 @@
+"""Opt-in bfloat16 wire compression for the PS-mode hot path.
+
+The dominant PS-mode wire cost is the dense model pull every
+``get_model_steps`` and the per-step gradient push (reference
+worker.py:748-825 + report_gradient — the reference ships both as f32
+protobufs with no compression). Training math tolerates bf16 transport:
+the receiver upcasts back to f32 before any optimizer/apply step, so
+only the wire narrows — params/grads lose the low 16 mantissa bits in
+transit, the standard TPU-ecosystem gradient-compression tradeoff.
+
+Protocol: the sender downcasts float32 tensor payloads (dense values and
+sparse row values alike) and lists the affected tensor names in a
+``compressed_f32`` message field; the receiver upcasts exactly those
+names. Tensors that are natively bf16 (or any other dtype) pass through
+untouched in both directions, so a bf16-parameter model composes with
+compression, and a sender/receiver flag mismatch degrades to "no
+compression" rather than corruption (the frames are self-describing).
+
+Enable with ``--wire_dtype=bfloat16`` (relayed master -> worker/PS pods
+via the argv relay, so one flag configures the whole job).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.dtypes import dtype_name_to_numpy
+from elasticdl_tpu.common.tensor import Tensor
+
+
+def compress_tensors(tensors, wire_dtype):
+    """Downcast f32 payloads to ``wire_dtype``; returns
+    ``(tensors, compressed_names)``. No-op when ``wire_dtype`` is falsy."""
+    if not wire_dtype:
+        return list(tensors), []
+    if wire_dtype != "bfloat16":
+        raise ValueError("unsupported wire_dtype %r" % (wire_dtype,))
+    # resolved lazily: common/dtypes omits bfloat16 when ml_dtypes is
+    # absent, and that environment must still serve uncompressed RPCs
+    bf16 = dtype_name_to_numpy("bfloat16")
+    out, names = [], []
+    for t in tensors:
+        if t.values is not None and t.values.dtype == np.float32:
+            out.append(Tensor(t.name, t.values.astype(bf16), t.indices))
+            names.append(t.name)
+        else:
+            out.append(t)
+    return out, names
+
+
+def decompress_tensors(tensors, compressed_names):
+    """Upcast the named tensors' payloads back to f32."""
+    if not compressed_names:
+        return list(tensors)
+    names = set(compressed_names)
+    return [
+        Tensor(t.name, t.values.astype(np.float32), t.indices)
+        if t.name in names and t.values is not None
+        else t
+        for t in tensors
+    ]

@@ -29,13 +29,20 @@ def main():
 
 
 def _run(args):
-    stub = MasterClient(args.master_addr) if args.master_addr else None
+    wire_dtype = getattr(args, "wire_dtype", "")
+    stub = (
+        MasterClient(args.master_addr, wire_dtype=wire_dtype)
+        if args.master_addr
+        else None
+    )
     ps_client = None
     if args.ps_addrs:
         from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
 
         addrs = [a for a in args.ps_addrs.split(",") if a]
-        ps_client = PSClient([BoundPS(a) for a in addrs])
+        ps_client = PSClient(
+            [BoundPS(a) for a in addrs], wire_dtype=wire_dtype
+        )
     from elasticdl_tpu.common.model_utils import get_dict_from_params_str
 
     if args.distribution_strategy == "AllreduceStrategy":

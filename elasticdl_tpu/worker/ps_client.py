@@ -18,12 +18,15 @@ from elasticdl_tpu.common.tensor import Tensor
 
 
 class PSClient:
-    def __init__(self, ps_stubs):
+    def __init__(self, ps_stubs, wire_dtype=""):
         """``ps_stubs``: list of objects exposing the Pserver dict-RPC
         methods — rpc.core Clients bound with ``BoundPS`` below, or
         in-process PserverServicer instances (the reference test rung 2
-        uses both)."""
+        uses both). ``wire_dtype="bfloat16"`` compresses pushed
+        gradients (see rpc/wire_compression.py); pulled params
+        decompress by the response's own field."""
         self._ps = ps_stubs
+        self._wire_dtype = wire_dtype
 
     @property
     def num_ps(self):
@@ -63,6 +66,8 @@ class PSClient:
     def pull_dense(self):
         """Merge every shard's params; returns (all_initialized, version,
         {name: ndarray})."""
+        from elasticdl_tpu.rpc.wire_compression import decompress_tensors
+
         named = {}
         versions = []
         for ps in self._ps:
@@ -70,7 +75,9 @@ class PSClient:
             if not resp.get("model_init_status"):
                 return False, -1, {}
             versions.append(resp["version"])
-            for t in resp.get("params", []):
+            for t in decompress_tensors(
+                resp.get("params", []), resp.get("compressed_f32")
+            ):
                 named[t.name] = t.values
         return True, min(versions), named
 
@@ -90,10 +97,19 @@ class PSClient:
                 t.values, t.indices, self.num_ps
             ).items():
                 reqs[shard].append(Tensor(t.name, values, indices=ids))
+        from elasticdl_tpu.rpc.wire_compression import compress_tensors
+
         accepted, out_version = True, -1
         for ps, tensors in zip(self._ps, reqs):
+            tensors, compressed = compress_tensors(
+                tensors, self._wire_dtype
+            )
             resp = ps.push_gradient(
-                {"model_version": version, "gradients": tensors}
+                {
+                    "model_version": version,
+                    "gradients": tensors,
+                    "compressed_f32": compressed,
+                }
             )
             accepted = resp["accepted"]
             out_version = resp["version"]
