@@ -49,7 +49,11 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.nn.model_api import apply_model, init_variables, split_variables
 from elasticdl_tpu.parallel import distributed
 from elasticdl_tpu.parallel.ring_attention import shard_map
-from elasticdl_tpu.training.step import TrainState
+from elasticdl_tpu.training.step import (
+    TrainState,
+    accumulate_gradients,
+    aux_loss_total,
+)
 
 
 def host_copy(tree):
@@ -142,7 +146,10 @@ def make_elastic_train_step(
                 )
                 if pol is not None:
                     output = pol.cast_output(output)
-                return loss_fn(output, labels_mb), new_state
+                loss = loss_fn(output, labels_mb) + aux_loss_total(
+                    new_state
+                )
+                return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(
                 loss_of, has_aux=True
@@ -154,8 +161,6 @@ def make_elastic_train_step(
                 ts.state, features, labels, rng
             )
         else:
-            from elasticdl_tpu.training.step import accumulate_gradients
-
             loss, grads, new_state = accumulate_gradients(
                 grads_of,
                 ts.state,

@@ -26,14 +26,49 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.parallel.ring_attention import shard_map
 
 
+def topk_gate(logits, k):
+    """(T, E) gate logits -> (expert_idx (T, k), gate_probs (T, k)).
+
+    For ``k > 1`` the selected probabilities renormalize to sum to 1
+    per token (the GShard top-2 recipe)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate, idx = jax.lax.top_k(probs, k)
+    if k > 1:
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+    return idx, gate
+
+
 def top1_gate(logits):
     """(T, E) gate logits -> (expert_idx (T,), gate_prob (T,))."""
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    idx = jnp.argmax(probs, axis=-1)
-    return idx, jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+    idx, gate = topk_gate(logits, 1)
+    return idx[:, 0], gate[:, 0]
 
 
-def moe_apply(expert_fn, expert_params, x, gate_logits, axis_name, capacity):
+def load_balancing_loss(gate_logits):
+    """Switch-transformer auxiliary loss: ``E * sum_e f_e * P_e``.
+
+    ``f_e`` = fraction of tokens whose top-1 expert is ``e``; ``P_e`` =
+    mean router probability for ``e``. Equals 1.0 at perfect balance,
+    grows as routing collapses onto few experts. Differentiable through
+    ``P_e`` (the ``f_e`` factor is piecewise-constant, as in the paper).
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    e = probs.shape[-1]
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p)
+
+
+def moe_apply(
+    expert_fn,
+    expert_params,
+    x,
+    gate_logits,
+    axis_name,
+    capacity,
+    num_selected=1,
+):
     """Route tokens to experts over ``axis_name``; call inside shard_map.
 
     - ``expert_fn(params, x) -> y``: one expert's computation (same
@@ -41,6 +76,10 @@ def moe_apply(expert_fn, expert_params, x, gate_logits, axis_name, capacity):
     - ``expert_params``: this device's expert's parameter slice (leading
       dim 1, squeezed internally).
     - ``x``: (T, D) local tokens; ``gate_logits``: (T, E).
+    - ``num_selected``: top-k routing. Each (token, choice) pair routes
+      as a virtual token through one shared capacity budget, and a
+      token's k expert outputs sum gate-weighted — so top-2 costs 2x
+      the dispatch of top-1, not a separate code path.
 
     Returns (T, D): gate-weighted expert outputs, overflow tokens zero.
     """
@@ -49,22 +88,28 @@ def moe_apply(expert_fn, expert_params, x, gate_logits, axis_name, capacity):
         lambda p: jnp.squeeze(p, axis=0), expert_params
     )
     t_local, d = x.shape
-    cap = min(capacity, t_local)
+    k = num_selected
 
-    expert_idx, gate = top1_gate(gate_logits)
+    idx_tk, gate_tk = topk_gate(gate_logits, k)
+    # choice-major virtual tokens: v[j*T + t] = (token t, choice j)
+    expert_idx = idx_tk.T.reshape(-1)  # (k*T,)
+    gate = gate_tk.T.reshape(-1)
+    vx = jnp.tile(x, (k, 1))  # (k*T, D)
+    t_virtual = k * t_local
+    cap = min(capacity, t_virtual)
 
-    # position of each token within its expert's bucket (stable order)
+    # position of each virtual token within its expert's bucket
     order = jnp.argsort(expert_idx, stable=True)
     sorted_expert = expert_idx[order]
     counts = jnp.bincount(expert_idx, length=n_exp)
     starts = jnp.cumsum(counts) - counts
-    pos = jnp.arange(t_local) - starts[sorted_expert]
+    pos = jnp.arange(t_virtual) - starts[sorted_expert]
     ok = pos < cap
     slot = jnp.where(ok, pos, cap)  # overflow -> trash column
 
     # (E, cap+1, D) send buffer; row e = tokens for expert e
     send = jnp.zeros((n_exp, cap + 1, d), x.dtype)
-    send = send.at[sorted_expert, slot].set(x[order])[:, :cap]
+    send = send.at[sorted_expert, slot].set(vx[order])[:, :cap]
     recv = jax.lax.all_to_all(
         send, axis_name, split_axis=0, concat_axis=0, tiled=True
     )  # (E, cap, D): row p = tokens shard p sent to THIS expert
@@ -82,23 +127,33 @@ def moe_apply(expert_fn, expert_params, x, gate_logits, axis_name, capacity):
         0.0,
     )
     inv = jnp.argsort(order, stable=True)
-    routed = gathered[inv]
-    return routed * gate[:, None].astype(x.dtype)
+    routed = gathered[inv] * gate[:, None].astype(x.dtype)
+    return routed.reshape(k, t_local, d).sum(axis=0)
 
 
 def make_moe_fn(
-    mesh, expert_fn, expert_axis="expert", batch_axis=None, capacity_factor=2.0
+    mesh,
+    expert_fn,
+    expert_axis="expert",
+    batch_axis=None,
+    capacity_factor=2.0,
+    num_selected=1,
 ):
     """Global wrapper: ``(stacked_expert_params, x, gate_logits) -> y``.
 
     ``stacked_expert_params`` leaves are (E, ...) sharded over
     ``expert_axis``; ``x`` is (T, D) tokens (optionally sharded over
     ``batch_axis``), ``gate_logits`` (T, E) likewise. Capacity per
-    expert = ceil(T_local / E) * capacity_factor.
+    expert = ceil(T_local * num_selected / E) * capacity_factor.
     """
 
     def _capacity(t_local, n_exp):
-        return max(1, int(-(-t_local // n_exp) * capacity_factor))
+        return max(
+            1,
+            int(
+                -(-(t_local * num_selected) // n_exp) * capacity_factor
+            ),
+        )
 
     @functools.partial(
         shard_map,
@@ -110,18 +165,28 @@ def make_moe_fn(
     def _moe(stacked_params, x, gate_logits):
         cap = _capacity(x.shape[0], int(mesh.shape[expert_axis]))
         return moe_apply(
-            expert_fn, stacked_params, x, gate_logits, expert_axis, cap
+            expert_fn,
+            stacked_params,
+            x,
+            gate_logits,
+            expert_axis,
+            cap,
+            num_selected=num_selected,
         )
 
     return _moe
 
 
-def reference_moe(expert_fn, per_expert_params, x, gate_logits):
+def reference_moe(expert_fn, per_expert_params, x, gate_logits, num_selected=1):
     """Dense semantics the routed form must match (tests): every expert
-    runs every token, outputs selected by the top-1 gate."""
-    idx, gate = top1_gate(gate_logits)
+    runs every token, outputs combined by the top-k gate."""
+    idx, gate = topk_gate(gate_logits, num_selected)
     outs = jnp.stack(
         [expert_fn(p, x) for p in per_expert_params]
     )  # (E, T, D)
-    picked = outs[idx, jnp.arange(x.shape[0])]
-    return picked * gate[:, None].astype(x.dtype)
+    t = jnp.arange(x.shape[0])
+    picked = sum(
+        outs[idx[:, j], t] * gate[:, j, None].astype(x.dtype)
+        for j in range(num_selected)
+    )
+    return picked

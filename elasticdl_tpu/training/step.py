@@ -47,6 +47,24 @@ class TrainState:
         )
 
 
+AUX_LOSS_COLLECTION = "aux_loss"
+
+
+def aux_loss_total(state):
+    """Sum of the model's ``aux_loss`` collection (e.g. the MoE
+    load-balancing loss, parallel/expert.py). Modules write per-call
+    auxiliary losses there via ``self.variable(AUX_LOSS_COLLECTION, ...)``;
+    every step builder adds this total to the task loss INSIDE the
+    differentiated function, so gradients flow to the producing params
+    (the router). Returns 0.0 when the collection is absent."""
+    if not isinstance(state, dict) or AUX_LOSS_COLLECTION not in state:
+        return jnp.float32(0.0)
+    leaves = jax.tree_util.tree_leaves(state[AUX_LOSS_COLLECTION])
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(v.astype(jnp.float32)) for v in leaves)
+
+
 def accumulate_gradients(
     grads_of, init_state, features, labels, rng, accum_steps, params_template
 ):
@@ -116,7 +134,8 @@ def make_grad_fn(module, loss_fn, precision=None):
             )
             if pol is not None:
                 output = pol.cast_output(output)
-            return loss_fn(output, labels), (output, new_state)
+            loss = loss_fn(output, labels) + aux_loss_total(new_state)
+            return loss, (output, new_state)
 
         (loss, (output, new_state)), grads = jax.value_and_grad(
             loss_of, has_aux=True
@@ -172,7 +191,8 @@ def make_train_step(
             )
             if pol is not None:
                 output = pol.cast_output(output)
-            return loss_fn(output, labels), new_state
+            loss = loss_fn(output, labels) + aux_loss_total(new_state)
+            return loss, new_state
 
         (loss, new_state), grads = jax.value_and_grad(
             loss_of, has_aux=True

@@ -46,21 +46,30 @@ def _rotary(x, positions):
 
 
 class MoEMlp(nn.Module):
-    """Mixture-of-experts MLP: top-1 routing over the ``expert`` mesh
+    """Mixture-of-experts MLP: top-k routing over the ``expert`` mesh
     axis (parallel/expert.py). Expert parameters are stacked on a leading
     (E,) dim sharded over the axis; the dense fallback (no mesh / no
-    ``expert`` axis) computes every expert and selects by gate — the
-    routed form's reference semantics."""
+    ``expert`` axis) computes every expert and combines by gate — the
+    routed form's reference semantics. With ``aux_loss_coef > 0`` the
+    Switch load-balancing loss is written to the ``aux_loss`` collection,
+    which every step builder adds to the task loss (training/step.py)."""
 
     num_experts: int
     mlp_dim: int
     dtype: Any
     mesh: Any = None
     capacity_factor: float = 2.0
+    num_selected: int = 1
+    aux_loss_coef: float = 0.01
 
     @nn.compact
     def __call__(self, h):
-        from elasticdl_tpu.parallel.expert import make_moe_fn, reference_moe
+        from elasticdl_tpu.parallel.expert import (
+            load_balancing_loss,
+            make_moe_fn,
+            reference_moe,
+        )
+        from elasticdl_tpu.training.step import AUX_LOSS_COLLECTION
 
         d = h.shape[-1]
         e = self.num_experts
@@ -85,6 +94,17 @@ class MoEMlp(nn.Module):
         stacked = {"up": w_up, "down": w_down}
         tokens = h.reshape(-1, d)
         logits_flat = gate_logits.reshape(-1, e)
+        aux = self.variable(
+            AUX_LOSS_COLLECTION,
+            "moe_balance",
+            lambda: jnp.zeros((), jnp.float32),
+        )
+        if self.is_mutable_collection(AUX_LOSS_COLLECTION):
+            # training applies pass state collections as mutable; eval
+            # forwards are immutable and skip the write
+            aux.value = self.aux_loss_coef * load_balancing_loss(
+                logits_flat
+            )
         use_routed = (
             self.mesh is not None and "expert" in self.mesh.axis_names
         )
@@ -101,6 +121,7 @@ class MoEMlp(nn.Module):
                 expert_axis="expert",
                 batch_axis=batch_axis,
                 capacity_factor=self.capacity_factor,
+                num_selected=self.num_selected,
             )
             out = moe(stacked, tokens, logits_flat)
         else:
@@ -108,7 +129,11 @@ class MoEMlp(nn.Module):
                 {"up": w_up[i], "down": w_down[i]} for i in range(e)
             ]
             out = reference_moe(
-                expert_fn, per_expert, tokens, logits_flat
+                expert_fn,
+                per_expert,
+                tokens,
+                logits_flat,
+                num_selected=self.num_selected,
             )
         return out.reshape(h.shape).astype(h.dtype)
 
@@ -122,6 +147,8 @@ class Block(nn.Module):
     num_experts: int = 0
     mesh: Any = None
     moe_capacity_factor: float = 2.0
+    moe_num_selected: int = 1
+    moe_aux_loss_coef: float = 0.01
 
     @nn.compact
     def __call__(self, x, positions):
@@ -153,6 +180,8 @@ class Block(nn.Module):
                 dtype=self.dtype,
                 mesh=self.mesh,
                 capacity_factor=self.moe_capacity_factor,
+                num_selected=self.moe_num_selected,
+                aux_loss_coef=self.moe_aux_loss_coef,
                 name="moe_mlp",
             )(h)
         else:
@@ -176,10 +205,12 @@ class TransformerLM(nn.Module):
     # path uses the fused ring). Trains blockwise since round 2 — the
     # backward recomputes p per tile from the saved logsumexp.
     use_flash: bool = True
-    # >0 turns every block's MLP into a top-1 MoE; expert parameters
+    # >0 turns every block's MLP into a top-k MoE; expert parameters
     # shard over the mesh's 'expert' axis when present (parallel/expert)
     num_experts: int = 0
     moe_capacity_factor: float = 2.0
+    moe_num_selected: int = 1  # top-k routing (2 = GShard top-2)
+    moe_aux_loss_coef: float = 0.01  # Switch load-balancing loss weight
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -226,6 +257,8 @@ class TransformerLM(nn.Module):
                 num_experts=self.num_experts,
                 mesh=self.mesh,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_num_selected=self.moe_num_selected,
+                moe_aux_loss_coef=self.moe_aux_loss_coef,
                 name="block_%d" % i,
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype)(x)
@@ -247,6 +280,8 @@ def custom_model(
     use_flash=True,
     num_experts=0,
     moe_capacity_factor=2.0,
+    moe_num_selected=1,
+    moe_aux_loss_coef=0.01,
 ):
     return TransformerLM(
         vocab_size=vocab_size,
@@ -261,6 +296,8 @@ def custom_model(
         use_flash=use_flash,
         num_experts=num_experts,
         moe_capacity_factor=moe_capacity_factor,
+        moe_num_selected=moe_num_selected,
+        moe_aux_loss_coef=moe_aux_loss_coef,
     )
 
 

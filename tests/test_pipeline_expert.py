@@ -281,3 +281,103 @@ def test_moe_transformer_dense_fallback_matches_routed():
             atol=2e-4,
             err_msg=str(shape),
         )
+
+
+def test_topk_gate_renormalizes():
+    from elasticdl_tpu.parallel.expert import topk_gate
+
+    logits = np.array([[2.0, 1.0, 0.0, -1.0]], np.float32)
+    idx, gate = topk_gate(jnp.asarray(logits), 2)
+    assert idx.shape == (1, 2) and gate.shape == (1, 2)
+    assert list(np.asarray(idx[0])) == [0, 1]
+    np.testing.assert_allclose(float(gate.sum()), 1.0, rtol=1e-6)
+    # relative odds of the two selected experts preserved
+    np.testing.assert_allclose(
+        float(gate[0, 0] / gate[0, 1]), np.e, rtol=1e-4
+    )
+
+
+def test_moe_top2_matches_dense_top2():
+    mesh = create_mesh({"expert": 8}, axis_names=("expert",))
+    experts = _expert_params(8, seed=5)
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((64, D)).astype(np.float32)
+    logits = rng.standard_normal((64, 8)).astype(np.float32)
+
+    moe = make_moe_fn(
+        mesh, _expert_fn, capacity_factor=8.0, num_selected=2
+    )
+    stacked = stack_stage_params(experts)
+    with mesh:
+        got = np.asarray(jax.jit(moe)(stacked, x, logits))
+    want = np.asarray(
+        reference_moe(_expert_fn, experts, x, logits, num_selected=2)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_load_balancing_loss_calibration():
+    from elasticdl_tpu.parallel.expert import load_balancing_loss
+
+    e = 8
+    # perfectly balanced: each expert gets 1/e of tokens & probability
+    logits = np.tile(np.eye(e, dtype=np.float32) * 0.0, (4, 1))
+    balanced = float(load_balancing_loss(jnp.asarray(logits)))
+    np.testing.assert_allclose(balanced, 1.0, rtol=1e-5)
+    # collapsed: every token hard-routes to expert 0
+    collapsed = np.zeros((32, e), np.float32)
+    collapsed[:, 0] = 20.0
+    assert float(load_balancing_loss(jnp.asarray(collapsed))) > e - 1e-3
+
+
+def test_moe_aux_loss_enters_train_step():
+    """The train step's loss must include the sown aux_loss collection
+    (gradients reach the router even when the task loss plateaus)."""
+    import optax
+
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+    from elasticdl_tpu.training.step import (
+        TrainState,
+        aux_loss_total,
+        make_train_step,
+    )
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    model = zoo.custom_model(
+        vocab_size=32,
+        num_layers=1,
+        num_experts=4,
+        moe_num_selected=2,
+        moe_aux_loss_coef=0.1,
+        use_flash=False,
+    )
+    tokens = np.random.default_rng(0).integers(
+        0, 32, size=(2, 16)
+    ).astype(np.int32)
+    variables = init_variables(
+        model, jax.random.PRNGKey(0), {"tokens": tokens}
+    )
+    params, state = split_variables(variables)
+    assert "aux_loss" in state
+    opt = optax.sgd(0.01)
+    ts = TrainState.create(params, state, opt)
+    step = make_train_step(model, zoo.loss, opt)
+    ts, loss = step(ts, {"tokens": tokens}, tokens, jax.random.PRNGKey(1))
+
+    # manual forward: task loss + aux == step loss
+    from elasticdl_tpu.nn.model_api import apply_model
+
+    output, new_state = apply_model(
+        model,
+        ts.params,
+        ts.state,
+        {"tokens": tokens},
+        training=True,
+        rng=jax.random.PRNGKey(2),
+    )
+    aux = float(aux_loss_total(new_state))
+    assert aux > 0.0  # coef 0.1 * lb-loss(>=1.0)
+    step2 = make_train_step(model, zoo.loss, opt)
+    _, loss2 = step2(ts, {"tokens": tokens}, tokens, jax.random.PRNGKey(2))
+    manual = float(zoo.loss(output, tokens)) + aux
+    np.testing.assert_allclose(float(loss2), manual, rtol=1e-4)
