@@ -139,9 +139,17 @@ class Master:
                 MembershipService,
             )
 
+            import os
+
             self.membership = MembershipService(
                 expected_workers=max(1, getattr(args, "num_workers", 0)),
                 base_port=getattr(args, "comm_base_port", 0),
+                # cold worker start (jax import + reader priming) can
+                # exceed the default grace on loaded CI hosts; a partial
+                # first world costs a churny re-form right at job start
+                form_grace_secs=float(
+                    os.environ.get("EDL_FORM_GRACE_SECS", "30")
+                ),
             )
         self._server = None
         self.instance_manager = self._create_instance_manager(args)

@@ -28,6 +28,22 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.parallel.ring_attention import shard_map
 
 
+def psum_lookup_collective(table_local, ids, axis):
+    """Gather+psum body for one device; ``axis`` must already be bound
+    (call inside shard_map / an outer collective step).
+
+    ``table_local``: this device's (V/n, D) table shard; ``ids``: this
+    device's id slice, any shape. Returns ids.shape + (D,)."""
+    me = jax.lax.axis_index(axis)
+    rows_per = table_local.shape[0]
+    local = ids.astype(jnp.int32) - me * rows_per
+    mask = (local >= 0) & (local < rows_per)
+    safe = jnp.clip(local, 0, rows_per - 1)
+    rows = jnp.take(table_local, safe, axis=0)
+    rows = jnp.where(mask[..., None], rows, 0)
+    return jax.lax.psum(rows, axis)
+
+
 def sharded_lookup(table, ids, mesh, axis):
     """Gather rows of a vocab-sharded table; differentiable.
 
@@ -44,15 +60,7 @@ def sharded_lookup(table, ids, mesh, axis):
     """
 
     def _lookup(table_local, ids):
-        n = jax.lax.psum(1, axis)
-        me = jax.lax.axis_index(axis)
-        rows_per = table_local.shape[0]
-        local = ids.astype(jnp.int32) - me * rows_per
-        mask = (local >= 0) & (local < rows_per)
-        safe = jnp.clip(local, 0, rows_per - 1)
-        rows = jnp.take(table_local, safe, axis=0)
-        rows = jnp.where(mask[..., None], rows, 0)
-        return jax.lax.psum(rows, axis)
+        return psum_lookup_collective(table_local, ids, axis)
 
     axes = set(mesh.axis_names)
     batch_axis = "data" if ("data" in axes and axis != "data") else None
@@ -65,6 +73,56 @@ def sharded_lookup(table, ids, mesh, axis):
         out_specs=out_spec,
         check_rep=False,
     )(table, ids)
+
+
+def a2a_lookup_collective(table_local, ids_flat, axis, capacity=None):
+    """all_to_all routing body for one device; ``axis`` must already be
+    bound (call inside shard_map / an outer collective step).
+
+    ``table_local``: this device's (V/n, D) shard; ``ids_flat``: this
+    device's flat id slice. Returns (ids, D). See
+    :func:`all_to_all_lookup` for the routing/capacity semantics."""
+    n = jax.lax.psum(1, axis)
+    me = jax.lax.axis_index(axis)
+    rows_per = table_local.shape[0]
+    mm = ids_flat.shape[0]  # ids local to this batch shard
+    cap = mm if capacity is None else min(capacity, mm)
+
+    owner = jnp.clip(ids_flat // rows_per, 0, n - 1)
+    order = jnp.argsort(owner, stable=True)
+    sorted_owner = owner[order]
+    sorted_ids = ids_flat[order]
+    counts = jnp.bincount(owner, length=n)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(mm) - starts[sorted_owner]
+    ok = pos < cap
+    # overflow entries write to a trash column (cap) so they can't
+    # clobber a live slot; the buffer is sliced back to cap below
+    pos = jnp.where(ok, pos, cap)
+
+    # (n, cap) send buffers: row p holds the ids this device asks
+    # peer p for; invalid slots carry id -1
+    send_ids = jnp.full((n, cap + 1), -1, jnp.int32)
+    send_ids = send_ids.at[sorted_owner, pos].set(sorted_ids)[:, :cap]
+    pos = jnp.where(ok, pos, 0)
+    recv_ids = jax.lax.all_to_all(
+        send_ids, axis, split_axis=0, concat_axis=0, tiled=True
+    )  # row p = ids peer p asked me for
+
+    local = recv_ids - me * rows_per
+    valid = (local >= 0) & (local < rows_per)
+    rows = jnp.take(
+        table_local, jnp.clip(local, 0, rows_per - 1), axis=0
+    )
+    rows = jnp.where(valid[..., None], rows, 0)
+    back = jax.lax.all_to_all(
+        rows, axis, split_axis=0, concat_axis=0, tiled=True
+    )  # row p = rows for the ids I sent to peer p
+
+    out_sorted = back[sorted_owner, pos]
+    out_sorted = jnp.where(ok[..., None], out_sorted, 0)
+    inv = jnp.argsort(order, stable=True)
+    return out_sorted[inv]
 
 
 def all_to_all_lookup(table, ids, mesh, axis, capacity=None):
@@ -98,50 +156,11 @@ def all_to_all_lookup(table, ids, mesh, axis, capacity=None):
     """
     orig_shape = ids.shape
     flat = jnp.reshape(jnp.asarray(ids).astype(jnp.int32), (-1,))
-    m = flat.shape[0]
 
     def _lookup(table_local, ids_flat):
-        n = jax.lax.psum(1, axis)
-        me = jax.lax.axis_index(axis)
-        rows_per = table_local.shape[0]
-        mm = ids_flat.shape[0]  # ids local to this batch shard
-        cap = mm if capacity is None else min(capacity, mm)
-
-        owner = jnp.clip(ids_flat // rows_per, 0, n - 1)
-        order = jnp.argsort(owner, stable=True)
-        sorted_owner = owner[order]
-        sorted_ids = ids_flat[order]
-        counts = jnp.bincount(owner, length=n)
-        starts = jnp.cumsum(counts) - counts
-        pos = jnp.arange(mm) - starts[sorted_owner]
-        ok = pos < cap
-        # overflow entries write to a trash column (cap) so they can't
-        # clobber a live slot; the buffer is sliced back to cap below
-        pos = jnp.where(ok, pos, cap)
-
-        # (n, cap) send buffers: row p holds the ids this device asks
-        # peer p for; invalid slots carry id -1
-        send_ids = jnp.full((n, cap + 1), -1, jnp.int32)
-        send_ids = send_ids.at[sorted_owner, pos].set(sorted_ids)[:, :cap]
-        pos = jnp.where(ok, pos, 0)
-        recv_ids = jax.lax.all_to_all(
-            send_ids, axis, split_axis=0, concat_axis=0, tiled=True
-        )  # row p = ids peer p asked me for
-
-        local = recv_ids - me * rows_per
-        valid = (local >= 0) & (local < rows_per)
-        rows = jnp.take(
-            table_local, jnp.clip(local, 0, rows_per - 1), axis=0
+        return a2a_lookup_collective(
+            table_local, ids_flat, axis, capacity=capacity
         )
-        rows = jnp.where(valid[..., None], rows, 0)
-        back = jax.lax.all_to_all(
-            rows, axis, split_axis=0, concat_axis=0, tiled=True
-        )  # row p = rows for the ids I sent to peer p
-
-        out_sorted = back[sorted_owner, pos]
-        out_sorted = jnp.where(ok[..., None], out_sorted, 0)
-        inv = jnp.argsort(order, stable=True)
-        return out_sorted[inv]
 
     axes = set(mesh.axis_names)
     batch_axis = "data" if ("data" in axes and axis != "data") else None
@@ -164,6 +183,15 @@ class HbmEmbedding(nn.Module):
     single-axis mesh (where a2a would replicate the ids and lose);
     "a2a"/"psum" force a form. ``capacity`` tunes the a2a per-peer
     bucket (see :func:`all_to_all_lookup`).
+
+    ``collective=True``: for use INSIDE an outer shard_map (the
+    multi-process elastic step, parallel/elastic.py) where nesting
+    another shard_map is impossible. ``axis`` must be bound by the
+    caller; the apply-time table is this device's local shard and the
+    ids are the device's batch slice, so the lookup calls the raw
+    collective bodies directly. a2a is the natural form here — each
+    device routes exactly its local ids even when the table axis IS the
+    batch axis. Init still traces densely (no axis bound at init).
     """
 
     vocab_size: int
@@ -173,18 +201,47 @@ class HbmEmbedding(nn.Module):
     mask_zero: bool = False
     method: str = "auto"
     capacity: int = None
+    collective: bool = False
 
     @nn.compact
     def __call__(self, ids, training=False):
-        table = self.param(
-            "table",
-            nn.initializers.variance_scaling(
-                1.0, "fan_in", "normal", out_axis=0
-            ),
-            (self.vocab_size, self.features),
+        init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "normal", out_axis=0
         )
+        if self.collective:
+            # self.variable, not self.param: flax shape-validates params
+            # against their initializer at apply time, but in collective
+            # mode the apply-time value is this device's (V/n, D) LOCAL
+            # shard of the declared (V, D) table
+            table = self.variable(
+                "params",
+                "table",
+                lambda: init(
+                    self.make_rng("params"),
+                    (self.vocab_size, self.features),
+                ),
+            ).value
+        else:
+            table = self.param(
+                "table", init, (self.vocab_size, self.features)
+            )
         ids = jnp.asarray(ids).astype(jnp.int32)
-        if self.mesh is None:
+        if self.collective and not self.is_initializing():
+            if self.method == "psum":
+                # each device's ids differ inside the outer shard_map, so
+                # a psum of per-device lookups would sum MISALIGNED rows
+                # — silently wrong activations, not a degraded mode
+                raise ValueError(
+                    "HbmEmbedding(collective=True) only supports a2a "
+                    "routing; psum needs replicated ids, which the "
+                    "elastic plane's sharded batch cannot provide"
+                )
+            flat = jnp.reshape(ids, (-1,))
+            out = a2a_lookup_collective(
+                table, flat, self.axis, capacity=self.capacity
+            )
+            emb = jnp.reshape(out, ids.shape + (table.shape[1],))
+        elif self.mesh is None:
             emb = jnp.take(table, ids, axis=0)
         else:
             table = jax.lax.with_sharding_constraint(

@@ -96,6 +96,58 @@ def broadcast_from_device0(mesh, host_tree):
     return pick0(stacked)
 
 
+def collect_sharded_paths(param_specs):
+    """Flatten a nested param_specs dict into {path tuple: PartitionSpec}."""
+    paths = {}
+    if not param_specs:
+        return paths
+
+    def walk(spec_tree, prefix):
+        if hasattr(spec_tree, "items"):
+            for k, sub in spec_tree.items():
+                walk(sub, prefix + (k,))
+        else:
+            paths[prefix] = spec_tree
+
+    walk(param_specs, ())
+    return paths
+
+
+def build_state_specs(ts, sharded_paths):
+    """TrainState-shaped PartitionSpec pytree for the elastic step.
+
+    Leaves whose tree path *ends with* a sharded path get that path's
+    spec — matching both the parameters and their optimizer slots (optax
+    moment trees nest the same sub-structure) — everything else ``P()``.
+    """
+    from elasticdl_tpu.common.pytree import key_path_names
+
+    def spec_for(key_path, _leaf):
+        names = key_path_names(key_path)
+        for spec_path, spec in sharded_paths.items():
+            if tuple(names[-len(spec_path):]) == tuple(spec_path):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, ts)
+
+
+def place_from_host_specs(mesh, tree, spec_tree):
+    """Place a full host pytree on a (possibly multi-process) mesh per a
+    matching spec pytree; each process materializes only its own
+    devices' slices (``make_array_from_callback``)."""
+
+    def put(x, spec):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape,
+            NamedSharding(mesh, spec),
+            lambda idx, x=x: x[idx],
+        )
+
+    return jax.tree_util.tree_map(put, tree, spec_tree)
+
+
 def make_elastic_train_step(
     module,
     loss_fn,
@@ -104,15 +156,28 @@ def make_elastic_train_step(
     axis="data",
     precision=None,
     accum_steps=1,
+    state_specs=None,
 ):
     """Weighted lockstep step: ``(ts, features, labels, weights, rng) ->
     (ts', loss, n_active)``.
 
     ``weights`` is a global (n_devices,) 0/1 array — per-device
-    participation. Gradients and batch statistics merge as weighted psums
-    over ``axis`` divided by the live-device count; with zero live devices
-    the state passes through unchanged and ``version`` does not advance,
-    so drain-mode dummy steps are exact no-ops.
+    participation. The local loss is scaled by ``w / psum(w)`` INSIDE the
+    differentiated function, so every gradient contribution — including
+    row gradients an ``all_to_all`` transpose routes to other devices'
+    table shards — carries its device's weight at the source; replicated
+    leaves then just psum. With zero live devices the state passes
+    through unchanged and ``version`` does not advance, so drain-mode
+    dummy steps are exact no-ops.
+
+    ``state_specs``: optional pytree with the SAME treedef as the
+    TrainState, each leaf a PartitionSpec — ``P()`` for replicated
+    leaves, e.g. ``P(axis, None)`` for HBM-sharded embedding tables (and
+    their co-sharded optimizer slots). Sharded leaves enter the step as
+    their local shard, their gradients stay local (no psum — the a2a
+    backward already routed and weighted them), and the module must use
+    collective lookups (nn/hbm_embedding.py ``collective=True``) since a
+    nested shard_map is impossible here.
 
     ``precision``: a training.precision.Policy (or preset name); master
     weights, gradients, and the weighted psum math stay in
@@ -129,10 +194,18 @@ def make_elastic_train_step(
 
     pol = get_policy(precision)
 
+    def _is_sharded(spec):
+        return spec is not None and any(a is not None for a in spec)
+
     def per_device(ts, features, labels, weights, rng):
         w = weights[0].astype(jnp.float32)
         # decorrelate stochastic layers (dropout) across the batch shards
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        # liveness (how many devices carried data) is separate from the
+        # weighted denominator: tail batches contribute fractional weight
+        n = jax.lax.psum((w > 0).astype(jnp.float32), axis)
+        denom = jnp.maximum(jax.lax.psum(w, axis), 1e-6)
+        scale = w / denom
 
         def grads_of(state, features_mb, labels_mb, rng_mb):
             def loss_of(p):
@@ -146,15 +219,17 @@ def make_elastic_train_step(
                 )
                 if pol is not None:
                     output = pol.cast_output(output)
-                loss = loss_fn(output, labels_mb) + aux_loss_total(
+                raw = loss_fn(output, labels_mb) + aux_loss_total(
                     new_state
                 )
-                return loss, new_state
+                # the weight rides the loss so AD distributes it to
+                # every gradient contribution, local or routed
+                return raw * scale, (raw, new_state)
 
-            (loss, new_state), grads = jax.value_and_grad(
+            (_, (raw, new_state)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(ts.params)
-            return loss, grads, new_state
+            return raw, grads, new_state
 
         if accum_steps == 1:
             loss, grads, new_state = grads_of(
@@ -170,19 +245,34 @@ def make_elastic_train_step(
                 accum_steps,
                 ts.params,
             )
-        # liveness (how many devices carried data) is separate from the
-        # weighted denominator: tail batches contribute fractional weight
-        n = jax.lax.psum((w > 0).astype(jnp.float32), axis)
-        denom = jnp.maximum(jax.lax.psum(w, axis), 1e-6)
 
-        def wavg(x):
+        if state_specs is None:
+            grad_specs = jax.tree_util.tree_map(lambda _: None, grads)
+            state_spec_tree = jax.tree_util.tree_map(
+                lambda _: None, new_state
+            )
+        else:
+            grad_specs = state_specs.params
+            state_spec_tree = state_specs.state
+
+        def reduce_grad(g, spec):
+            if _is_sharded(spec):
+                return g  # local shard; weighting rode the loss
+            return jax.lax.psum(g, axis)  # = sum_d (w_d/denom) g_d
+
+        grads = jax.tree_util.tree_map(reduce_grad, grads, grad_specs)
+        loss = jax.lax.psum(loss * scale, axis)
+
+        def wavg(x, spec):
+            if _is_sharded(spec):
+                return x  # per-shard state stays local
             if jnp.issubdtype(x.dtype, jnp.floating):
                 return jax.lax.psum(x * w, axis) / denom
             return x  # int leaves (counters) advance identically everywhere
 
-        grads = jax.tree_util.tree_map(wavg, grads)
-        loss = wavg(loss)
-        new_state = jax.tree_util.tree_map(wavg, new_state)
+        new_state = jax.tree_util.tree_map(
+            wavg, new_state, state_spec_tree
+        )
 
         updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
         params = optax.apply_updates(ts.params, updates)
@@ -199,11 +289,15 @@ def make_elastic_train_step(
         )
         return new_ts, loss, n
 
+    if state_specs is None:
+        ts_spec = P()
+    else:
+        ts_spec = state_specs
     sharded = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P(), P()),
+        in_specs=(ts_spec, P(axis), P(axis), P(axis), P()),
+        out_specs=(ts_spec, P(), P()),
         check_rep=False,
     )
     # no donation: the pre-step state must survive a failed collective so
@@ -222,13 +316,29 @@ class ElasticDPTrainer:
         seed=0,
         precision=None,
         accum_steps=1,
+        distributed_builder=None,
+        restore_provider=None,
     ):
+        """``distributed_builder``: optional ``mesh -> (module,
+        param_specs)`` hook for HBM-sharded parameters (the zoo's
+        ``build_collective_model`` + ``param_shardings``). Sharded
+        leaves cannot ride the survivor re-broadcast (a dead process's
+        shards are gone), so re-forms restore the WHOLE state from
+        ``restore_provider()`` (the latest sharded checkpoint directory,
+        or None) — recovery granularity is the checkpoint cadence; with
+        no checkpoint the state re-initializes (the reference lost its
+        Redis-resident tables entirely on the same failure,
+        reference master/embedding_service.py)."""
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._seed = seed
         self._precision = precision
         self._accum_steps = max(1, accum_steps)
+        self._builder = distributed_builder
+        self.restore_provider = restore_provider
+        self._sharded_paths = {}
+        self._state_specs = None
         self._mesh = None
         self._spec = None
         self._ts = None
@@ -253,30 +363,59 @@ class ElasticDPTrainer:
         """Cheap liveness check (no device->host transfer)."""
         return self._ts is not None or self._host_ts is not None
 
+    @property
+    def is_sharded(self):
+        """True when parameters shard over the mesh (HBM tables)."""
+        return bool(self._sharded_paths) or self._builder is not None
+
+    def _build_init_ts(self, example_batch):
+        features = example_batch[0]
+        host_one = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[:1], features
+        )
+
+        def build():
+            variables = init_variables(
+                self._module, jax.random.PRNGKey(self._seed), host_one
+            )
+            params, state = split_variables(variables)
+            return TrainState.create(params, state, self._optimizer)
+
+        return build
+
+    def _host_init_ts(self, example_batch):
+        """Deterministic full host init (identical on every process)."""
+        return host_copy(self._build_init_ts(example_batch)())
+
+    def _abstract_ts(self, example_batch):
+        """ShapeDtypeStruct TrainState — treedef/shapes without
+        materializing any parameter values."""
+        return jax.eval_shape(self._build_init_ts(example_batch))
+
     def establish(self, spec, example_batch=None):
         """Join ``spec``'s world and (re)place train state on its mesh.
 
         ``example_batch`` is required the first time (state init); on
         re-forms the previous host snapshot is re-broadcast, with rank 0
-        as the source of truth.
+        as the source of truth. Sharded-parameter jobs instead restore
+        from the latest checkpoint on EVERY establish (see __init__).
         """
         distributed.ensure_world(spec)
         self._spec = spec
         self._mesh = Mesh(np.asarray(jax.devices()), ("data",))
-        if self._host_ts is None:
-            if example_batch is None:
-                raise ValueError("first establish() needs an example batch")
-            features = example_batch[0]
-            host_one = jax.tree_util.tree_map(
-                lambda x: np.asarray(x)[:1], features
-            )
-            variables = init_variables(
-                self._module, jax.random.PRNGKey(self._seed), host_one
-            )
-            params, state = split_variables(variables)
-            ts = TrainState.create(params, state, self._optimizer)
-            self._host_ts = host_copy(ts)
-        self._ts = broadcast_from_device0(self._mesh, self._host_ts)
+        if self._builder is not None:
+            self._module, param_specs = self._builder(self._mesh)
+            self._sharded_paths = collect_sharded_paths(param_specs)
+        if self._sharded_paths:
+            self._establish_sharded(example_batch)
+        else:
+            if self._host_ts is None:
+                if example_batch is None:
+                    raise ValueError(
+                        "first establish() needs an example batch"
+                    )
+                self._host_ts = self._host_init_ts(example_batch)
+            self._ts = broadcast_from_device0(self._mesh, self._host_ts)
         self._checked_ts = self._ts
         self._step_fn = make_elastic_train_step(
             self._module,
@@ -285,14 +424,69 @@ class ElasticDPTrainer:
             self._mesh,
             precision=self._precision,
             accum_steps=self._accum_steps,
+            state_specs=self._state_specs,
         )
         logger.info(
-            "elastic plane established: epoch=%d rank=%d/%d devices=%d",
+            "elastic plane established: epoch=%d rank=%d/%d devices=%d%s",
             spec.epoch,
             spec.process_id,
             spec.num_processes,
             self._mesh.devices.size,
+            " (sharded params)" if self._sharded_paths else "",
         )
+
+    def _establish_sharded(self, example_batch):
+        """Place sharded-parameter state: newest restorable checkpoint
+        (falling back through older complete ones — a killed rank can
+        leave the newest version torn), else deterministic re-init."""
+        from elasticdl_tpu.common.sharded_checkpoint import load_sharded
+
+        if example_batch is None and self._last_local is None:
+            raise ValueError("first establish() needs an example batch")
+        example = example_batch or self._last_local
+        # abstract shapes, not a real init: spec building only needs the
+        # treedef, and a full host materialization of every (V,D) table
+        # on every process at every re-form is exactly the memory spike
+        # vocab-sharding exists to avoid
+        self._state_specs = build_state_specs(
+            self._abstract_ts(example), self._sharded_paths
+        )
+        candidates = (
+            self.restore_provider() if self.restore_provider else None
+        ) or []
+        if isinstance(candidates, str):
+            candidates = [candidates]
+        was_live = self._host_step > 0
+        self._ts = None
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self._mesh, s), self._state_specs
+        )
+        for restore_dir in candidates:
+            try:
+                version, self._ts = load_sharded(restore_dir, shardings)
+                logger.info(
+                    "sharded state restored at v%d from %s",
+                    version,
+                    restore_dir,
+                )
+                break
+            except Exception:
+                logger.warning(
+                    "sharded checkpoint %s unrestorable onto the new "
+                    "mesh; trying older",
+                    restore_dir,
+                    exc_info=True,
+                )
+        if self._ts is None:
+            if was_live:
+                logger.warning(
+                    "membership change with sharded parameters and no "
+                    "restorable checkpoint: state RE-INITIALIZED "
+                    "(enable --checkpoint_steps to bound this loss)"
+                )
+            self._ts = place_from_host_specs(
+                self._mesh, self._host_init_ts(example), self._state_specs
+            )
 
     def _place_batch(self, tree):
         n_proc = self._spec.num_processes
@@ -404,7 +598,13 @@ class ElasticDPTrainer:
         """Pull current state to host (the re-form / checkpoint source).
 
         Falls back to the last fetch-validated state when the newest
-        buffers carry a failed collective (unsynced steps roll back)."""
+        buffers carry a failed collective (unsynced steps roll back).
+        Sharded-parameter jobs return None: one process's host copy of a
+        sharded leaf would be its shard alone — the sharded checkpoint
+        plane (save_sharded / restore on establish) is their snapshot
+        mechanism."""
+        if self._sharded_paths:
+            return None
         if self._ts is not None:
             try:
                 self._host_ts = host_copy(self._ts)

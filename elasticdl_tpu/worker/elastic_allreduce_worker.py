@@ -112,18 +112,45 @@ class ElasticAllReduceWorker:
         zoo_module = load_module(
             get_module_file_path(model_zoo, model_def)
         ).__dict__
-        if "build_distributed_model" in zoo_module:
-            # HBM-sharded tables need sharded snapshot/broadcast across
-            # membership epochs (the sharded-checkpoint plane); the
-            # replicated-state re-form implemented here would silently
-            # corrupt them. The single-process ALLREDUCE path
-            # (api local mode / AllReduceWorker) runs these models today.
+        builder = None
+        if (
+            "build_distributed_model" in zoo_module
+            and "build_collective_model" not in zoo_module
+        ):
+            # training the plain replicated model instead would either
+            # OOM (the table was sharded because it doesn't fit) or
+            # silently change the declared strategy
             raise NotImplementedError(
-                "model %s defines build_distributed_model (HBM-sharded "
-                "parameters); the multi-process elastic plane does not "
-                "support sharded parameters yet — run it under the "
+                "model %s declares HBM-sharded parameters "
+                "(build_distributed_model) but no build_collective_model "
+                "hook; the multi-process elastic plane needs the "
+                "collective-lookup form — add build_collective_model "
+                "(see model_zoo/deepfm_edl_embedding) or run the "
                 "single-process ALLREDUCE strategy" % model_def
             )
+        if "build_collective_model" in zoo_module:
+            # HBM-sharded tables on the elastic plane: the model looks
+            # rows up with raw collectives inside the weighted step's
+            # shard_map, tables shard per param_shardings, and re-forms
+            # restore from the sharded checkpoint plane
+            from elasticdl_tpu.common.model_utils import (
+                get_dict_from_params_str,
+            )
+
+            extra = get_dict_from_params_str(model_params) or {}
+
+            def builder(mesh, _zoo=zoo_module, _extra=extra):
+                return (
+                    _zoo["build_collective_model"](**_extra),
+                    _zoo["param_shardings"](mesh),
+                )
+
+            if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                raise NotImplementedError(
+                    "evaluation interleave is not yet supported for "
+                    "sharded-parameter elastic jobs (eval needs a full "
+                    "host model); run training_only + offline eval"
+                )
         self.trainer = ElasticDPTrainer(
             spec.model,
             spec.loss,
@@ -131,6 +158,7 @@ class ElasticAllReduceWorker:
             seed=seed,
             precision=precision,
             accum_steps=accum_steps,
+            distributed_builder=builder,
         )
         self._task_data_service = TaskDataService(
             self,
@@ -151,6 +179,12 @@ class ElasticAllReduceWorker:
                 keep_checkpoint_max,
                 async_io=True,
             )
+            self.trainer.restore_provider = self._ckpt_dirs_newest_first
+        elif builder is not None:
+            logger.warning(
+                "sharded-parameter elastic job without --checkpoint_steps:"
+                " any membership change RE-INITIALIZES the model"
+            )
         self._restore_attempted = False
         self._last_ckpt_version = 0
         self._batch_gen = None
@@ -160,6 +194,31 @@ class ElasticAllReduceWorker:
         self._forward_fn = None
         self._eval_params_version = None
         self._eval_params = None
+
+    def _ckpt_dirs_newest_first(self):
+        """Candidate checkpoint dirs, newest first; drains in-flight
+        async saves so an establish/restore never reads a half-written
+        one. More than one candidate matters: a killed rank can leave
+        the newest version torn (its manifest missing) while an older
+        complete one sits behind it."""
+        if self._ckpt is None:
+            return []
+        try:
+            self._ckpt.wait()
+        except Exception:
+            logger.warning(
+                "async checkpoint write failed; restoring from the "
+                "previous complete checkpoint",
+                exc_info=True,
+            )
+        return [
+            self._ckpt._dir_for(v)
+            for v in sorted(self._ckpt.versions(), reverse=True)
+        ]
+
+    def _latest_ckpt_dir(self):
+        dirs = self._ckpt_dirs_newest_first()
+        return dirs[0] if dirs else None
 
     # master surface used by TaskDataService
     def get_task(self, task_type=None):
@@ -290,16 +349,23 @@ class ElasticAllReduceWorker:
             try:
                 example = self._retry_batch or self.trainer._last_local
                 self.trainer.establish(world, example_batch=example)
-                if self._ckpt is not None and not self._restore_attempted:
+                if (
+                    self._ckpt is not None
+                    and not self._restore_attempted
+                    and not self.trainer.is_sharded
+                ):
                     self._restore_attempted = True
                     # resume only when the WHOLE world is virgin (the
                     # broadcast state carries version 0). A fresh process
                     # joining a live job receives the survivors' state in
                     # the broadcast; restoring a stale checkpoint over
                     # just this replica would silently de-synchronize the
-                    # replicated parameters.
+                    # replicated parameters. (Sharded-parameter jobs
+                    # restore inside establish() instead, every epoch.)
                     if self.trainer.version == 0:
                         self._restore_latest_checkpoint()
+                if self.trainer.is_sharded:
+                    self._last_ckpt_version = max(0, self.trainer.version)
             except WorldBroken:
                 logger.warning(
                     "world %d broke during formation; re-polling", world.epoch
@@ -374,6 +440,20 @@ class ElasticAllReduceWorker:
                 self._flush_unreported(
                     "" if ok else "collective failed before validation"
                 )
+                if (
+                    ok
+                    and self.trainer.is_sharded
+                    and self._ckpt is not None
+                    and self._ckpt.is_enabled()
+                ):
+                    # graceful membership change: every rank is alive, so
+                    # a checkpoint written NOW makes the re-form's
+                    # restore lossless (a SIGKILLed peer skips this path
+                    # and recovery falls back to the cadence checkpoint)
+                    version = self.trainer.version
+                    if version > self._last_ckpt_version:
+                        self._ckpt.save(self.trainer._ts, version)
+                        self._last_ckpt_version = version
                 from elasticdl_tpu.utils.profiling import maybe_stop_trace
 
                 maybe_stop_trace()  # the trace must not outlive its world
@@ -386,7 +466,12 @@ class ElasticAllReduceWorker:
             # drain steps always (their n_active drives the exit).
             # Records consumed by unsynced steps are reported only once
             # their window validates.
-            sync = batch is None or step_i % self._sync_every == 0
+            # aligned_sync points land at the same step INDEX on every
+            # rank (loop iterations are lockstep — one collective per
+            # iteration), so version reads there agree globally; a
+            # drain-forced sync is local to the draining rank
+            aligned_sync = step_i % self._sync_every == 0
+            sync = batch is None or aligned_sync
             try:
                 if batch is None:
                     loss, n_active, count = self.trainer.train_step(
@@ -425,15 +510,26 @@ class ElasticAllReduceWorker:
                 self._flush_unreported()
                 if (
                     self._ckpt is not None
-                    and world.process_id == 0
+                    and (
+                        world.process_id == 0 or self.trainer.is_sharded
+                    )
                     and self._ckpt.is_enabled()
+                    # sharded checkpoints are only restorable when EVERY
+                    # rank wrote the same version, so the cadence must
+                    # trigger at rank-aligned sync points alone
+                    and (aligned_sync or not self.trainer.is_sharded)
                 ):
                     # checkpoints land at sync points, so the cadence is
                     # "at least checkpoint_steps versions since the last
                     # save" rather than an exact modulo (which would
                     # silently degrade to lcm(sync_every, steps)). Rank 0
                     # alone suffices on the replicated plane (it holds
-                    # replica 0 of every leaf); pure local writes.
+                    # replica 0 of every leaf); with sharded parameters
+                    # EVERY rank writes — each owns distinct table rows,
+                    # and the per-process manifests only assemble into a
+                    # restorable checkpoint when all ranks contributed.
+                    # Versions agree across ranks (lockstep collective
+                    # steps), so all ranks pick the same cadence points.
                     version = self.trainer.version
                     if (
                         version - self._last_ckpt_version
@@ -442,6 +538,21 @@ class ElasticAllReduceWorker:
                         self._ckpt.save(self.trainer._ts, version)
                         self._last_ckpt_version = version
             if n_active == 0:
+                # global quiescence: every rank observes it in the same
+                # collective round with the same (final) version. Sharded
+                # ranks land their shards NOW — the export task (one
+                # rank, in _finalize) needs every OTHER rank's manifest,
+                # and those ranks may legitimately still be here waiting
+                # for the job (incl. that very export task) to finish.
+                if (
+                    self.trainer.is_sharded
+                    and self._ckpt is not None
+                    and self._ckpt.is_enabled()
+                ):
+                    version = self.trainer.version
+                    if version > self._last_ckpt_version:
+                        self._ckpt.save(self.trainer._ts, version)
+                        self._last_ckpt_version = version
                 if self._drained:
                     return "done"
                 time.sleep(0.2)
@@ -529,25 +640,70 @@ class ElasticAllReduceWorker:
         saved_model_path = task.extended_config.get(
             SaveModelConfig.SAVED_MODEL_PATH, "/tmp/edl_saved_model"
         )
-        host_ts = self.trainer.snapshot()
-        if host_ts is None:
-            # never trained (no data ever assigned); let another worker
-            # with state pick the task up
-            self.report_task_result(
-                task.task_id, err_msg="no local train state to export"
-            )
-            return
+        if self.trainer.is_sharded:
+            named, version = self._assemble_sharded_export()
+            if named is None:
+                self.report_task_result(
+                    task.task_id,
+                    err_msg="no complete sharded checkpoint to export",
+                )
+                return
+        else:
+            host_ts = self.trainer.snapshot()
+            if host_ts is None:
+                # never trained (no data ever assigned); let another
+                # worker with state pick the task up
+                self.report_task_result(
+                    task.task_id, err_msg="no local train state to export"
+                )
+                return
+            named = pytree_to_named_arrays(host_ts.params)
+            version = max(0, int(np.asarray(host_ts.version)))
         saved_model_path = os.path.join(
             saved_model_path, str(int(time.time()))
         )
         os.makedirs(saved_model_path, exist_ok=True)
         save_checkpoint_to_file(
-            pytree_to_named_arrays(host_ts.params),
-            max(0, int(np.asarray(host_ts.version))),
+            named,
+            version,
             os.path.join(saved_model_path, "model.chkpt"),
         )
         logger.info("Exported model to %s", saved_model_path)
         self.report_task_result(task_id=task.task_id, err_msg="")
+
+    def _assemble_sharded_export(self):
+        """Full host model from the newest complete sharded checkpoint.
+
+        Every rank wrote a final checkpoint entering _finalize, but the
+        export-task rank may get here before its peers' manifests land —
+        retry on incomplete coverage before falling back to the previous
+        complete version."""
+        from elasticdl_tpu.common.sharded_checkpoint import (
+            load_sharded_to_host,
+        )
+
+        directory = self._latest_ckpt_dir()
+        if directory is None:
+            return None, 0
+        for attempt in range(10):
+            try:
+                version, tree = load_sharded_to_host(directory)
+                return pytree_to_named_arrays(tree["params"]), version
+            except Exception:
+                time.sleep(1.0)
+        logger.warning(
+            "newest checkpoint %s never completed; exporting the "
+            "previous one",
+            directory,
+            exc_info=True,
+        )
+        for version in sorted(self._ckpt.versions(), reverse=True)[1:]:
+            try:
+                v, tree = load_sharded_to_host(self._ckpt._dir_for(version))
+                return pytree_to_named_arrays(tree["params"]), v
+            except Exception:
+                continue
+        return None, 0
 
     def _drain_ckpt(self):
         """Land queued async checkpoint writes; surface IO errors as a
@@ -563,6 +719,19 @@ class ElasticAllReduceWorker:
             )
 
     def _finalize(self):
+        if (
+            self.trainer.is_sharded
+            and self._ckpt is not None
+            and self._ckpt.is_enabled()
+            and self.trainer._ts is not None
+        ):
+            # every rank lands a final checkpoint so the export task (one
+            # rank) and any resume see the finished state, not the last
+            # cadence point
+            version = self.trainer.version
+            if version > self._last_ckpt_version:
+                self._ckpt.save(self.trainer._ts, version)
+                self._last_ckpt_version = version
         self._drain_ckpt()
         if self._job_type == JobType.TRAINING_WITH_EVALUATION:
             try:

@@ -43,9 +43,16 @@ class DeepFMEdl(nn.Module):
     # force the HBM layer even without a mesh (single-device jnp.take —
     # the dense numerics twin the sharded path is validated against)
     force_hbm: bool = False
+    # tables looked up with raw collectives (axis bound by an OUTER
+    # shard_map — the multi-process elastic plane, parallel/elastic.py)
+    collective: bool = False
 
     def _embedding(self, dim, name):
-        if self.mesh is None and not self.force_hbm:
+        if (
+            self.mesh is None
+            and not self.force_hbm
+            and not self.collective
+        ):
             return Embedding(output_dim=dim, mask_zero=True, name=name)
         return HbmEmbedding(
             vocab_size=self.vocab_size,
@@ -53,6 +60,7 @@ class DeepFMEdl(nn.Module):
             mesh=self.mesh,
             axis=self.table_axis,
             mask_zero=True,
+            collective=self.collective,
             name=name,
         )
 
@@ -83,17 +91,27 @@ class DeepFMEdl(nn.Module):
         return {"logits": logits, "probs": probs}
 
 
-def custom_model(embedding_dim=64, input_length=10, fc_unit=64):
+def custom_model(
+    embedding_dim=64, input_length=10, fc_unit=64, vocab_size=VOCAB_SIZE
+):
     return DeepFMEdl(
         embedding_dim=embedding_dim,
         input_length=input_length,
         fc_unit=fc_unit,
+        vocab_size=vocab_size,
     )
 
 
 def build_distributed_model(mesh, table_axis="data", **params):
     """ALLREDUCE-strategy hook: tables row-sharded over mesh HBM."""
     return DeepFMEdl(mesh=mesh, table_axis=table_axis, **params)
+
+
+def build_collective_model(table_axis="data", **params):
+    """Multi-process elastic hook: tables looked up with raw collectives
+    inside the elastic plane's shard_map (parallel/elastic.py pairs this
+    with ``param_shardings`` via ElasticDPTrainer's distributed_builder)."""
+    return DeepFMEdl(collective=True, table_axis=table_axis, **params)
 
 
 def param_shardings(mesh, table_axis="data"):

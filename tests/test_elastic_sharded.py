@@ -1,0 +1,310 @@
+"""HBM-sharded parameters on the multi-process elastic plane
+(BASELINE.json north star: row-partitioned tables in pod HBM + resizable
+process group).
+
+The elastic weighted step scales the loss by w/psum(w) inside the
+differentiated function so a2a-routed table gradients carry their
+device's weight at the source; sharded leaves enter/leave the step as
+local shards with no psum. These pin that math against the dense twin,
+then run the real 2-OS-process job.
+"""
+
+import glob
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.nn.model_api import init_variables, split_variables
+from elasticdl_tpu.parallel.elastic import (
+    build_state_specs,
+    collect_sharded_paths,
+    host_copy,
+    make_elastic_train_step,
+    place_from_host_specs,
+)
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.training.step import TrainState, make_train_step
+from model_zoo.deepfm_edl_embedding import deepfm_edl_embedding as zoo
+
+VOCAB = 64
+
+
+def _batches(n_steps, batch=16, length=10, seed=7):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_steps):
+        ids = rng.integers(0, VOCAB, size=(batch, length)).astype(np.int64)
+        labels = rng.integers(0, 2, size=(batch, 1)).astype(np.int64)
+        out.append(({"feature": ids}, labels))
+    return out
+
+
+def _init_state(model, batch, opt):
+    variables = init_variables(model, jax.random.PRNGKey(0), batch)
+    params, state = split_variables(variables)
+    return TrainState.create(params, state, opt)
+
+
+def _sharded_setup(mesh, opt, example):
+    model = zoo.DeepFMEdl(
+        embedding_dim=8,
+        fc_unit=8,
+        vocab_size=VOCAB,
+        collective=True,
+        table_axis="data",
+    )
+    ts_host = _init_state(model, example, opt)
+    sharded = collect_sharded_paths(zoo.param_shardings(mesh))
+    specs = build_state_specs(ts_host, sharded)
+    ts = place_from_host_specs(mesh, ts_host, specs)
+    return model, ts, specs
+
+
+def test_sharded_elastic_step_matches_dense_training():
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    opt = optax.sgd(0.05)
+    batches = _batches(6)
+    model, ts, specs = _sharded_setup(mesh, opt, batches[0][0])
+
+    step = make_elastic_train_step(
+        model, zoo.loss, opt, mesh, state_specs=specs
+    )
+
+    def put_batch(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, P(*(("data",) + (None,) * (x.ndim - 1))))
+            ),
+            tree,
+        )
+
+    ones = jax.device_put(
+        np.ones(8, np.float32), NamedSharding(mesh, P("data"))
+    )
+    key = jax.random.PRNGKey(5)
+    losses = []
+    with mesh:
+        for features, labels in batches:
+            ts, loss, n = step(
+                ts, put_batch(features), put_batch(labels), ones, key
+            )
+            assert int(n) == 8
+            losses.append(float(loss))
+
+    # dense twin: same init, plain full-batch steps
+    dense_model = zoo.DeepFMEdl(
+        embedding_dim=8, fc_unit=8, vocab_size=VOCAB, force_hbm=True
+    )
+    ts_d = _init_state(dense_model, batches[0][0], opt)
+    dense_step = make_train_step(dense_model, zoo.loss, opt)
+    dense_losses = []
+    for features, labels in batches:
+        ts_d, loss_d = dense_step(ts_d, features, labels, key)
+        dense_losses.append(float(loss_d))
+
+    np.testing.assert_allclose(losses, dense_losses, rtol=2e-4, atol=1e-5)
+    # the trained table shards reassemble to the dense table
+    got = np.asarray(
+        jax.device_get(ts.params["embedding"]["table"])
+    )
+    want = np.asarray(ts_d.params["embedding"]["table"])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_sharded_elastic_drain_is_exact_noop():
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    opt = optax.sgd(0.05)
+    batches = _batches(2, seed=9)
+    model, ts, specs = _sharded_setup(mesh, opt, batches[0][0])
+    step = make_elastic_train_step(
+        model, zoo.loss, opt, mesh, state_specs=specs
+    )
+
+    def put_batch(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, P(*(("data",) + (None,) * (x.ndim - 1))))
+            ),
+            tree,
+        )
+
+    zeros = jax.device_put(
+        np.zeros(8, np.float32), NamedSharding(mesh, P("data"))
+    )
+    key = jax.random.PRNGKey(3)
+    with mesh:
+        ts2, _, n = step(
+            ts, put_batch(batches[0][0]), put_batch(batches[0][1]), zeros, key
+        )
+    assert int(n) == 0
+    assert int(host_copy(ts2.version)) == int(host_copy(ts.version))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(ts2.params)),
+        jax.tree_util.tree_leaves(jax.device_get(ts.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_elastic_partial_weights_downweight_dead_devices():
+    """Weight-0 devices' examples must not move the table: train with
+    half the devices at weight 0 == dense training on only the live
+    devices' examples (each live example at weight 1/denom)."""
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    opt = optax.sgd(0.05)
+    batches = _batches(3, seed=11)
+    model, ts, specs = _sharded_setup(mesh, opt, batches[0][0])
+    step = make_elastic_train_step(
+        model, zoo.loss, opt, mesh, state_specs=specs
+    )
+
+    def put_batch(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, P(*(("data",) + (None,) * (x.ndim - 1))))
+            ),
+            tree,
+        )
+
+    w = np.array([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
+    weights = jax.device_put(w, NamedSharding(mesh, P("data")))
+    key = jax.random.PRNGKey(4)
+    with mesh:
+        for features, labels in batches:
+            ts, loss, n = step(
+                ts, put_batch(features), put_batch(labels), weights, key
+            )
+            assert int(n) == 4
+
+    # dense twin on the live half only (rows 0..7 of each 16-row batch)
+    dense_model = zoo.DeepFMEdl(
+        embedding_dim=8, fc_unit=8, vocab_size=VOCAB, force_hbm=True
+    )
+    ts_d = _init_state(dense_model, batches[0][0], opt)
+    dense_step = make_train_step(dense_model, zoo.loss, opt)
+    for features, labels in batches:
+        half = (
+            {"feature": features["feature"][:8]},
+            labels[:8],
+        )
+        ts_d, _ = dense_step(ts_d, half[0], half[1], key)
+
+    got = np.asarray(jax.device_get(ts.params["embedding"]["table"]))
+    want = np.asarray(ts_d.params["embedding"]["table"])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_sharded_elastic_job(tmp_path, monkeypatch):
+    """Real 2-OS-process elastic job, deepfm tables sharded over the
+    2-device world, checkpoints written by BOTH ranks, export assembles
+    the full model."""
+    # cold worker start (jax import) can straggle past the default
+    # 30 s form grace on a loaded CI host; the tiny job would then run
+    # to completion on a partial world before the straggler registers
+    monkeypatch.setenv("EDL_FORM_GRACE_SECS", "120")
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.model_utils import load_from_checkpoint_file
+    from elasticdl_tpu.common.sharded_checkpoint import (
+        load_sharded_to_host,
+    )
+    from elasticdl_tpu.master.local_instance_manager import (
+        LocalInstanceManager,
+    )
+    from elasticdl_tpu.master.master import Master
+    from tests.test_elastic_allreduce import _worker_env
+    from tests.test_utils import (
+        MODEL_ZOO_PATH,
+        DatasetName,
+        create_recordio_file,
+    )
+
+    create_recordio_file(
+        128, DatasetName.FRAPPE, 10, temp_dir=str(tmp_path)
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+    export_dir = str(tmp_path / "export")
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    args = parse_master_args(
+        [
+            "--job_name", "elastic-sharded-test",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", "embedding_dim=8,fc_unit=8,vocab_size=96",
+            "--minibatch_size", "16",
+            "--num_minibatches_per_task", "1",
+            "--num_epochs", "1",
+            "--training_data", str(tmp_path),
+            "--num_workers", "2",
+            "--num_ps_pods", "0",
+            "--port", "0",
+            "--distribution_strategy", "AllreduceStrategy",
+            "--output", export_dir,
+        ]
+    )
+    master = Master(args)
+    master.prepare()
+
+    def worker_command(worker_id):
+        return [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.worker.main",
+            "--worker_id", str(worker_id),
+            "--job_type", "training_only",
+            "--master_addr", "localhost:%d" % master.port,
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", "embedding_dim=8,fc_unit=8,vocab_size=96",
+            "--minibatch_size", "16",
+            "--distribution_strategy", "AllreduceStrategy",
+            "--comm_host", "localhost",
+            "--checkpoint_dir", ckpt_dir,
+            "--checkpoint_steps", "2",
+        ]
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        2,
+        worker_command,
+        env=_worker_env(),
+        membership=master.membership,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    manager.stop_relaunch_and_remove_all_pods()
+
+    # both ranks wrote their shard manifests
+    dirs = sorted(glob.glob(os.path.join(ckpt_dir, "ckpt_v*")))
+    assert dirs, "no sharded checkpoints written"
+    latest = dirs[-1]
+    manifests = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(latest, "manifest-*.json"))
+    )
+    assert manifests == ["manifest-0.json", "manifest-1.json"], manifests
+
+    # the checkpoint assembles to the full model: both table shards
+    version, tree = load_sharded_to_host(latest)
+    table = tree["params"]["embedding"]["table"]
+    assert table.shape == (96, 8)
+    assert version > 0
+
+    # the export task assembled a full host model.chkpt
+    exports = glob.glob(os.path.join(export_dir, "*", "model.chkpt"))
+    assert exports, "no exported model"
+    export_version, named = load_from_checkpoint_file(exports[0])
+    assert named["embedding/table"].shape == (96, 8)

@@ -320,10 +320,11 @@ def test_load_balancing_loss_calibration():
     from elasticdl_tpu.parallel.expert import load_balancing_loss
 
     e = 8
-    # perfectly balanced: each expert gets 1/e of tokens & probability
-    logits = np.tile(np.eye(e, dtype=np.float32) * 0.0, (4, 1))
+    # perfectly balanced: token i hard-routes to expert i%e, so BOTH the
+    # f (top-1 fraction) and P (mean prob) terms are exercised at 1/e
+    logits = np.tile(np.eye(e, dtype=np.float32) * 20.0, (4, 1))
     balanced = float(load_balancing_loss(jnp.asarray(logits)))
-    np.testing.assert_allclose(balanced, 1.0, rtol=1e-5)
+    np.testing.assert_allclose(balanced, 1.0, rtol=1e-4)
     # collapsed: every token hard-routes to expert 0
     collapsed = np.zeros((32, e), np.float32)
     collapsed[:, 0] = 20.0
