@@ -355,6 +355,12 @@ class ShardedCheckpointManager:
         return os.path.join(self._base, "ckpt_v%d" % version)
 
     def _evict(self):
+        """Ring retention (process 0 only). In multi-writer (sharded)
+        jobs a straggler rank's async writer could still be filling an
+        old version while it is evicted; the straggler's write then
+        fails (surfaced by its next wait()) and that version reads as
+        incomplete — restores skip it. Keep keep_max comfortably above
+        the async queue bound (2) so the window is theoretical."""
         kept = sorted(self.versions())
         while len(kept) > self._keep_max:
             victim = self._dir_for(kept.pop(0))

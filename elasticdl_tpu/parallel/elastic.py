@@ -96,6 +96,19 @@ def broadcast_from_device0(mesh, host_tree):
     return pick0(stacked)
 
 
+def _max_checkpoint_version(candidate_dirs):
+    """Largest ckpt_v{N} among candidate directory paths (0 if none)."""
+    import os
+    import re
+
+    best = 0
+    for d in candidate_dirs or ():
+        m = re.match(r"ckpt_v(\d+)$", os.path.basename(str(d)))
+        if m:
+            best = max(best, int(m.group(1)))
+    return best
+
+
 def collect_sharded_paths(param_specs):
     """Flatten a nested param_specs dict into {path tuple: PartitionSpec}."""
     paths = {}
@@ -177,7 +190,12 @@ def make_elastic_train_step(
     their local shard, their gradients stay local (no psum — the a2a
     backward already routed and weighted them), and the module must use
     collective lookups (nn/hbm_embedding.py ``collective=True``) since a
-    nested shard_map is impossible here.
+    nested shard_map is impossible here. Constraint: the optimizer must
+    be per-leaf elementwise (sgd/momentum/adam/adagrad/... all are) —
+    a transform that couples across leaves, e.g.
+    ``optax.clip_by_global_norm``, would fold each device's DIFFERENT
+    local table-shard gradient into a per-device scale and silently
+    desynchronize the replicated parameters.
 
     ``precision``: a training.precision.Policy (or preset name); master
     weights, gradients, and the weighted psum math stay in
@@ -484,8 +502,19 @@ class ElasticDPTrainer:
                     "restorable checkpoint: state RE-INITIALIZED "
                     "(enable --checkpoint_steps to bound this loss)"
                 )
+            init_ts = self._host_init_ts(example)
+            # version continuity: re-initialized state must start PAST
+            # any existing checkpoint version, or future saves would
+            # reuse an old ckpt_vN directory whose stale manifests (from
+            # a departed rank / larger world) would silently merge into
+            # restores
+            floor = _max_checkpoint_version(candidates)
+            if floor:
+                init_ts = init_ts.replace(
+                    version=np.int32(floor)
+                )
             self._ts = place_from_host_specs(
-                self._mesh, self._host_init_ts(example), self._state_specs
+                self._mesh, init_ts, self._state_specs
             )
 
     def _place_batch(self, tree):

@@ -440,20 +440,15 @@ class ElasticAllReduceWorker:
                 self._flush_unreported(
                     "" if ok else "collective failed before validation"
                 )
-                if (
-                    ok
-                    and self.trainer.is_sharded
-                    and self._ckpt is not None
-                    and self._ckpt.is_enabled()
-                ):
-                    # graceful membership change: every rank is alive, so
-                    # a checkpoint written NOW makes the re-form's
-                    # restore lossless (a SIGKILLed peer skips this path
-                    # and recovery falls back to the cadence checkpoint)
-                    version = self.trainer.version
-                    if version > self._last_ckpt_version:
-                        self._ckpt.save(self.trainer._ts, version)
-                        self._last_ckpt_version = version
+                if ok and self.trainer.is_sharded:
+                    # graceful membership change: a checkpoint written
+                    # NOW usually makes the re-form's restore lossless.
+                    # Best-effort, not guaranteed: a peer that already
+                    # entered the next collective when the epoch bumped
+                    # takes the exception path without saving, leaving
+                    # this version torn — restore then falls back to the
+                    # last complete (cadence) checkpoint.
+                    self._save_ckpt_if_newer()
                 from elasticdl_tpu.utils.profiling import maybe_stop_trace
 
                 maybe_stop_trace()  # the trace must not outlive its world
@@ -544,15 +539,8 @@ class ElasticAllReduceWorker:
                 # rank, in _finalize) needs every OTHER rank's manifest,
                 # and those ranks may legitimately still be here waiting
                 # for the job (incl. that very export task) to finish.
-                if (
-                    self.trainer.is_sharded
-                    and self._ckpt is not None
-                    and self._ckpt.is_enabled()
-                ):
-                    version = self.trainer.version
-                    if version > self._last_ckpt_version:
-                        self._ckpt.save(self.trainer._ts, version)
-                        self._last_ckpt_version = version
+                if self.trainer.is_sharded:
+                    self._save_ckpt_if_newer()
                 if self._drained:
                     return "done"
                 time.sleep(0.2)
@@ -685,17 +673,19 @@ class ElasticAllReduceWorker:
         directory = self._latest_ckpt_dir()
         if directory is None:
             return None, 0
+        last_err = None
         for attempt in range(10):
             try:
                 version, tree = load_sharded_to_host(directory)
                 return pytree_to_named_arrays(tree["params"]), version
-            except Exception:
+            except Exception as e:  # noqa: BLE001 - retried, then logged
+                last_err = e
                 time.sleep(1.0)
         logger.warning(
-            "newest checkpoint %s never completed; exporting the "
+            "newest checkpoint %s never completed (%s); exporting the "
             "previous one",
             directory,
-            exc_info=True,
+            last_err,
         )
         for version in sorted(self._ckpt.versions(), reverse=True)[1:]:
             try:
@@ -704,6 +694,17 @@ class ElasticAllReduceWorker:
             except Exception:
                 continue
         return None, 0
+
+    def _save_ckpt_if_newer(self):
+        """Checkpoint the current state if its version advanced past the
+        last save (all three call sites: graceful epoch bump, global
+        quiescence, finalize)."""
+        if self._ckpt is None or not self._ckpt.is_enabled():
+            return
+        version = self.trainer.version
+        if version > self._last_ckpt_version:
+            self._ckpt.save(self.trainer._ts, version)
+            self._last_ckpt_version = version
 
     def _drain_ckpt(self):
         """Land queued async checkpoint writes; surface IO errors as a
@@ -719,19 +720,11 @@ class ElasticAllReduceWorker:
             )
 
     def _finalize(self):
-        if (
-            self.trainer.is_sharded
-            and self._ckpt is not None
-            and self._ckpt.is_enabled()
-            and self.trainer._ts is not None
-        ):
+        if self.trainer.is_sharded and self.trainer._ts is not None:
             # every rank lands a final checkpoint so the export task (one
             # rank) and any resume see the finished state, not the last
             # cadence point
-            version = self.trainer.version
-            if version > self._last_ckpt_version:
-                self._ckpt.save(self.trainer._ts, version)
-                self._last_ckpt_version = version
+            self._save_ckpt_if_newer()
         self._drain_ckpt()
         if self._job_type == JobType.TRAINING_WITH_EVALUATION:
             try:

@@ -308,3 +308,130 @@ def test_two_process_sharded_elastic_job(tmp_path, monkeypatch):
     assert exports, "no exported model"
     export_version, named = load_from_checkpoint_file(exports[0])
     assert named["embedding/table"].shape == (96, 8)
+
+
+@pytest.mark.slow
+def test_sharded_elastic_job_survives_worker_kill(tmp_path, monkeypatch):
+    """SIGKILL one of 3 workers mid-job: survivors re-form a 2-device
+    world, the 3-way-sharded tables restore from the last complete
+    checkpoint ONTO THE NEW MESH (cross-mesh re-slice), and the job
+    completes with every task accounted."""
+    import time
+
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.master.local_instance_manager import (
+        LocalInstanceManager,
+    )
+    from elasticdl_tpu.master.master import Master
+    from tests.test_elastic_allreduce import _worker_env
+    from tests.test_utils import (
+        MODEL_ZOO_PATH,
+        DatasetName,
+        create_recordio_file,
+    )
+
+    monkeypatch.setenv("EDL_FORM_GRACE_SECS", "120")
+    create_recordio_file(
+        192, DatasetName.FRAPPE, 10, temp_dir=str(tmp_path)
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=8,fc_unit=8,vocab_size=96"
+    args = parse_master_args(
+        [
+            "--job_name", "elastic-sharded-kill",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", "16",
+            "--num_minibatches_per_task", "1",
+            "--num_epochs", "2",
+            "--training_data", str(tmp_path),
+            "--num_workers", "3",
+            "--num_ps_pods", "0",
+            "--port", "0",
+            "--distribution_strategy", "AllreduceStrategy",
+        ]
+    )
+    master = Master(args)
+    master.prepare()
+
+    completed = []
+    orig_report = master.task_d.report
+
+    def counting_report(task_id, success):
+        if success:
+            completed.append(task_id)
+        return orig_report(task_id, success)
+
+    master.task_d.report = counting_report
+
+    def worker_command(worker_id):
+        return [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.worker.main",
+            "--worker_id", str(worker_id),
+            "--job_type", "training_only",
+            "--master_addr", "localhost:%d" % master.port,
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", "16",
+            "--distribution_strategy", "AllreduceStrategy",
+            "--comm_host", "localhost",
+            "--checkpoint_dir", ckpt_dir,
+            "--checkpoint_steps", "2",
+        ]
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        3,
+        worker_command,
+        env=_worker_env(),
+        membership=master.membership,
+        max_relaunches=10,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+
+    deadline = time.time() + 240
+    while len(completed) < 2:
+        assert time.time() < deadline, "job made no progress"
+        assert runner.is_alive(), "master exited early"
+        time.sleep(0.5)
+    victims = manager.live_workers()
+    assert victims, "no live workers to kill"
+    manager.kill_worker(victims[-1])
+
+    runner.join(timeout=420)
+    assert not runner.is_alive(), "master did not finish after the kill"
+    assert master.task_d.finished()
+    # 192*2 records / 16 records-per-task = 24 training tasks
+    assert len(set(completed)) == 24
+    manager.stop_relaunch_and_remove_all_pods()
+
+    # the final checkpoint assembles the full tables regardless of the
+    # world size changes along the way
+    from elasticdl_tpu.common.sharded_checkpoint import (
+        load_sharded_to_host,
+    )
+
+    dirs = {
+        int(os.path.basename(d)[len("ckpt_v"):]): d
+        for d in glob.glob(os.path.join(ckpt_dir, "ckpt_v*"))
+    }
+    assert dirs, "no checkpoints written"
+    table = None
+    for v in sorted(dirs, reverse=True):
+        try:
+            _, tree = load_sharded_to_host(dirs[v])
+            table = tree["params"]["embedding"]["table"]
+            break
+        except Exception:
+            continue
+    assert table is not None and table.shape == (96, 8)
