@@ -139,6 +139,21 @@ def write_snapshot(directory, snap, version=0, process_index=None):
     pid = (
         jax.process_index() if process_index is None else process_index
     )
+    # clear THIS process's stale files from a previous write into the
+    # same directory (shard counts can change across membership epochs;
+    # leftover .p{pid}.s{i} files beyond the new count would merge into
+    # restores). Other ranks' files are never touched — they may be
+    # writing concurrently. Version-numbering continuity
+    # (parallel/elastic.py floors) keeps departed ranks' files out.
+    for stale in glob.glob(
+        os.path.join(directory, "*.p%d.s*.npy" % pid)
+    ) + glob.glob(
+        os.path.join(directory, "%s%d.json" % (_MANIFEST_PREFIX, pid))
+    ):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
     manifest = {"version": int(version), "leaves": {}}
     for path, shape, dtype, shards, full in snap:
         safe = path.replace("/", ".")

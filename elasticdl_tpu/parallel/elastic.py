@@ -479,6 +479,7 @@ class ElasticDPTrainer:
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self._mesh, s), self._state_specs
         )
+        floor = _max_checkpoint_version(candidates)
         for restore_dir in candidates:
             try:
                 version, self._ts = load_sharded(restore_dir, shardings)
@@ -487,6 +488,13 @@ class ElasticDPTrainer:
                     version,
                     restore_dir,
                 )
+                if floor > version:
+                    # a torn NEWER directory exists (killed rank):
+                    # future saves must number past it, or its stale
+                    # manifests would merge into later restores
+                    self._ts = self._ts.replace(
+                        version=jnp.asarray(floor, jnp.int32)
+                    )
                 break
             except Exception:
                 logger.warning(
@@ -508,7 +516,6 @@ class ElasticDPTrainer:
             # reuse an old ckpt_vN directory whose stale manifests (from
             # a departed rank / larger world) would silently merge into
             # restores
-            floor = _max_checkpoint_version(candidates)
             if floor:
                 init_ts = init_ts.replace(
                     version=np.int32(floor)

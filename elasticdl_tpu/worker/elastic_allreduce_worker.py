@@ -113,6 +113,7 @@ class ElasticAllReduceWorker:
             get_module_file_path(model_zoo, model_def)
         ).__dict__
         builder = None
+        self._host_model_factory = None
         if (
             "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
@@ -145,11 +146,28 @@ class ElasticAllReduceWorker:
                     _zoo["param_shardings"](mesh),
                 )
 
-            if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+            if "build_host_model" in zoo_module:
+                self._host_model_factory = (
+                    lambda _zoo=zoo_module, _extra=extra: _zoo[
+                        "build_host_model"
+                    ](**_extra)
+                )
+            evaluating = self._job_type in (
+                JobType.TRAINING_WITH_EVALUATION,
+                JobType.EVALUATION_ONLY,
+            )
+            if evaluating and self._host_model_factory is None:
                 raise NotImplementedError(
-                    "evaluation interleave is not yet supported for "
-                    "sharded-parameter elastic jobs (eval needs a full "
-                    "host model); run training_only + offline eval"
+                    "evaluation for sharded-parameter elastic jobs "
+                    "needs the zoo's build_host_model hook (same param "
+                    "structure, dense lookups) — see "
+                    "model_zoo/deepfm_edl_embedding"
+                )
+            if evaluating and not (checkpoint_dir and checkpoint_steps):
+                raise ValueError(
+                    "evaluation for sharded-parameter elastic jobs "
+                    "assembles eval params from sharded checkpoints; "
+                    "set --checkpoint_dir and --checkpoint_steps"
                 )
         self.trainer = ElasticDPTrainer(
             spec.model,
@@ -548,8 +566,8 @@ class ElasticAllReduceWorker:
     # -- evaluation (local devices only, host-fetched params) ---------------
 
     def _local_forward(self, features):
-        import jax
-
+        if self.trainer.is_sharded:
+            return self._sharded_forward(features)
         if self._forward_fn is None:
             from elasticdl_tpu.training.step import make_forward_fn
 
@@ -563,6 +581,61 @@ class ElasticAllReduceWorker:
                 raise RuntimeError("no local train state for evaluation")
             self._eval_params = (host_ts.params, host_ts.state)
             self._eval_params_version = version
+        params, state = self._eval_params
+        return self._forward_fn(params, state, features)
+
+    def _sharded_forward(self, features):
+        """Eval forward for sharded-parameter jobs: the host-twin model
+        over full tables assembled from the newest complete checkpoint.
+
+        Evaluation therefore scores the checkpoint version (lagged by at
+        most the cadence) — the same approximation the replicated plane
+        makes in the other direction (it scores current params whatever
+        version the eval task pinned)."""
+        from elasticdl_tpu.common.sharded_checkpoint import (
+            load_sharded_to_host,
+        )
+
+        candidates = self._ckpt_dirs_newest_first()
+        if not candidates:
+            raise RuntimeError(
+                "no sharded checkpoint yet; eval params unavailable"
+            )
+        # re-assemble only when a checkpoint newer than the last ATTEMPT
+        # appears — keyed on the attempt, not the loaded dir, so a torn
+        # newest (killed peer) doesn't trigger a full-model disk reload
+        # on every eval minibatch
+        if candidates[0] != self._eval_params_version:
+            self._eval_params_version = candidates[0]
+            tree = None
+            for directory in candidates:
+                try:
+                    _, tree = load_sharded_to_host(directory)
+                    break
+                except Exception:
+                    # newest may be mid-write by a peer; older complete
+                    # versions are fine for a lagged eval
+                    continue
+            if tree is not None:
+                if self._forward_fn is None:
+                    from elasticdl_tpu.training.step import (
+                        make_forward_fn,
+                    )
+
+                    self._forward_fn = make_forward_fn(
+                        self._host_model_factory()
+                    )
+                self._eval_params = (
+                    tree["params"],
+                    tree.get("state") or {},
+                )
+            elif self._eval_params is None:
+                self._eval_params_version = None  # retry next call
+                raise RuntimeError(
+                    "no complete sharded checkpoint for evaluation"
+                )
+            # else: every candidate torn right now; score the previous
+            # assembly rather than thrashing the disk
         params, state = self._eval_params
         return self._forward_fn(params, state, features)
 
@@ -601,13 +674,22 @@ class ElasticAllReduceWorker:
             )
             return
         out_chunks, label_chunks = {}, []
-        for features, labels in dataset:
-            outputs = self._local_forward(features)
-            if not isinstance(outputs, dict):
-                outputs = {MetricsDictKey.MODEL_OUTPUT: outputs}
-            for k, v in outputs.items():
-                out_chunks.setdefault(k, []).append(np.asarray(v))
-            label_chunks.append(np.asarray(labels))
+        try:
+            for features, labels in dataset:
+                outputs = self._local_forward(features)
+                if not isinstance(outputs, dict):
+                    outputs = {MetricsDictKey.MODEL_OUTPUT: outputs}
+                for k, v in outputs.items():
+                    out_chunks.setdefault(k, []).append(np.asarray(v))
+                label_chunks.append(np.asarray(labels))
+        except RuntimeError as e:
+            # e.g. a sharded job's first eval task arriving before any
+            # checkpoint exists — fail-report so the task requeues and a
+            # later round (with a checkpoint) redoes it, instead of
+            # crash-looping the worker
+            logger.warning("eval task %d deferred: %s", task_id, e)
+            self.report_task_result(task_id, err_msg=str(e))
+            return
         if out_chunks:
             self._stub.report_evaluation_metrics(
                 model_version,

@@ -114,6 +114,14 @@ def build_collective_model(table_axis="data", **params):
     return DeepFMEdl(collective=True, table_axis=table_axis, **params)
 
 
+def build_host_model(**params):
+    """Host twin of the collective model: same parameter structure,
+    dense ``jnp.take`` lookups — the forward the elastic worker runs for
+    evaluation/export against checkpoint-assembled full tables."""
+    params.pop("table_axis", None)
+    return DeepFMEdl(force_hbm=True, **params)
+
+
 def param_shardings(mesh, table_axis="data"):
     """PartitionSpecs for the HBM-resident tables; everything else
     (dense layers, optimizer moments of dense layers) replicates, and
