@@ -87,6 +87,10 @@ class SaveModelConfig:
 
 class TaskExecCounterKey:
     FAIL_COUNT = "fail_count"
+    # allreduce workers piggyback their on-device model version here so
+    # the coordinating master (which applies no gradients itself) can
+    # drive version-based triggers (evaluation cadence)
+    MODEL_VERSION = "model_version"
 
 
 class ODPSConfig:

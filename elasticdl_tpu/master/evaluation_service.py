@@ -189,9 +189,21 @@ class EvaluationService:
         self._round = _EvaluationJob(self._eval_metrics_fn(), -1, num_task)
 
     def add_evaluation_task_if_needed(self, master_locking):
-        """Step-based trigger: a round every ``eval_steps`` versions."""
+        """Step-based trigger: a round every ``eval_steps`` versions.
+
+        A coordinating (ALLREDUCE) master learns versions in jumps from
+        worker task reports, so the trigger there is gap-based — an
+        exact modulo could never hit."""
         version = self._master_servicer.get_model_version()
-        if self._eval_steps and version % self._eval_steps == 0:
+        if not self._eval_steps:
+            return
+        if getattr(self._master_servicer, "coordinates_only", False):
+            due = version - max(0, self._last_snapshot_version) >= (
+                self._eval_steps
+            )
+        else:
+            due = version % self._eval_steps == 0
+        if due:
             self.add_evaluation_task(
                 is_time_based_eval=False, master_locking=master_locking
             )
@@ -218,13 +230,22 @@ class EvaluationService:
             self.try_to_create_new_job()
 
     def _snapshot_model_locked(self):
-        """Pin the model into an eval checkpoint (master lock held)."""
+        """Pin the model into an eval checkpoint (master lock held).
+
+        A coordinating (ALLREDUCE) master holds no parameters: the round
+        pins only the version NUMBER, and workers score it with their
+        own device-resident (or checkpoint-assembled) state."""
         version = self._master_servicer.get_model_version()
         if version == self._last_snapshot_version:
             return False
-        snapshot = self._master_servicer.save_eval_checkpoint(locking=False)
-        if snapshot is None:
-            return False  # write failed: nothing to evaluate against
+        if getattr(self._master_servicer, "coordinates_only", False):
+            snapshot = version
+        else:
+            snapshot = self._master_servicer.save_eval_checkpoint(
+                locking=False
+            )
+            if snapshot is None:
+                return False  # write failed: nothing to evaluate against
         with self._lock:
             self._pending_versions.append(snapshot)
         self._last_snapshot_version = snapshot
@@ -278,9 +299,14 @@ class EvaluationService:
                 self._round = None
         self._publish_summary(round_)
         if not self._eval_only:
-            self._checkpoint_service.remove_eval_checkpoint(
-                round_.model_version
-            )
+            try:
+                self._checkpoint_service.remove_eval_checkpoint(
+                    round_.model_version
+                )
+            except OSError:
+                # a coordinating (ALLREDUCE) master pins version
+                # NUMBERS, not checkpoint files — nothing to remove
+                pass
             self.try_to_create_new_job()
 
     def _publish_summary(self, round_):

@@ -23,7 +23,11 @@ import threading
 import numpy as np
 import optax
 
-from elasticdl_tpu.common.constants import GetModelMethod, TaskType
+from elasticdl_tpu.common.constants import (
+    GetModelMethod,
+    TaskExecCounterKey,
+    TaskType,
+)
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.model_utils import load_from_checkpoint_file
 from elasticdl_tpu.common.tensor import Tensor
@@ -353,7 +357,31 @@ class MasterServicer:
         """Rows for ``ids`` from the master-central store (lazy init)."""
         return self._embedding_store.get_embedding_param(layer_name, ids)
 
+    @property
+    def coordinates_only(self):
+        """True for ALLREDUCE jobs: the master dispatches tasks but
+        applies no gradients, so its version advances only via the
+        workers' piggybacked reports."""
+        return self._opt is None
+
     def report_task_result(self, task_id, err_message="", exec_counters=None):
+        if (
+            self.coordinates_only
+            and exec_counters
+            and TaskExecCounterKey.MODEL_VERSION in exec_counters
+        ):
+            reported = int(exec_counters[TaskExecCounterKey.MODEL_VERSION])
+            with self._lock:
+                advanced = reported > self._version
+                self._version = max(self._version, reported)
+            if advanced and self._evaluation_service:
+                # a coordinating master never applies gradients, so task
+                # reports are its only version heartbeat — drive the
+                # step-based evaluation trigger from here (taking the
+                # model lock: this thread does not hold it)
+                self._evaluation_service.add_evaluation_task_if_needed(
+                    master_locking=True
+                )
         if err_message:
             logger.warning("Worker reported error: " + err_message)
             self._task_d.report(task_id, False)

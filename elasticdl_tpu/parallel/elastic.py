@@ -491,9 +491,14 @@ class ElasticDPTrainer:
                 if floor > version:
                     # a torn NEWER directory exists (killed rank):
                     # future saves must number past it, or its stale
-                    # manifests would merge into later restores
+                    # manifests would merge into later restores. The
+                    # scalar is committed onto the mesh like every other
+                    # leaf (a host-local scalar inside an otherwise
+                    # mesh-global TrainState breaks multi-host jit).
                     self._ts = self._ts.replace(
-                        version=jnp.asarray(floor, jnp.int32)
+                        version=place_from_host_specs(
+                            self._mesh, np.int32(floor), P()
+                        )
                     )
                 break
             except Exception:

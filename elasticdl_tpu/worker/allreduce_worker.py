@@ -119,7 +119,11 @@ class AllReduceWorker:
         return self._stub.get_task(self._worker_id, task_type)
 
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
-        return self._stub.report_task_result(task_id, err_msg, exec_counters)
+        from elasticdl_tpu.worker.reporting import with_model_version
+
+        return self._stub.report_task_result(
+            task_id, err_msg, with_model_version(self.trainer, exec_counters)
+        )
 
     # -- steps --------------------------------------------------------------
 

@@ -39,6 +39,7 @@ from elasticdl_tpu.common.constants import (
     MetricsDictKey,
     Mode,
     SaveModelConfig,
+    TaskExecCounterKey,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.model_utils import (
@@ -152,10 +153,16 @@ class ElasticAllReduceWorker:
                         "build_host_model"
                     ](**_extra)
                 )
-            evaluating = self._job_type in (
-                JobType.TRAINING_WITH_EVALUATION,
-                JobType.EVALUATION_ONLY,
-            )
+            if self._job_type == JobType.EVALUATION_ONLY:
+                # the elastic run loop only interleaves evaluation with
+                # training; a pure-eval sharded job would deadlock (no
+                # worker ever trains, so none takes eval tasks)
+                raise NotImplementedError(
+                    "evaluation_only is not supported on the elastic "
+                    "plane; evaluate offline from the exported model or "
+                    "a sharded checkpoint (load_sharded_to_host)"
+                )
+            evaluating = self._job_type == JobType.TRAINING_WITH_EVALUATION
             if evaluating and self._host_model_factory is None:
                 raise NotImplementedError(
                     "evaluation for sharded-parameter elastic jobs "
@@ -243,7 +250,11 @@ class ElasticAllReduceWorker:
         return self._stub.get_task(self._worker_id, task_type)
 
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
-        return self._stub.report_task_result(task_id, err_msg, exec_counters)
+        from elasticdl_tpu.worker.reporting import with_model_version
+
+        return self._stub.report_task_result(
+            task_id, err_msg, with_model_version(self.trainer, exec_counters)
+        )
 
     # -- data ---------------------------------------------------------------
 
@@ -652,14 +663,22 @@ class ElasticAllReduceWorker:
             task = self.get_task(TaskType.EVALUATION)
             if not task.shard_name:
                 break
-            self._process_eval_task(task)
+            if not self._process_eval_task(task):
+                # deferred (e.g. no sharded checkpoint yet): the task
+                # requeued; stop regrabbing it in a tight loop — the
+                # next training iteration retries, by which point a
+                # checkpoint may exist
+                break
             executed = True
         return executed
 
     def _process_eval_task(self, task):
+        """Returns True when the task completed (success or reported
+        failure another worker should retry); False when deferred — the
+        caller stops regrabbing until the next training iteration."""
         eval_info = self._task_data_service.get_validation_dataset(task)
         if not eval_info:
-            return
+            return False
         dataset, model_version, task_id = eval_info
         dataset = self._dataset_fn(
             dataset,
@@ -672,7 +691,7 @@ class ElasticAllReduceWorker:
             self.report_task_result(
                 task_id, err_msg="no local train state for evaluation"
             )
-            return
+            return False
         out_chunks, label_chunks = {}, []
         try:
             for features, labels in dataset:
@@ -689,7 +708,7 @@ class ElasticAllReduceWorker:
             # crash-looping the worker
             logger.warning("eval task %d deferred: %s", task_id, e)
             self.report_task_result(task_id, err_msg=str(e))
-            return
+            return False
         if out_chunks:
             self._stub.report_evaluation_metrics(
                 model_version,
@@ -697,6 +716,7 @@ class ElasticAllReduceWorker:
                 np.concatenate(label_chunks),
             )
         self.report_task_result(task_id)
+        return True
 
     # -- export -------------------------------------------------------------
 

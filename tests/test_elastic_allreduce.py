@@ -488,3 +488,72 @@ def test_elastic_allreduce_resumes_from_sharded_checkpoint(tmp_path):
     assert exports
     versions = [load_from_checkpoint_file(p)[0] for p in exports]
     assert max(versions) > max(v1), (versions, v1)
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_evaluation_interleave(tmp_path, monkeypatch):
+    """TRAINING_WITH_EVALUATION on the elastic plane: the coordinating
+    master learns versions from worker task reports (it applies no
+    gradients), triggers gap-based eval rounds pinning version NUMBERS,
+    and workers score them with their own device state."""
+    monkeypatch.setenv("EDL_FORM_GRACE_SECS", "120")
+    train_dir = tmp_path / "train"
+    val_dir = tmp_path / "val"
+    train_dir.mkdir()
+    val_dir.mkdir()
+    create_recordio_file(
+        192, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(train_dir)
+    )
+    create_recordio_file(
+        32, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(val_dir)
+    )
+    master = _master_for(
+        str(train_dir),
+        num_workers=2,
+        num_epochs=2,
+        extra=(
+            "--validation_data",
+            str(val_dir),
+            "--evaluation_steps",
+            "4",
+            "--evaluation_start_delay_secs",
+            "0",
+        ),
+    )
+    assert master.evaluation_service is not None
+
+    published = []
+    orig_publish = master.evaluation_service._publish_summary
+
+    def capture_publish(round_):
+        published.append(
+            (round_.model_version, round_.get_evaluation_summary())
+        )
+        return orig_publish(round_)
+
+    master.evaluation_service._publish_summary = capture_publish
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        2,
+        _worker_command_for(
+            master, extra=("--job_type", "training_with_evaluation")
+        ),
+        env=_worker_env(),
+        membership=master.membership,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    manager.stop_relaunch_and_remove_all_pods()
+
+    assert published, "no evaluation round ever completed"
+    for version, metrics in published:
+        assert version > 0
+        assert metrics, "empty evaluation summary"
