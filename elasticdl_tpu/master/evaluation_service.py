@@ -198,17 +198,26 @@ class EvaluationService:
         if not self._eval_steps:
             return
         if getattr(self._master_servicer, "coordinates_only", False):
+            # the gap is re-validated under the master lock in
+            # _snapshot_model_locked (min_gap) — this unlocked read is
+            # only a cheap pre-filter against taking the lock per report
             due = version - max(0, self._last_snapshot_version) >= (
                 self._eval_steps
             )
+            min_gap = self._eval_steps
         else:
             due = version % self._eval_steps == 0
+            min_gap = 1
         if due:
             self.add_evaluation_task(
-                is_time_based_eval=False, master_locking=master_locking
+                is_time_based_eval=False,
+                master_locking=master_locking,
+                min_gap=min_gap,
             )
 
-    def add_evaluation_task(self, is_time_based_eval, master_locking=True):
+    def add_evaluation_task(
+        self, is_time_based_eval, master_locking=True, min_gap=1
+    ):
         """Snapshot the current model and queue a round on it.
 
         The version guard, the eval-checkpoint write, and the guard
@@ -223,19 +232,26 @@ class EvaluationService:
             return
         if master_locking:
             with self._master_servicer.lock:
-                queued = self._snapshot_model_locked()
+                queued = self._snapshot_model_locked(min_gap)
         else:
-            queued = self._snapshot_model_locked()
+            queued = self._snapshot_model_locked(min_gap)
         if queued:
             self.try_to_create_new_job()
 
-    def _snapshot_model_locked(self):
+    def _snapshot_model_locked(self, min_gap=1):
         """Pin the model into an eval checkpoint (master lock held).
 
         A coordinating (ALLREDUCE) master holds no parameters: the round
         pins only the version NUMBER, and workers score it with their
-        own device-resident (or checkpoint-assembled) state."""
+        own device-resident (or checkpoint-assembled) state. ``min_gap``
+        re-validates the step cadence under the lock — concurrent task
+        reports can both pass the unlocked pre-filter."""
         version = self._master_servicer.get_model_version()
+        if (
+            self._last_snapshot_version >= 0
+            and version - self._last_snapshot_version < min_gap
+        ):
+            return False
         if version == self._last_snapshot_version:
             return False
         if getattr(self._master_servicer, "coordinates_only", False):

@@ -130,6 +130,7 @@ class Master:
                 args, "lr_staleness_modulation", False
             ),
             use_async=getattr(args, "use_async", False),
+            coordinates_only=(strategy == DistributionStrategy.ALLREDUCE),
         )
         # membership epochs for the elastic allreduce plane (the PS plane
         # needs no inter-worker world)

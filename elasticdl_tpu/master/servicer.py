@@ -79,6 +79,7 @@ class MasterServicer:
         lr_staleness_modulation=False,
         use_async=False,
         embedding_gradient_applier=None,
+        coordinates_only=False,
     ):
         """``optimizer`` is an optax GradientTransformation (or None for
         pure task-dispatch mode, e.g. ALLREDUCE jobs where the master only
@@ -101,6 +102,7 @@ class MasterServicer:
         self._opt_state = None
         self._lr_modulation = None
         self._opt = self._init_optimizer(optimizer)
+        self._coordinates_only = coordinates_only
         # master-central elastic-embedding store (replaces the reference's
         # external Redis EmbeddingService, master/embedding_service.py):
         # tables + optimizer slots live in a host Parameters store, updated
@@ -361,8 +363,11 @@ class MasterServicer:
     def coordinates_only(self):
         """True for ALLREDUCE jobs: the master dispatches tasks but
         applies no gradients, so its version advances only via the
-        workers' piggybacked reports."""
-        return self._opt is None
+        workers' piggybacked reports and eval rounds pin version numbers
+        rather than checkpoint files. Set explicitly by the strategy — a
+        PS-pod master ALSO holds no optimizer, but its workers evaluate
+        pinned eval checkpoints that must keep being written."""
+        return self._coordinates_only
 
     def report_task_result(self, task_id, err_message="", exec_counters=None):
         if (

@@ -113,6 +113,17 @@ class ElasticAllReduceWorker:
         zoo_module = load_module(
             get_module_file_path(model_zoo, model_def)
         ).__dict__
+        if self._job_type == JobType.EVALUATION_ONLY:
+            # the elastic run loop only interleaves evaluation with
+            # training; a pure-eval job would deadlock (no worker ever
+            # trains, so trainer.has_state stays False and none takes
+            # eval tasks)
+            raise NotImplementedError(
+                "evaluation_only is not supported on the elastic plane; "
+                "evaluate offline from the exported model (or, for "
+                "sharded jobs, a sharded checkpoint via "
+                "load_sharded_to_host)"
+            )
         builder = None
         self._host_model_factory = None
         if (
@@ -152,15 +163,6 @@ class ElasticAllReduceWorker:
                     lambda _zoo=zoo_module, _extra=extra: _zoo[
                         "build_host_model"
                     ](**_extra)
-                )
-            if self._job_type == JobType.EVALUATION_ONLY:
-                # the elastic run loop only interleaves evaluation with
-                # training; a pure-eval sharded job would deadlock (no
-                # worker ever trains, so none takes eval tasks)
-                raise NotImplementedError(
-                    "evaluation_only is not supported on the elastic "
-                    "plane; evaluate offline from the exported model or "
-                    "a sharded checkpoint (load_sharded_to_host)"
                 )
             evaluating = self._job_type == JobType.TRAINING_WITH_EVALUATION
             if evaluating and self._host_model_factory is None:
@@ -650,7 +652,11 @@ class ElasticAllReduceWorker:
         params, state = self._eval_params
         return self._forward_fn(params, state, features)
 
-    def _evaluate_only(self):
+    def _evaluate_only(self, final=False):
+        """Drain pending eval tasks. ``final=True`` (the _finalize call,
+        where no later training iteration will retry) waits out transient
+        deferrals — e.g. a peer's final checkpoint still landing — so a
+        requeued eval task is never abandoned with the job unfinished."""
         from elasticdl_tpu.common.constants import TaskType
 
         if not self.trainer.has_state:
@@ -659,16 +665,20 @@ class ElasticAllReduceWorker:
             # fail-requeue-regrab in a tight livelock
             return False
         executed = False
+        retries = 30 if final else 0
         while True:
             task = self.get_task(TaskType.EVALUATION)
             if not task.shard_name:
                 break
             if not self._process_eval_task(task):
                 # deferred (e.g. no sharded checkpoint yet): the task
-                # requeued; stop regrabbing it in a tight loop — the
-                # next training iteration retries, by which point a
-                # checkpoint may exist
-                break
+                # requeued. Mid-training, stop regrabbing in a tight
+                # loop — the next training iteration retries.
+                if retries <= 0:
+                    break
+                retries -= 1
+                time.sleep(1.0)
+                continue
             executed = True
         return executed
 
@@ -830,7 +840,7 @@ class ElasticAllReduceWorker:
         self._drain_ckpt()
         if self._job_type == JobType.TRAINING_WITH_EVALUATION:
             try:
-                self._evaluate_only()
+                self._evaluate_only(final=True)
             except Exception:
                 logger.warning("final eval round failed", exc_info=True)
         self._process_save_model_task_if_needed()

@@ -508,3 +508,106 @@ def test_sharded_forward_assembles_eval_params_from_checkpoint(tmp_path):
     )
     # a second call reuses the cached assembly
     assert stub._eval_params_version == ckpt_dir
+
+
+@pytest.mark.slow
+def test_sharded_elastic_evaluation_interleave(tmp_path, monkeypatch):
+    """TRAINING_WITH_EVALUATION on the sharded elastic plane: eval
+    rounds trigger off worker-reported versions and score
+    checkpoint-assembled tables via the host-twin model."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.master.local_instance_manager import (
+        LocalInstanceManager,
+    )
+    from elasticdl_tpu.master.master import Master
+    from tests.test_elastic_allreduce import _worker_env
+    from tests.test_utils import (
+        MODEL_ZOO_PATH,
+        DatasetName,
+        create_recordio_file,
+    )
+
+    monkeypatch.setenv("EDL_FORM_GRACE_SECS", "120")
+    train_dir = tmp_path / "train"
+    val_dir = tmp_path / "val"
+    train_dir.mkdir()
+    val_dir.mkdir()
+    create_recordio_file(128, DatasetName.FRAPPE, 10, temp_dir=str(train_dir))
+    create_recordio_file(32, DatasetName.FRAPPE, 10, temp_dir=str(val_dir))
+    ckpt_dir = str(tmp_path / "ckpt")
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=8,fc_unit=8,vocab_size=96"
+    args = parse_master_args(
+        [
+            "--job_name", "elastic-sharded-eval",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", "16",
+            "--num_minibatches_per_task", "1",
+            "--num_epochs", "2",
+            "--training_data", str(train_dir),
+            "--validation_data", str(val_dir),
+            "--evaluation_steps", "3",
+            "--evaluation_start_delay_secs", "0",
+            "--num_workers", "2",
+            "--num_ps_pods", "0",
+            "--port", "0",
+            "--distribution_strategy", "AllreduceStrategy",
+        ]
+    )
+    master = Master(args)
+    master.prepare()
+    assert master.evaluation_service is not None
+
+    published = []
+    orig_publish = master.evaluation_service._publish_summary
+
+    def capture_publish(round_):
+        published.append(
+            (round_.model_version, round_.get_evaluation_summary())
+        )
+        return orig_publish(round_)
+
+    master.evaluation_service._publish_summary = capture_publish
+
+    def worker_command(worker_id):
+        return [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.worker.main",
+            "--worker_id", str(worker_id),
+            "--job_type", "training_with_evaluation",
+            "--master_addr", "localhost:%d" % master.port,
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", "16",
+            "--distribution_strategy", "AllreduceStrategy",
+            "--comm_host", "localhost",
+            "--checkpoint_dir", ckpt_dir,
+            "--checkpoint_steps", "2",
+        ]
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        2,
+        worker_command,
+        env=_worker_env(),
+        membership=master.membership,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    manager.stop_relaunch_and_remove_all_pods()
+
+    assert published, "no evaluation round completed"
+    for version, metrics in published:
+        assert version > 0
+        assert "auc" in str(metrics) or metrics, metrics
