@@ -252,8 +252,6 @@ class EvaluationService:
             and version - self._last_snapshot_version < min_gap
         ):
             return False
-        if version == self._last_snapshot_version:
-            return False
         if getattr(self._master_servicer, "coordinates_only", False):
             snapshot = version
         else:

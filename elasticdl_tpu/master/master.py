@@ -68,6 +68,19 @@ class Master:
         self.logger = logger
         self.args = args
         self.job_type = Master._get_job_type(args)
+        if (
+            getattr(args, "distribution_strategy", "")
+            == DistributionStrategy.ALLREDUCE
+            and self.job_type
+            in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY)
+        ):
+            # reject at submit time: the allreduce workers would
+            # otherwise crash-loop on the same rejection pod by pod
+            raise ValueError(
+                "%s is not supported under AllreduceStrategy; run it "
+                "under ParameterServerStrategy against the exported "
+                "model" % self.job_type
+            )
 
         records_per_task = (
             args.minibatch_size * args.num_minibatches_per_task

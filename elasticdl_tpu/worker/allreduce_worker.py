@@ -61,6 +61,17 @@ class AllReduceWorker:
         accum_steps=1,
         precision=None,
     ):
+        if job_type in (
+            JobType.EVALUATION_ONLY,
+            JobType.PREDICTION_ONLY,
+        ):
+            # the ALLREDUCE run loop only trains (with optional eval
+            # interleave); pure eval/predict jobs run under
+            # ParameterServerStrategy against the exported model
+            raise NotImplementedError(
+                "%s is not supported under AllreduceStrategy; use "
+                "ParameterServerStrategy" % job_type
+            )
         self._worker_id = worker_id
         self._job_type = job_type
         self._minibatch_size = minibatch_size

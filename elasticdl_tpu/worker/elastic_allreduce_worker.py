@@ -124,6 +124,13 @@ class ElasticAllReduceWorker:
                 "sharded jobs, a sharded checkpoint via "
                 "load_sharded_to_host)"
             )
+        if self._job_type == JobType.PREDICTION_ONLY:
+            # the run loop would feed prediction shards into train_step
+            raise NotImplementedError(
+                "prediction is not supported on the elastic plane; run "
+                "predict under ParameterServerStrategy (the reference's "
+                "predict plane) against the exported model"
+            )
         builder = None
         self._host_model_factory = None
         if (

@@ -100,3 +100,18 @@ def test_allreduce_worker_elastic_resize_mid_job():
     assert task_d.finished()
     assert worker.trainer.num_devices == 4
     assert worker.trainer.version == 8
+
+
+def test_allreduce_rejects_eval_and_predict_only_jobs():
+    import pytest
+
+    for job_type in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY):
+        with pytest.raises(NotImplementedError, match="ParameterServer"):
+            AllReduceWorker(
+                worker_id=0,
+                job_type=job_type,
+                minibatch_size=16,
+                model_zoo=MODEL_ZOO_PATH,
+                model_def="mnist_subclass.mnist_subclass.CustomModel",
+                stub=None,
+            )

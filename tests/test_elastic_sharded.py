@@ -610,4 +610,4 @@ def test_sharded_elastic_evaluation_interleave(tmp_path, monkeypatch):
     assert published, "no evaluation round completed"
     for version, metrics in published:
         assert version > 0
-        assert "auc" in str(metrics) or metrics, metrics
+        assert metrics and "auc" in str(metrics), metrics
