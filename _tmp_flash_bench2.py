@@ -1,0 +1,41 @@
+import time
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from elasticdl_tpu.ops.flash_attention import flash_attention
+from elasticdl_tpu.parallel.ring_attention import reference_attention
+
+ITERS = 20
+
+def bench(fn, b, l, h, d):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+    @jax.jit
+    def run(q, k, v):
+        def step(carry, i):
+            gq, gk, gv = grad(q + carry * 1e-30, k, v)
+            return carry + gq.astype(jnp.float32).sum() * 1e-30, ()
+        c, _ = lax.scan(step, jnp.float32(0.0), jnp.arange(ITERS))
+        return c
+    float(run(q, k, v))
+    t0 = time.perf_counter(); float(run(q, k, v))
+    return (time.perf_counter() - t0) / ITERS
+
+for b, l in ((2, 8192), (1, 16384), (1, 32768)):
+    h, d = 8, 64
+    row = f"b={b} L={l}:"
+    try:
+        t = bench(lambda q, k, v: flash_attention(q, k, v, True), b, l, h, d)
+        row += f" flash {t*1e3:8.1f}ms"
+    except Exception as e:
+        row += f" flash FAIL({type(e).__name__})"
+    try:
+        t = bench(lambda q, k, v: reference_attention(q, k, v, causal=True), b, l, h, d)
+        row += f"  ref {t*1e3:8.1f}ms"
+    except Exception as e:
+        row += f"  ref FAIL({type(e).__name__})"
+    print(row, flush=True)
