@@ -27,6 +27,19 @@ assigned by ascending worker id; relaunched workers get fresh, higher ids
 (reference next_worker_id semantics), so rank 0 is always the
 longest-lived survivor — the state-broadcast source after a re-form.
 
+Bump discipline: deaths bump the epoch *immediately* (push-based — the
+instance manager's watch callback fires the moment a process/pod dies,
+reference k8s_instance_manager.py:177-231, so recovery never waits out a
+poll window). Growth is *coalesced*: a joiner that registers while a
+formation is still in flight parks in a lobby and folds in at the next
+bump — bumping mid-formation would strand members that already took the
+ready spec inside a stale ``jax.distributed.initialize`` barrier, where
+they burn the whole init timeout and then get fenced as unresponsive.
+Formation completion is inferred from traffic that already exists: a
+member's first ``awaiting=False`` poll of an epoch means it established
+that world and is training (elastic_allreduce_worker polls that way once
+per step).
+
 Each epoch gets a fresh coordinator port so a stale coordination service
 from the previous world can never be mistaken for the new one.
 """
@@ -51,6 +64,7 @@ class MembershipService:
         base_port=0,
         form_grace_secs=30.0,
         confirm_timeout_secs=15.0,
+        stale_form_secs=None,
     ):
         """``base_port=0`` picks ephemeral ports (single-host jobs, where
         the master and rank 0 share the host); on a cluster pass a fixed
@@ -73,6 +87,15 @@ class MembershipService:
         self._base_port = base_port
         self._form_grace_secs = form_grace_secs
         self._confirm_timeout = confirm_timeout_secs
+        if stale_form_secs is None:
+            # long enough for every member to burn a full initialize
+            # timeout and re-poll (same knob the workers read)
+            from elasticdl_tpu.parallel.distributed import (
+                world_init_timeout,
+            )
+
+            stale_form_secs = confirm_timeout_secs + world_init_timeout()
+        self._stale_form_secs = stale_form_secs
         self._lock = threading.Lock()
         self._live = {}  # worker_id -> advertised host
         self._epoch = 0
@@ -85,6 +108,8 @@ class MembershipService:
         self._bump_time = None
         self._last_poll = {}  # worker_id -> wall time of last poll
         self._fencer = None
+        self._formed = set()  # members seen training in the current epoch
+        self._lobby = {}  # joiners parked while a formation is in flight
 
     def set_fencer(self, fencer):
         """``fencer(worker_id)`` forcibly terminates a dropped member.
@@ -101,10 +126,23 @@ class MembershipService:
     def epoch(self):
         return self._epoch
 
+    def _formation_in_flight(self):
+        """True while the current world is still coming up: either the
+        confirm phase hasn't finished, or ready specs went out but not
+        every member has been seen training yet."""
+        if not self._world:
+            return False
+        ids = set(w for w, _ in self._world)
+        return not self._world_ready or not ids <= self._formed
+
     def _bump_locked(self):
+        # any parked joiners ride along with whatever forced this bump
+        self._live.update(self._lobby)
+        self._lobby = {}
         self._epoch += 1
         self._world = sorted(self._live.items())
         self._confirmed = set()
+        self._formed = set()
         self._world_ready = not self._world  # empty world: nothing to form
         self._bump_time = time.time()
         if self._world:
@@ -126,24 +164,38 @@ class MembershipService:
 
     def register(self, worker_id, host="localhost"):
         with self._lock:
-            if self._live.get(worker_id) == host:
+            if (
+                self._live.get(worker_id) == host
+                or self._lobby.get(worker_id) == host
+            ):
                 return
-            self._live[worker_id] = host
             if self._first_register_time is None:
                 self._first_register_time = time.time()
-            if self._formed_initial:
-                # a joiner (relaunch or scale-up): grow the world
-                self._bump_locked()
-            elif len(self._live) >= self._expected:
-                self._formed_initial = True
+            if not self._formed_initial:
+                self._live[worker_id] = host
+                if len(self._live) >= self._expected:
+                    self._formed_initial = True
+                    self._bump_locked()
+            elif self._formation_in_flight():
+                # growth coalesces: bumping now would strand members that
+                # already took the ready spec in a stale initialize
+                # barrier. The joiner folds in at the next bump (formation
+                # completing, a death, or the staleness valve below).
+                self._lobby[worker_id] = host
+            else:
+                self._live[worker_id] = host
                 self._bump_locked()
 
     def remove(self, worker_id):
         with self._lock:
+            self._lobby.pop(worker_id, None)
             if worker_id not in self._live:
                 return
             del self._live[worker_id]
             if self._formed_initial:
+                # push-based: deaths re-form immediately — survivors in the
+                # broken collective fail fast and re-poll, so the job never
+                # waits out a detection window
                 self._bump_locked()
 
     def get_world(self, worker_id, host="localhost", awaiting=True):
@@ -195,9 +247,24 @@ class MembershipService:
                     return {"epoch": self._epoch, "ready": False}
             ids = [w for w, _ in self._world]
             if worker_id not in ids:
-                # removed as dead but evidently alive: next poll's register
-                # re-adds it (and has already done so above -> bumped)
+                # parked in the lobby, or removed as dead but evidently
+                # alive (register above re-adds / parks it)
+                if self._lobby and self._world_ready:
+                    # staleness valve: a formation that still hasn't
+                    # completed this long after ready specs went out is
+                    # going to break anyway — stop holding joiners
+                    if now - self._bump_time > self._stale_form_secs:
+                        self._bump_locked()
                 return {"epoch": self._epoch, "ready": False}
+            if self._world_ready and not awaiting:
+                # an awaiting=False poll is the training loop's per-step
+                # epoch check: this member established the current world
+                if worker_id not in self._formed:
+                    self._formed.add(worker_id)
+                    if not self._formation_in_flight() and self._lobby:
+                        # formation done and joiners are waiting: grow now
+                        self._bump_locked()
+                        return {"epoch": self._epoch, "ready": False}
             if not self._world_ready:
                 if awaiting:
                     self._confirmed.add(worker_id)

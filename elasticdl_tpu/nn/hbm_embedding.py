@@ -22,10 +22,50 @@ and async training; both share checkpoint naming via the params pytree.
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.parallel.ring_attention import shard_map
+
+
+METRICS_COLLECTION = "metrics"
+OVERFLOW_METRIC = "a2a_overflow"
+
+
+def a2a_overflow_total(state):
+    """Total overflowed-id count across every HbmEmbedding in ``state``.
+
+    Sums the ``metrics/*/a2a_overflow`` counters the layers accumulate
+    (see :class:`HbmEmbedding`); returns None when the model has no such
+    counters. Accepts device or host pytrees — callers fetch per leaf,
+    so the cost is a scalar transfer per embedding layer.
+    """
+    if not isinstance(state, dict) or METRICS_COLLECTION not in state:
+        return None
+    total = 0
+    found = False
+
+    def walk(node):
+        nonlocal total, found
+        if hasattr(node, "items"):
+            for k, v in node.items():
+                if k == OVERFLOW_METRIC:
+                    found = True
+                    # replicated counter: every shard holds the global
+                    # value, so read this process's replica rather than
+                    # summing copies (device_get of a non-addressable
+                    # multi-host array would fail)
+                    if hasattr(v, "addressable_shards"):
+                        arr = np.asarray(v.addressable_shards[0].data)
+                    else:
+                        arr = np.asarray(jax.device_get(v))
+                    total += int(arr.reshape(-1)[0])
+                else:
+                    walk(v)
+
+    walk(state[METRICS_COLLECTION])
+    return total if found else None
 
 
 def psum_lookup_collective(table_local, ids, axis):
@@ -44,6 +84,22 @@ def psum_lookup_collective(table_local, ids, axis):
     return jax.lax.psum(rows, axis)
 
 
+def _check_divisible(table, mesh, axis):
+    """Uneven vocab shards would fail deep inside shard_map tracing with
+    an opaque message; fail here with an actionable one instead. On the
+    elastic plane the same check runs at establish() against the NEW
+    world size (parallel/elastic.py), where it matters most: a re-form
+    to a non-divisor size must error clearly, not crash-loop."""
+    n = mesh.shape[axis]
+    if table.shape[0] % n:
+        raise ValueError(
+            "embedding vocab_size %d is not divisible by mesh axis "
+            "%r size %d; pad the table rows to the next multiple "
+            "(e.g. vocab_size=%d) so every device holds an equal shard"
+            % (table.shape[0], axis, n, -(-table.shape[0] // n) * n)
+        )
+
+
 def sharded_lookup(table, ids, mesh, axis):
     """Gather rows of a vocab-sharded table; differentiable.
 
@@ -58,6 +114,8 @@ def sharded_lookup(table, ids, mesh, axis):
     batch, which is the unavoidable cost of vocab-sharding over the same
     axis as the batch; shard tables on ``model`` to avoid it.
     """
+
+    _check_divisible(table, mesh, axis)
 
     def _lookup(table_local, ids):
         return psum_lookup_collective(table_local, ids, axis)
@@ -75,13 +133,22 @@ def sharded_lookup(table, ids, mesh, axis):
     )(table, ids)
 
 
-def a2a_lookup_collective(table_local, ids_flat, axis, capacity=None):
+def a2a_lookup_collective(
+    table_local, ids_flat, axis, capacity=None, return_overflow=False
+):
     """all_to_all routing body for one device; ``axis`` must already be
     bound (call inside shard_map / an outer collective step).
 
     ``table_local``: this device's (V/n, D) shard; ``ids_flat``: this
-    device's flat id slice. Returns (ids, D). See
-    :func:`all_to_all_lookup` for the routing/capacity semantics."""
+    device's flat id slice. Returns (ids, D) — or, with
+    ``return_overflow=True``, ``(rows, n_overflowed)`` where
+    ``n_overflowed`` is this device's LOCAL count of ids that didn't fit
+    their per-peer capacity bucket and therefore read zero rows. The
+    caller owns aggregation, because only it knows how ids were spread:
+    psum over ``axis`` when each device routed a distinct slice (the
+    elastic plane), no-op when the ids were replicated (each device
+    already counted the whole batch). See :func:`all_to_all_lookup` for
+    the routing/capacity semantics."""
     n = jax.lax.psum(1, axis)
     me = jax.lax.axis_index(axis)
     rows_per = table_local.shape[0]
@@ -122,10 +189,15 @@ def a2a_lookup_collective(table_local, ids_flat, axis, capacity=None):
     out_sorted = back[sorted_owner, pos]
     out_sorted = jnp.where(ok[..., None], out_sorted, 0)
     inv = jnp.argsort(order, stable=True)
-    return out_sorted[inv]
+    out = out_sorted[inv]
+    if not return_overflow:
+        return out
+    return out, jnp.sum(~ok).astype(jnp.int32)
 
 
-def all_to_all_lookup(table, ids, mesh, axis, capacity=None):
+def all_to_all_lookup(
+    table, ids, mesh, axis, capacity=None, return_overflow=False
+):
     """Row exchange by explicit ``all_to_all`` routing (the BASELINE.json
     north-star formulation); differentiable.
 
@@ -146,7 +218,11 @@ def all_to_all_lookup(table, ids, mesh, axis, capacity=None):
     right choice for tests and modest batches. Production lookups on
     hashed/unique ids set ``capacity ~= 2 x ids/n_shards``; overflowing
     ids fall back to zero rows (same contract as a dropped row in the
-    reference's best-effort Redis plane) — size capacity generously.
+    reference's best-effort Redis plane) — size capacity generously. A
+    mis-sized capacity is NOT silent: pass ``return_overflow=True`` to
+    get ``(rows, n_overflowed)`` back (a replicated global count), which
+    :class:`HbmEmbedding` accumulates into its ``metrics/a2a_overflow``
+    state counter so workers can alarm on it.
 
     Backward: the transpose of ``all_to_all`` is ``all_to_all`` and the
     transpose of the owner-side take is a scatter-add into that shard
@@ -154,23 +230,42 @@ def all_to_all_lookup(table, ids, mesh, axis, capacity=None):
     the dense (V, D) gradient never exists — each device only ever holds
     its own (V/n, D) gradient shard.
     """
+    _check_divisible(table, mesh, axis)
     orig_shape = ids.shape
     flat = jnp.reshape(jnp.asarray(ids).astype(jnp.int32), (-1,))
 
-    def _lookup(table_local, ids_flat):
-        return a2a_lookup_collective(
-            table_local, ids_flat, axis, capacity=capacity
-        )
-
     axes = set(mesh.axis_names)
     batch_axis = "data" if ("data" in axes and axis != "data") else None
+
+    def _lookup(table_local, ids_flat):
+        out = a2a_lookup_collective(
+            table_local,
+            ids_flat,
+            axis,
+            capacity=capacity,
+            return_overflow=return_overflow,
+        )
+        if not return_overflow:
+            return out
+        rows, n_over = out
+        # the local count is replicated along the table axis (every
+        # member of that axis routed the same id slice); total across
+        # the dp replicas, whose slices are distinct
+        if batch_axis is not None:
+            n_over = jax.lax.psum(n_over, batch_axis)
+        return rows, n_over
+
+    out_spec = P(batch_axis, None)
     out = shard_map(
         _lookup,
         mesh=mesh,
         in_specs=(P(axis, None), P(batch_axis)),
-        out_specs=P(batch_axis, None),
+        out_specs=(out_spec, P()) if return_overflow else out_spec,
         check_rep=False,
     )(table, flat)
+    if return_overflow:
+        rows, n_over = out
+        return jnp.reshape(rows, orig_shape + (table.shape[1],)), n_over
     return jnp.reshape(out, orig_shape + (table.shape[1],))
 
 
@@ -192,6 +287,15 @@ class HbmEmbedding(nn.Module):
     collective bodies directly. a2a is the natural form here — each
     device routes exactly its local ids even when the table axis IS the
     batch axis. Init still traces densely (no axis bound at init).
+
+    Capacity overflow is metered, not silent: every a2a lookup adds its
+    global overflowed-id count to a ``metrics/a2a_overflow`` int32 state
+    counter (monotone across steps; replicated, so it survives the
+    elastic plane's state averaging unchanged). Read it with
+    :func:`a2a_overflow_total`; a nonzero value means ids trained on
+    zero rows and ``capacity`` must grow. The counter is only written
+    when the ``metrics`` collection is mutable (training steps), so
+    frozen-state eval forwards are unaffected.
     """
 
     vocab_size: int
@@ -225,6 +329,33 @@ class HbmEmbedding(nn.Module):
             table = self.param(
                 "table", init, (self.vocab_size, self.features)
             )
+        # declared whenever the caller threads state (init always; the
+        # framework step builders pass every collection through), so the
+        # state STRUCTURE is identical across init and apply. A bare
+        # apply({"params": ...}) with no metrics collection simply goes
+        # unmetered instead of erroring.
+        overflow = None
+        if (
+            self.is_initializing()
+            or self.has_variable(METRICS_COLLECTION, OVERFLOW_METRIC)
+            or self.is_mutable_collection(METRICS_COLLECTION)
+        ):
+            overflow = self.variable(
+                METRICS_COLLECTION,
+                OVERFLOW_METRIC,
+                lambda: jnp.zeros((), jnp.int32),
+            )
+
+        def meter(n_over):
+            # init's tracing forward is not a training step: the counter
+            # must start at zero
+            if (
+                overflow is not None
+                and not self.is_initializing()
+                and self.is_mutable_collection(METRICS_COLLECTION)
+            ):
+                overflow.value = overflow.value + n_over
+
         ids = jnp.asarray(ids).astype(jnp.int32)
         if self.collective and not self.is_initializing():
             if self.method == "psum":
@@ -237,9 +368,16 @@ class HbmEmbedding(nn.Module):
                     "elastic plane's sharded batch cannot provide"
                 )
             flat = jnp.reshape(ids, (-1,))
-            out = a2a_lookup_collective(
-                table, flat, self.axis, capacity=self.capacity
+            out, n_over = a2a_lookup_collective(
+                table,
+                flat,
+                self.axis,
+                capacity=self.capacity,
+                return_overflow=True,
             )
+            # each device routed a distinct batch slice here; psum makes
+            # the counter the replicated global total
+            meter(jax.lax.psum(n_over, self.axis))
             emb = jnp.reshape(out, ids.shape + (table.shape[1],))
         elif self.mesh is None:
             emb = jnp.take(table, ids, axis=0)
@@ -254,9 +392,15 @@ class HbmEmbedding(nn.Module):
                 )
                 method = "a2a" if has_batch_axis else "psum"
             if method == "a2a":
-                emb = all_to_all_lookup(
-                    table, ids, self.mesh, self.axis, capacity=self.capacity
+                emb, n_over = all_to_all_lookup(
+                    table,
+                    ids,
+                    self.mesh,
+                    self.axis,
+                    capacity=self.capacity,
+                    return_overflow=True,
                 )
+                meter(n_over)
             else:
                 emb = sharded_lookup(table, ids, self.mesh, self.axis)
         if self.mask_zero:

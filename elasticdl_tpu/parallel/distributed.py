@@ -51,6 +51,19 @@ class WorldBroken(RuntimeError):
     """A collective or coordination failure that requires re-forming."""
 
 
+# shared with the master's MembershipService staleness valve: the valve
+# must outlast a member burning one full initialize timeout
+DEFAULT_WORLD_INIT_TIMEOUT = 30
+
+
+def world_init_timeout():
+    return int(
+        os.environ.get(
+            "EDL_WORLD_INIT_TIMEOUT", str(DEFAULT_WORLD_INIT_TIMEOUT)
+        )
+    )
+
+
 _active_spec = None
 
 
@@ -115,7 +128,14 @@ def ensure_world(spec, init_timeout=None):
 
     _configure_platform()
     if init_timeout is None:
-        init_timeout = int(os.environ.get("EDL_WORLD_INIT_TIMEOUT", "120"))
+        # short by design: members only enter the barrier after the
+        # master's two-phase confirm (everyone alive and polling), so a
+        # healthy formation completes in well under a second. A long
+        # timeout only prolongs the stale-barrier case — a member that
+        # took a ready spec just before the epoch bumped again — which
+        # must fail fast (WorldBroken -> re-poll) *before* the master's
+        # confirm window fences the silent process.
+        init_timeout = world_init_timeout()
     logger.info(
         "joining world epoch=%d rank=%d/%d coordinator=%s",
         spec.epoch,

@@ -466,9 +466,11 @@ class ElasticDPTrainer:
         # treedef, and a full host materialization of every (V,D) table
         # on every process at every re-form is exactly the memory spike
         # vocab-sharding exists to avoid
+        abstract = self._abstract_ts(example)
         self._state_specs = build_state_specs(
-            self._abstract_ts(example), self._sharded_paths
+            abstract, self._sharded_paths
         )
+        self._check_shard_divisibility(abstract)
         candidates = (
             self.restore_provider() if self.restore_provider else None
         ) or []
@@ -527,6 +529,47 @@ class ElasticDPTrainer:
                 )
             self._ts = place_from_host_specs(
                 self._mesh, init_ts, self._state_specs
+            )
+
+    def _check_shard_divisibility(self, abstract_ts):
+        """Every sharded leaf must split evenly over the NEW world's mesh.
+
+        The elastic world size changes at runtime; a re-form to a
+        non-divisor size would otherwise fail at shard_map trace time
+        with an opaque error and crash-loop the worker through
+        relaunches. Fail once, loudly, with the fix in the message.
+        Validates against the spec tree the step will actually use, so
+        the check can never disagree with placement."""
+        problems = []
+
+        def check(key_path, leaf, spec):
+            from elasticdl_tpu.common.pytree import key_path_names
+
+            for dim, axis_name in enumerate(spec or ()):
+                if axis_name is None:
+                    continue
+                n = self._mesh.shape[axis_name]
+                if leaf.shape[dim] % n:
+                    problems.append(
+                        "%s: dim %d (=%d) %% %d devices != 0"
+                        % (
+                            "/".join(key_path_names(key_path)),
+                            dim,
+                            leaf.shape[dim],
+                            n,
+                        )
+                    )
+
+        jax.tree_util.tree_map_with_path(
+            check, abstract_ts.params, self._state_specs.params
+        )
+        if problems:
+            raise ValueError(
+                "sharded parameters do not divide the %d-device world: "
+                "%s. Pad the sharded dimension (e.g. vocab_size) to a "
+                "multiple of every world size the job can shrink/grow "
+                "to — a multiple of num_workers * local_devices is the "
+                "usual choice." % (self._mesh.devices.size, "; ".join(problems))
             )
 
     def _place_batch(self, tree):

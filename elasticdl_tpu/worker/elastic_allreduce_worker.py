@@ -228,6 +228,7 @@ class ElasticAllReduceWorker:
         self._forward_fn = None
         self._eval_params_version = None
         self._eval_params = None
+        self._overflow_alarmed = 0
 
     def _ckpt_dirs_newest_first(self):
         """Candidate checkpoint dirs, newest first; drains in-flight
@@ -541,6 +542,7 @@ class ElasticAllReduceWorker:
                 self._unreported.append(count)
             if sync:
                 self._flush_unreported()
+                self._alarm_on_embedding_overflow()
                 if (
                     self._ckpt is not None
                     and (
@@ -582,6 +584,30 @@ class ElasticAllReduceWorker:
                 if self._drained:
                     return "done"
                 time.sleep(0.2)
+
+    def _alarm_on_embedding_overflow(self):
+        """Surface a2a capacity overflow (ids silently trained on zero
+        rows) at sync points. The counter is a replicated scalar in the
+        model state, so the read costs one scalar fetch per sync."""
+        ts = self.trainer._ts
+        if ts is None:
+            return
+        from elasticdl_tpu.nn.hbm_embedding import a2a_overflow_total
+
+        try:
+            total = a2a_overflow_total(ts.state)
+        except Exception:
+            return  # mid-failure state; the step error path owns it
+        if total and total > self._overflow_alarmed:
+            logger.warning(
+                "embedding a2a capacity overflow: %d ids have read zero "
+                "rows since job start (+%d since last sync) — increase "
+                "HbmEmbedding capacity (or leave it None for the exact "
+                "worst case)",
+                total,
+                total - self._overflow_alarmed,
+            )
+            self._overflow_alarmed = total
 
     # -- evaluation (local devices only, host-fetched params) ---------------
 

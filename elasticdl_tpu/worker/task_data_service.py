@@ -1,11 +1,20 @@
-"""Bridges master task pulls into one continuous record stream.
+"""Turns the master's task queue into one continuous record stream.
 
-Parity: reference worker/task_data_service.py — tasks pulled from the
-master are concatenated into a single generator-backed dataset; pending
-tasks are tracked by record count and reported complete once enough records
-were consumed; a warm-up task primes the data reader's metadata; WAIT tasks
-end the current dataset so the worker loop re-polls later; SAVE_MODEL tasks
-are routed aside for the export path.
+Role parity with the reference's worker-side task data service
+(reference worker/task_data_service.py): the worker sees a single
+iterable of records, while underneath this service pulls shard tasks
+from the master on demand, remembers which tasks the consumed records
+belong to, and acknowledges each task back to the master once the
+worker has burned through its record range.  Control tasks are handled
+inline: a WAIT ends the current stream so the worker re-polls later,
+and a SAVE_MODEL is parked for the export path instead of being fed to
+training.
+
+The implementation is this repo's own: completion accounting lives in a
+small in-flight ledger (`_drain_acknowledged`) keyed by a running
+record cursor, rather than the reference's inline while-loop, and the
+stream itself is a plain generator handed to the repo's tf-free
+`Dataset` shim (data/dataset.py).
 """
 
 import threading
@@ -17,94 +26,108 @@ from elasticdl_tpu.data.data_reader import create_data_reader
 from elasticdl_tpu.data.dataset import Dataset, create_dataset_from_tasks
 
 
+def _task_span(task):
+    """Number of records a shard task covers."""
+    return task.end - task.start
+
+
 class TaskDataService:
+    """One worker's bridge between master tasks and its input stream.
+
+    The worker object passed in must expose ``get_task()`` and
+    ``report_task_result(task_id, err_msg, exec_counters=)`` — the same
+    two calls every worker runtime in this repo already makes over the
+    master channel.
+    """
+
     def __init__(
         self, worker, training_with_evaluation, data_reader_params=None
     ):
         self._worker = worker
         self._training_with_evaluation = training_with_evaluation
-        self._lock = threading.Lock()
-        self._pending_dataset = True
-        self._pending_save_model_task = None
-        self._reset()
-        data_reader_params = data_reader_params or {}
+        self._ledger_lock = threading.Lock()
+        self._stream_open = True  # may get_dataset() hand out a new stream?
+        self._parked_export_task = None
+        self._clear_ledger()
+        reader_kwargs = dict(data_reader_params or {})
         self.data_reader = create_data_reader(
-            data_origin=data_reader_params.pop("data_origin", None),
-            **data_reader_params,
+            data_origin=reader_kwargs.pop("data_origin", None),
+            **reader_kwargs,
         )
-        self._warm_up_task = None
-        self._has_warmed_up = False
+        # First task is peeked once to prime reader metadata, then replayed
+        # into the stream so no records are lost.
+        self._primed_task = None
+        self._metadata_primed = False
 
-    def _reset(self):
-        self._reported_record_count = 0
-        self._failed_record_count = 0
-        self._pending_tasks = deque()
-        self._current_task = None
+    # ------------------------------------------------------------------
+    # in-flight ledger
+    # ------------------------------------------------------------------
+
+    def _clear_ledger(self):
+        self._inflight = deque()  # tasks whose records are being consumed
+        self._record_cursor = 0  # records consumed against head of ledger
+        self._bad_records = 0  # failed records charged to the head task
 
     def get_current_task(self):
-        return self._current_task
+        return self._inflight[0] if self._inflight else None
 
     def remaining_records_in_head_task(self):
-        """Records still unreported in the head pending task (0 if none).
+        """Unconsumed record count of the ledger's head task (0 if empty).
 
-        report_record_done counts *relative* to the head task's size, so a
-        failed train step charges exactly this to drain + fail-report the
-        task it was working on, without over-draining later pending tasks.
+        A failed train step calls report_record_done with exactly this
+        amount to finish + fail-report the task it was on, without
+        spilling the charge into tasks queued behind it.
         """
-        with self._lock:
-            if not self._pending_tasks:
+        with self._ledger_lock:
+            if not self._inflight:
                 return 0
-            head = self._pending_tasks[0]
-            return max(
-                0, (head.end - head.start) - self._reported_record_count
-            )
+            return max(0, _task_span(self._inflight[0]) - self._record_cursor)
 
-    def _do_report_task(self, task, err_msg=""):
-        if self._failed_record_count != 0:
-            exec_counters = {
-                TaskExecCounterKey.FAIL_COUNT: self._failed_record_count
-            }
-        else:
-            exec_counters = None
-        self._worker.report_task_result(
-            task.task_id, err_msg, exec_counters=exec_counters
+    def _acknowledge(self, task, err_msg):
+        """Report one finished task (and its failure tally) to the master."""
+        counters = (
+            {TaskExecCounterKey.FAIL_COUNT: self._bad_records}
+            if self._bad_records
+            else None
         )
-
-    def _log_fail_records(self, task, err_msg):
-        logger.warning(
-            'records (%d/%d) failure, possible in task_id: %d reason "%s"'
-            % (
-                self._failed_record_count,
-                task.end - task.start,
+        if err_msg:
+            logger.warning(
+                "task %d finished with %d/%d bad records; last error: %s",
                 task.task_id,
+                self._bad_records,
+                _task_span(task),
                 err_msg,
             )
+        self._worker.report_task_result(
+            task.task_id, err_msg, exec_counters=counters
         )
+        self._bad_records = 0
+
+    def _drain_acknowledged(self, err_msg):
+        """Pop + report every ledger task the cursor has moved past.
+
+        One batch can straddle several small tasks, so a single cursor
+        advance may complete more than one; any failure tally rides out
+        with the first task drained.
+        """
+        while self._inflight and self._record_cursor >= _task_span(
+            self._inflight[0]
+        ):
+            done = self._inflight.popleft()
+            self._record_cursor -= _task_span(done)
+            self._acknowledge(done, err_msg)
 
     def report_record_done(self, count, err_msg=""):
-        """Report records consumed; completes + reports drained tasks."""
-        self._reported_record_count += count
-        if err_msg:
-            self._failed_record_count += count
-
-        task = self._pending_tasks[0]
-        total_record_num = task.end - task.start
-        if self._reported_record_count >= total_record_num:
+        """Advance the cursor by ``count`` consumed records."""
+        with self._ledger_lock:
+            self._record_cursor += count
             if err_msg:
-                self._log_fail_records(task, err_msg)
-            # A single batch may span multiple tasks; keep popping while
-            # the consumed count covers the head task.
-            with self._lock:
-                while self._pending_tasks and self._reported_record_count >= (
-                    self._pending_tasks[0].end - self._pending_tasks[0].start
-                ):
-                    task = self._pending_tasks[0]
-                    self._reported_record_count -= task.end - task.start
-                    self._pending_tasks.popleft()
-                    self._do_report_task(task, err_msg)
-                    self._failed_record_count = 0
-                if self._pending_tasks:
-                    self._current_task = self._pending_tasks[0]
+                self._bad_records += count
+            self._drain_acknowledged(err_msg)
+
+    # ------------------------------------------------------------------
+    # dataset construction
+    # ------------------------------------------------------------------
 
     def get_validation_dataset(self, eval_task):
         """(dataset, model_version, task_id) for one eval task, or None."""
@@ -117,54 +140,65 @@ class TaskDataService:
         )
 
     def get_save_model_task_and_dataset(self):
-        if not self._pending_save_model_task:
+        task, self._parked_export_task = self._parked_export_task, None
+        if task is None:
             return None, None
-        task = self._pending_save_model_task
-        self._pending_save_model_task = None
-        return (task, create_dataset_from_tasks([task], self.data_reader))
+        return task, create_dataset_from_tasks([task], self.data_reader)
+
+    def _prime_reader_metadata(self):
+        """Peek the first task so the reader can expose its metadata.
+
+        Only a single record is pulled (enough for the reader to learn
+        schema/shape info); the task itself is replayed by the stream so
+        its records still reach training.
+        """
+        if self._metadata_primed:
+            return
+        task = self._worker.get_task()
+        if task.shard_name:
+            self._primed_task = task
+            for _ in self.data_reader.read_records(task):
+                break
+        self._metadata_primed = True
 
     def get_dataset(self):
-        """A Dataset over all tasks the master will hand us, or None."""
-        if not self._pending_dataset:
+        """A Dataset spanning every task the master will hand out, or None."""
+        if not self._stream_open:
             return None
-        if self._pending_tasks:
-            logger.error("Cannot get new dataset when there are pending tasks")
-            return None
-        self._reset()
-        # Warm-up task primes reader metadata without consuming records
-        # (reference task_data_service.py:143-148).
-        if self._warm_up_task is None and not self._has_warmed_up:
-            task = self._worker.get_task()
-            if task.shard_name:
-                self._warm_up_task = task
-                for _ in self.data_reader.read_records(task):
-                    break
-            self._has_warmed_up = True
-        ds = Dataset.from_generator(self._gen)
-        self._pending_dataset = False
-        return ds
+        with self._ledger_lock:
+            if self._inflight:
+                logger.error(
+                    "refusing a new dataset: %d in-flight tasks are still "
+                    "unacknowledged",
+                    len(self._inflight),
+                )
+                return None
+            self._clear_ledger()
+        self._prime_reader_metadata()
+        self._stream_open = False
+        return Dataset.from_generator(self._record_stream)
 
-    def _gen(self):
+    def _record_stream(self):
+        """Generator: pull tasks until the master says stop, yield records."""
         while True:
-            if self._warm_up_task is not None and self._has_warmed_up:
-                task = self._warm_up_task
-                self._warm_up_task = None
+            if self._primed_task is not None:
+                task, self._primed_task = self._primed_task, None
             else:
                 task = self._worker.get_task()
             if not task.shard_name:
                 if task.type == TaskType.WAIT:
-                    self._pending_dataset = True
-                    logger.info("Finish current dataset, maybe more data later")
+                    # More data may show up (e.g. a lazy next epoch); let
+                    # the worker loop ask again.
+                    self._stream_open = True
+                    logger.info("record stream paused (WAIT); will re-poll")
                 else:
-                    logger.info("No more task, stopping")
-                break
-            with self._lock:
-                if task.type == TaskType.SAVE_MODEL:
-                    self._pending_save_model_task = task
-                    continue
-                self._pending_tasks.append(task)
-                if len(self._pending_tasks) == 1:
-                    self._current_task = task
-            for data in self.data_reader.read_records(task):
-                if data is not None:
-                    yield data
+                    logger.info("task queue exhausted; record stream ends")
+                return
+            if task.type == TaskType.SAVE_MODEL:
+                self._parked_export_task = task
+                continue
+            with self._ledger_lock:
+                self._inflight.append(task)
+            for record in self.data_reader.read_records(task):
+                if record is not None:
+                    yield record

@@ -56,9 +56,16 @@ def test_membership_epochs():
     assert w1["epoch"] > epoch
     assert w1["num_processes"] == 1 and w1["process_id"] == 0
 
-    # a relaunch (higher id) grows the world; survivor keeps rank 0
+    # a relaunch (higher id) parks in the lobby while the survivor's
+    # world is still forming — growth must not strand members in a stale
+    # initialize barrier
     m.get_world(2)
+    assert m.get_world(2)["ready"] is False
+    w_mid = m.get_world(1, awaiting=False)  # survivor trains: formed
+    # formation complete -> the parked joiner triggers the growth bump
+    assert w_mid["epoch"] > w1["epoch"] or not w_mid.get("ready", True)
     m.get_world(1)  # survivor confirms the grown world
+    m.get_world(2)
     w2 = _poll_ready(m, 2)
     assert w2["epoch"] > w1["epoch"]
     assert w2["num_processes"] == 2 and w2["process_id"] == 1
@@ -66,6 +73,13 @@ def test_membership_epochs():
 
     # coordinator address rotates with the epoch
     assert _poll_ready(m, 1)["coordinator"] != w["coordinator"]
+
+    # once the grown world is training, a further joiner bumps immediately
+    m.get_world(1, awaiting=False)
+    m.get_world(2, awaiting=False)
+    e2 = m.epoch
+    m.get_world(3)
+    assert m.epoch > e2
 
 
 def test_membership_unconfirmed_member_dropped_after_timeout():
@@ -325,9 +339,12 @@ def _worker_env():
             "EDL_DIST_PLATFORM": "cpu",
             "EDL_LOCAL_DEVICES": "1",
             "EDL_COMM_HOST": "localhost",
-            "EDL_WORLD_INIT_TIMEOUT": "60",
+            # init timeout deliberately < the master's 15 s confirm
+            # window: a member stuck in a stale formation barrier raises
+            # WorldBroken and re-polls before the fencer kills it
+            "EDL_WORLD_INIT_TIMEOUT": "10",
             "EDL_HEARTBEAT_TIMEOUT": "10",
-            "EDL_SHUTDOWN_TIMEOUT": "10",
+            "EDL_SHUTDOWN_TIMEOUT": "5",
         }
     )
     # the parent test process pins these for its own virtual mesh; they

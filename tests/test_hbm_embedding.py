@@ -145,6 +145,74 @@ def test_a2a_lookup_capacity_overflow_drops_to_zero():
     assert (got[2:] == 0).all()
 
 
+def test_a2a_overflow_is_counted_not_silent():
+    """An undersized capacity must produce a nonzero overflow signal:
+    both from the raw lookup (return_overflow) and accumulated into the
+    HbmEmbedding metrics counter across steps."""
+    from elasticdl_tpu.nn.hbm_embedding import (
+        a2a_overflow_total,
+        all_to_all_lookup,
+    )
+
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    table = np.arange(32, dtype=np.float32).reshape(16, 2)
+    ids = np.array([0, 1, 0, 1])  # all owned by shard 0; capacity 2
+    _, n_over = jax.jit(
+        lambda t, i: all_to_all_lookup(
+            t, i, mesh, "data", capacity=2, return_overflow=True
+        )
+    )(table, ids)
+    assert int(n_over) == 2
+
+    # layer-level: the metrics collection accumulates across train steps
+    model = HbmEmbedding(
+        vocab_size=16, features=2, mesh=mesh, axis="data",
+        method="a2a", capacity=2,
+    )
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    state = {k: v for k, v in variables.items() if k != "params"}
+    assert a2a_overflow_total(state) == 0
+
+    @jax.jit
+    def step(params, state):
+        _, new_state = model.apply(
+            {"params": params, **state}, ids, mutable=["metrics"]
+        )
+        return dict(new_state)
+
+    with mesh:
+        state = step(variables["params"], state)
+        state = step(variables["params"], state)
+    assert a2a_overflow_total(state) == 4  # 2 dropped ids x 2 steps
+
+    # a generous capacity keeps the counter at zero
+    ok_model = HbmEmbedding(
+        vocab_size=16, features=2, mesh=mesh, axis="data", method="a2a"
+    )
+    v2 = ok_model.init(jax.random.PRNGKey(0), ids)
+    with mesh:
+        _, s2 = jax.jit(
+            lambda p, s: ok_model.apply(
+                {"params": p, **s}, ids, mutable=["metrics"]
+            )
+        )(v2["params"], {k: v for k, v in v2.items() if k != "params"})
+    assert a2a_overflow_total(dict(s2)) == 0
+
+
+def test_lookup_rejects_non_divisible_vocab():
+    from elasticdl_tpu.nn.hbm_embedding import all_to_all_lookup
+
+    import pytest
+
+    mesh = create_mesh({"data": 8}, axis_names=("data",))
+    table = np.ones((15, 2), np.float32)  # 15 % 8 != 0
+    ids = np.array([0, 1])
+    with pytest.raises(ValueError, match="not divisible"):
+        all_to_all_lookup(table, ids, mesh, "data")
+    with pytest.raises(ValueError, match="not divisible"):
+        sharded_lookup(table, ids, mesh, "data")
+
+
 def test_a2a_lookup_with_dp_sharded_batch():
     """table on 'model', ids sharded over 'data': each dp replica routes
     only its own slice."""
