@@ -160,7 +160,7 @@ def _block_cases(src, my_idx, causal, diag_fn, full_fn, skip_fn):
 
 
 def ring_flash_attention(
-    q, k, v, axis_name, causal=False, block_q=128, block_k=128
+    q, k, v, axis_name, causal=False, block_q=None, block_k=None
 ):
     """Ring attention whose per-block compute is the fused Pallas kernel.
 
@@ -171,6 +171,13 @@ def ring_flash_attention(
     so neither pass materializes more than one K/V block beyond the
     residents, and no (L, L) score matrix exists anywhere.
     """
+    from elasticdl_tpu.ops.flash_attention import auto_blocks
+
+    # resolve here (not per inner call): the custom_vjp's nondiff args
+    # must be concrete and identical across the fwd/bwd ring loops
+    block_q, block_k = auto_blocks(
+        q.shape[1], k.shape[1], block_q, block_k
+    )
     return _ring_flash(q, k, v, axis_name, causal, block_q, block_k)
 
 
