@@ -110,7 +110,13 @@ def _max_checkpoint_version(candidate_dirs):
 
 
 def collect_sharded_paths(param_specs):
-    """Flatten a nested param_specs dict into {path tuple: PartitionSpec}."""
+    """Flatten a nested param_specs dict into {path tuple: PartitionSpec}.
+
+    A ``"**"`` key makes its spec apply to EVERY leaf under the
+    enclosing prefix (stored as ``prefix + ("**",)``): the stacked stage
+    subtree of a pipeline (parallel/pipeline.py PipelinedStack) has many
+    leaves of varying depth that all shard the same way, which per-leaf
+    spec paths cannot express."""
     paths = {}
     if not param_specs:
         return paths
@@ -126,6 +132,26 @@ def collect_sharded_paths(param_specs):
     return paths
 
 
+def spec_path_matches(spec_path, leaf_names):
+    """True when a collected spec path claims a leaf's tree path.
+
+    Exact paths match by suffix (so optimizer slot trees, which nest the
+    params structure under mu/nu/..., co-shard automatically). Subtree
+    paths (ending in ``"**"``) match when their prefix appears as a
+    contiguous run anywhere in the leaf path."""
+    names = tuple(leaf_names)
+    if spec_path and spec_path[-1] == "**":
+        prefix = tuple(spec_path[:-1])
+        if not prefix:
+            return True
+        span = len(prefix)
+        return any(
+            names[i : i + span] == prefix
+            for i in range(len(names) - span + 1)
+        )
+    return names[-len(spec_path):] == tuple(spec_path)
+
+
 def build_state_specs(ts, sharded_paths):
     """TrainState-shaped PartitionSpec pytree for the elastic step.
 
@@ -138,7 +164,7 @@ def build_state_specs(ts, sharded_paths):
     def spec_for(key_path, _leaf):
         names = key_path_names(key_path)
         for spec_path, spec in sharded_paths.items():
-            if tuple(names[-len(spec_path):]) == tuple(spec_path):
+            if spec_path_matches(spec_path, names):
                 return spec
         return P()
 
@@ -159,6 +185,52 @@ def place_from_host_specs(mesh, tree, spec_tree):
         )
 
     return jax.tree_util.tree_map(put, tree, spec_tree)
+
+
+def optimizer_couples_leaves(optimizer):
+    """Behavioral probe: does one leaf's update depend on ANOTHER leaf's
+    gradient?
+
+    On the sharded-state plane each rank holds different local table
+    shards, so a cross-leaf transform (``optax.clip_by_global_norm`` is
+    the common one) folds each rank's different shard gradients into a
+    per-rank scale and silently desynchronizes the replicated
+    parameters. Probing behavior instead of matching transform names
+    catches every such transform, including ones inside ``optax.chain``
+    or custom ``GradientTransformation``s. Probes a tiny 2-leaf tree:
+    changing only leaf b's gradient must not change leaf a's update.
+    """
+    import jax.numpy as jnp
+
+    probe = {
+        "a": jnp.ones((4,), jnp.float32),
+        "b": jnp.ones((4,), jnp.float32),
+    }
+    try:
+        state = optimizer.init(probe)
+        g_small = {
+            "a": jnp.full((4,), 0.5, jnp.float32),
+            "b": jnp.full((4,), 0.5, jnp.float32),
+        }
+        g_large = {
+            "a": jnp.full((4,), 0.5, jnp.float32),
+            "b": jnp.full((4,), 64.0, jnp.float32),
+        }
+        u1, _ = optimizer.update(g_small, state, probe)
+        u2, _ = optimizer.update(g_large, state, probe)
+    except Exception:
+        # exotic optimizer the probe can't drive: let training proceed —
+        # this check exists to catch the common silent footgun, not to
+        # gate every optimizer shape
+        logger.warning(
+            "optimizer cross-leaf probe failed; skipping the sharded-"
+            "plane coupling check",
+            exc_info=True,
+        )
+        return False
+    return not np.allclose(
+        np.asarray(u1["a"]), np.asarray(u2["a"]), rtol=1e-6, atol=1e-8
+    )
 
 
 def make_elastic_train_step(
@@ -350,6 +422,7 @@ class ElasticDPTrainer:
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
+        self._coupling_checked = False
         self._seed = seed
         self._precision = precision
         self._accum_steps = max(1, accum_steps)
@@ -424,6 +497,7 @@ class ElasticDPTrainer:
         if self._builder is not None:
             self._module, param_specs = self._builder(self._mesh)
             self._sharded_paths = collect_sharded_paths(param_specs)
+        self._check_optimizer_coupling()
         if self._sharded_paths:
             self._establish_sharded(example_batch)
         else:
@@ -451,6 +525,41 @@ class ElasticDPTrainer:
             spec.num_processes,
             self._mesh.devices.size,
             " (sharded params)" if self._sharded_paths else "",
+        )
+
+    def _check_optimizer_coupling(self):
+        """Refuse cross-leaf optimizers for sharded-parameter jobs.
+
+        Runs at the FIRST establish, after ``ensure_world`` — the probe
+        executes real (tiny) JAX computation, and any JAX computation
+        before ``jax.distributed.initialize`` would pin the backend and
+        make the world formation itself fail. Once per trainer: the
+        optimizer doesn't change across re-forms."""
+        if self._coupling_checked or not self._sharded_paths:
+            return
+        self._coupling_checked = True
+        if not optimizer_couples_leaves(self._optimizer):
+            return
+        import os
+
+        if os.environ.get("EDL_ALLOW_CROSS_LEAF_OPT"):
+            logger.warning(
+                "cross-leaf optimizer on the sharded plane allowed by "
+                "EDL_ALLOW_CROSS_LEAF_OPT=1; replicated parameters may "
+                "silently desynchronize"
+            )
+            return
+        # fail before the first step, not N steps into silent divergence
+        raise ValueError(
+            "the optimizer couples gradients across leaves (e.g. "
+            "optax.clip_by_global_norm) but this job shards parameters "
+            "across ranks: each rank would fold its own DIFFERENT local "
+            "table-shard gradients into the coupled quantity and the "
+            "replicated parameters would silently desynchronize. Use "
+            "per-leaf transforms instead (e.g. optax.clip / "
+            "optax.adaptive_grad_clip), or set "
+            "EDL_ALLOW_CROSS_LEAF_OPT=1 if the coupling is known to "
+            "exclude the sharded leaves."
         )
 
     def _establish_sharded(self, example_batch):

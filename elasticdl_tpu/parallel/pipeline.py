@@ -20,6 +20,7 @@ param_specs.
 
 import functools
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -132,6 +133,93 @@ def make_pipeline_fn(mesh, stage_fn, pipe_axis="pipe", batch_axis=None):
 def stage_param_sharding(mesh, pipe_axis="pipe"):
     """NamedSharding for stacked stage parameters."""
     return NamedSharding(mesh, P(pipe_axis))
+
+
+class PipelinedStack(nn.Module):
+    """Flax module running a stage template through the pipe ring.
+
+    The job-path integration of :func:`pipeline_apply`: drop this into a
+    model where a sequential stack of identical-shape layers would sit
+    (transformer blocks — embed/head stay outside the ring), declare its
+    ``stages`` parameter subtree as ``{"**": P("pipe")}`` in the zoo's
+    ``param_shardings``, and the ALLREDUCE trainers place each stage's
+    parameters only on that stage's devices.
+
+    - ``stage_template``: an UNBOUND module whose ``__call__(x)`` maps an
+      activation to the same shape (the classic pipeline constraint).
+    - ``n_stages``: ring length; must equal the mesh's ``pipe`` axis size.
+    - ``microbatches``: how many microbatches the incoming batch splits
+      into (0 -> ``n_stages``; more microbatches shrink the bubble,
+      S/(S+M-1) of ticks are ramp).
+    - ``mesh=None``: degenerate single-device form — runs the stages
+      sequentially (used for init shape-tracing and CPU smoke tests).
+
+    Parameters are created by initializing the template once per stage
+    and stacking each leaf on a leading (S,) dim — a single flax param
+    whose value is the stacked subtree, so checkpoints/optimizers see
+    ordinary (S, ...) leaves.
+    """
+
+    stage_template: object
+    n_stages: int
+    mesh: object = None
+    pipe_axis: str = "pipe"
+    microbatches: int = 0
+
+    @nn.compact
+    def __call__(self, x):
+        m = self.microbatches or self.n_stages
+
+        def init_fn(rng):
+            rngs = jax.random.split(rng, self.n_stages)
+            per = [
+                self.stage_template.init(r, x[:1])["params"]
+                for r in rngs
+            ]
+            return stack_stage_params(per)
+
+        stacked = self.param("stages", init_fn)
+
+        def stage_fn(params, act):
+            return self.stage_template.apply({"params": params}, act)
+
+        if (
+            self.is_initializing()
+            or self.mesh is None
+            or self.pipe_axis not in getattr(self.mesh, "axis_names", ())
+        ):
+            # sequential reference form: init tracing (single example,
+            # no microbatching possible) and pipe-less meshes
+            y = x
+            for s in range(self.n_stages):
+                p = jax.tree_util.tree_map(
+                    lambda a, s=s: a[s], stacked
+                )
+                y = stage_fn(p, y)
+            return y
+        batch_axis = (
+            "data" if "data" in self.mesh.axis_names else None
+        )
+        # pad ragged batches (eval tails) up to a whole number of
+        # microbatch rows per data shard, slice the padding back off
+        chunk = m * (
+            self.mesh.shape[batch_axis] if batch_axis else 1
+        )
+        b = x.shape[0]
+        padded = -(-b // chunk) * chunk
+        if padded != b:
+            x = jnp.concatenate(
+                [x, jnp.broadcast_to(x[-1:], (padded - b,) + x.shape[1:])]
+            )
+        micro = jnp.reshape(x, (m, padded // m) + x.shape[1:])
+        out = make_pipeline_fn(
+            self.mesh,
+            stage_fn,
+            pipe_axis=self.pipe_axis,
+            batch_axis=batch_axis,
+        )(stacked, micro)
+        out = jnp.reshape(out, (padded,) + out.shape[2:])
+        return out[:b]
 
 
 def reference_pipeline(stage_fn, per_stage_params, microbatches):

@@ -85,20 +85,18 @@ class AllReduceTrainer:
         return int(self._ts.version) if self._ts is not None else -1
 
     def _collect_sharded_paths(self):
-        """Flatten param_specs into {path tuple: NamedSharding}."""
-        paths = {}
-        if not self._param_specs:
-            return paths
+        """Flatten param_specs into {path tuple: NamedSharding}.
 
-        def walk(spec_tree, prefix):
-            if hasattr(spec_tree, "items"):
-                for k, sub in spec_tree.items():
-                    walk(sub, prefix + (k,))
-            else:
-                paths[prefix] = NamedSharding(self._mesh, spec_tree)
+        ``"**"`` keys mark subtree specs (every leaf under the prefix) —
+        see parallel/elastic.py collect_sharded_paths."""
+        from elasticdl_tpu.parallel.elastic import collect_sharded_paths
 
-        walk(self._param_specs, ())
-        return paths
+        return {
+            path: NamedSharding(self._mesh, spec)
+            for path, spec in collect_sharded_paths(
+                self._param_specs
+            ).items()
+        }
 
     @staticmethod
     def _key_names(key_path):
@@ -118,10 +116,12 @@ class AllReduceTrainer:
         rep = replicated(self._mesh)
         specs = self._sharded_paths
 
+        from elasticdl_tpu.parallel.elastic import spec_path_matches
+
         def put(key_path, x):
             names = self._key_names(key_path)
             for spec_path, sharding in specs.items():
-                if names[-len(spec_path):] == spec_path:
+                if spec_path_matches(spec_path, names):
                     return jax.device_put(x, sharding)
             return jax.device_put(x, rep)
 

@@ -60,16 +60,22 @@ class AllReduceWorker:
         seed=0,
         accum_steps=1,
         precision=None,
+        checkpoint_dir="",
+        checkpoint_steps=0,
+        keep_checkpoint_max=0,
     ):
         if job_type in (
             JobType.EVALUATION_ONLY,
             JobType.PREDICTION_ONLY,
         ):
-            # the ALLREDUCE run loop only trains (with optional eval
-            # interleave); pure eval/predict jobs run under
-            # ParameterServerStrategy against the exported model
+            # this single-process run loop only trains (with optional
+            # eval interleave); pure eval jobs are served by the elastic
+            # worker's checkpoint-scored eval-only drain (api.py routes
+            # them there), and predict by ParameterServerStrategy
             raise NotImplementedError(
-                "%s is not supported under AllreduceStrategy; use "
+                "%s is not served by the single-process ALLREDUCE loop; "
+                "evaluation_only runs via the elastic worker "
+                "(checkpoint-scored), prediction under "
                 "ParameterServerStrategy" % job_type
             )
         self._worker_id = worker_id
@@ -99,18 +105,36 @@ class AllReduceWorker:
         )
         from elasticdl_tpu.parallel.mesh import create_mesh
 
-        mesh = create_mesh(devices=devices)
         module = load_module(
             get_module_file_path(model_zoo, model_def)
         ).__dict__
+        params_dict = get_dict_from_params_str(model_params) or {}
+        mesh_shape = None
+        if "mesh_axes" in module:
+            # the model declares its parallelism layout (e.g. a
+            # transformer with pipeline_stages wants {"data": n/S,
+            # "pipe": S}); None keeps the default all-data mesh
+            import jax as _jax
+
+            n_dev = len(devices) if devices else len(_jax.devices())
+            mesh_shape = module["mesh_axes"](n_dev, **params_dict)
+        mesh = create_mesh(
+            mesh_shape,
+            axis_names=tuple(mesh_shape) if mesh_shape else None,
+            devices=devices,
+        )
         model = spec.model
         param_specs = None
         if "build_distributed_model" in module:
             model = module["build_distributed_model"](
-                mesh=mesh, **(get_dict_from_params_str(model_params) or {})
+                mesh=mesh, **params_dict
             )
             if "param_shardings" in module:
-                param_specs = module["param_shardings"](mesh)
+                # full model params, uniformly with the other hooks —
+                # zoo param_shardings declare **_params catch-alls
+                param_specs = module["param_shardings"](
+                    mesh, **params_dict
+                )
         self.trainer = AllReduceTrainer(
             model, spec.loss, spec.optimizer(), mesh=mesh,
             param_specs=param_specs, seed=seed,
@@ -124,6 +148,23 @@ class AllReduceWorker:
             self._job_type == JobType.TRAINING_WITH_EVALUATION,
             data_reader_params=data_reader_params,
         )
+        # worker-side sharded checkpoints: in ALLREDUCE mode parameters
+        # live on this worker's mesh, so the worker (not the master)
+        # writes them — same cadence/format as the multi-process elastic
+        # plane, so eval-only jobs and resumes read either
+        self._ckpt = None
+        self._last_ckpt_version = 0
+        if checkpoint_dir and checkpoint_steps:
+            from elasticdl_tpu.common.sharded_checkpoint import (
+                ShardedCheckpointManager,
+            )
+
+            self._ckpt = ShardedCheckpointManager(
+                checkpoint_dir,
+                checkpoint_steps,
+                keep_checkpoint_max,
+            )
+            self._ckpt.set_expected_writers(1)
 
     # master surface used by TaskDataService
     def get_task(self, task_type=None):
@@ -294,9 +335,23 @@ class AllReduceWorker:
                         or len(dataset_batch[1])
                     )
                 self._task_data_service.report_record_done(count, err_msg)
+                self._save_ckpt_if_due()
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                 self._evaluate_only()
             self._process_save_model_task_if_needed()
             if batches == 0:
                 time.sleep(0.2)
+        self._save_ckpt_if_due(final=True)
         return losses
+
+    def _save_ckpt_if_due(self, final=False):
+        """Write a sharded checkpoint at the version cadence (and once at
+        job end, so eval-only jobs always find the finished state)."""
+        if self._ckpt is None or not self._ckpt.is_enabled():
+            return
+        version = self.trainer.version
+        if version <= self._last_ckpt_version:
+            return
+        if final or version - self._last_ckpt_version >= self._ckpt.steps:
+            self._ckpt.save(self.trainer.train_state, version)
+            self._last_ckpt_version = version

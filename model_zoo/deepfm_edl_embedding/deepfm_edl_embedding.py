@@ -122,7 +122,7 @@ def build_host_model(**params):
     return DeepFMEdl(force_hbm=True, **params)
 
 
-def param_shardings(mesh, table_axis="data"):
+def param_shardings(mesh, table_axis="data", **_params):
     """PartitionSpecs for the HBM-resident tables; everything else
     (dense layers, optimizer moments of dense layers) replicates, and
     the tables' optimizer state co-shards with them automatically."""

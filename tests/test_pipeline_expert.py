@@ -382,3 +382,155 @@ def test_moe_aux_loss_enters_train_step():
     _, loss2 = step2(ts, {"tokens": tokens}, tokens, jax.random.PRNGKey(2))
     manual = float(zoo.loss(output, tokens)) + aux
     np.testing.assert_allclose(float(loss2), manual, rtol=1e-4)
+
+
+# -- the pipeline JOB PATH (PipelinedStack -> trainer -> worker) -------------
+
+
+def _plain_to_staged(plain_params, num_layers, n_stages):
+    """Transplant a plain TransformerLM's params into the pipelined
+    model's structure (stacked stage subtree), so both models compute
+    with identical values."""
+    per = num_layers // n_stages
+    stages = []
+    for s in range(n_stages):
+        stage = {}
+        for i in range(per):
+            stage["block_%d" % i] = plain_params["block_%d" % (s * per + i)]
+        stages.append(stage)
+    from elasticdl_tpu.parallel.pipeline import stack_stage_params
+
+    return {
+        "embed": plain_params["embed"],
+        "RMSNorm_0": plain_params["RMSNorm_0"],
+        "pipe": {"stages": stack_stage_params(stages)},
+    }
+
+
+def test_pipelined_transformer_matches_plain():
+    """Forward logits and a 4-step dp x pp training run must match the
+    plain (single-stage) model exactly (same params transplanted)."""
+    import optax
+
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+    from elasticdl_tpu.parallel.trainer import AllReduceTrainer
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    cfg = dict(
+        vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+        embed_dim=32, mlp_dim=64,
+    )
+    b, l = 8, 16
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 64, size=(b, l)).astype(np.int32)
+    batch = {"tokens": tokens}
+
+    plain = zoo.custom_model(**cfg)
+    variables = init_variables(
+        plain, jax.random.PRNGKey(0), {"tokens": tokens[:1]}
+    )
+    plain_params, _ = split_variables(variables)
+
+    mesh = create_mesh(
+        {"data": 4, "pipe": 2}, axis_names=("data", "pipe")
+    )
+    piped = zoo.build_distributed_model(
+        mesh=mesh, pipeline_stages=2, **cfg
+    )
+    staged_params = _plain_to_staged(plain_params, cfg["num_layers"], 2)
+
+    out_plain = plain.apply({"params": plain_params}, batch)
+    out_piped = piped.apply({"params": staged_params}, batch)
+    np.testing.assert_allclose(
+        np.asarray(out_piped), np.asarray(out_plain), rtol=2e-4, atol=2e-4
+    )
+
+    # ragged batch (eval tail): pads internally, slices back
+    ragged = {"tokens": tokens[:5]}
+    np.testing.assert_allclose(
+        np.asarray(piped.apply({"params": staged_params}, ragged)),
+        np.asarray(plain.apply({"params": plain_params}, ragged)),
+        rtol=2e-4,
+        atol=2e-4,
+    )
+
+    # training: same curves through the ALLREDUCE trainer
+    param_specs = zoo.param_shardings(mesh, pipeline_stages=2)
+    t_plain = AllReduceTrainer(plain, zoo.loss, optax.sgd(0.05), seed=1)
+    t_piped = AllReduceTrainer(
+        piped, zoo.loss, optax.sgd(0.05), mesh=mesh,
+        param_specs=param_specs, seed=1,
+    )
+    from elasticdl_tpu.training.step import TrainState
+
+    def host_clone(tree):
+        # donated steps delete input buffers; each trainer needs its own
+        return jax.tree_util.tree_map(lambda a: np.array(a), tree)
+
+    t_plain.load_state(
+        TrainState.create(host_clone(plain_params), {}, optax.sgd(0.05))
+    )
+    t_piped.load_state(
+        TrainState.create(host_clone(staged_params), {}, optax.sgd(0.05))
+    )
+    for step in range(4):
+        l_plain = float(t_plain.train_step(batch, tokens))
+        l_piped = float(t_piped.train_step(batch, tokens))
+        np.testing.assert_allclose(l_piped, l_plain, rtol=2e-4)
+    # stage params actually sharded over the pipe axis
+    leaf = t_piped.train_state.params["pipe"]["stages"]
+    first = jax.tree_util.tree_leaves(leaf)[0]
+    assert "pipe" in str(first.sharding.spec)
+
+
+def test_pipeline_job_path_through_worker(tmp_path):
+    """The VERDICT done-criterion: a zoo config trains through the job
+    path with stages > 1 — master task dispatch, the single-process
+    ALLREDUCE worker (the CLI local-mode engine), pipelined model."""
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.data.example import encode_example
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.allreduce_worker import AllReduceWorker
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "tokens.edlr")
+    with RecordIOWriter(path) as f:
+        for _ in range(64):
+            f.write(
+                encode_example(
+                    {
+                        "tokens": rng.integers(
+                            0, 64, size=(64,), dtype=np.int64
+                        )
+                    }
+                )
+            )
+    task_d = TaskDispatcher({path: (0, 64)}, {}, {}, 32, 1)
+    master = MasterServicer(
+        1, 16, None, task_d,
+        checkpoint_service=CheckpointService("", 0, 0, False),
+        use_async=True,
+    )
+    worker = AllReduceWorker(
+        worker_id=0,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=16,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def="transformer_lm.transformer_lm.custom_model",
+        model_params=(
+            "pipeline_stages=2,vocab_size=64,num_layers=2,num_heads=2,"
+            "head_dim=8,embed_dim=32,mlp_dim=64"
+        ),
+        stub=InProcessMaster(master),
+    )
+    # the worker built the pipelined form over a data x pipe mesh
+    assert worker.trainer.mesh.shape.get("pipe") == 2
+    losses = worker.run()
+    assert task_d.finished()
+    assert worker.trainer.version == 4  # 64 records / batch 16
+    assert all(np.isfinite(losses))
