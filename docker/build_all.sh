@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Build the image family (parity: reference elasticdl/docker/build_all.sh):
+#   elasticdl-tpu:dev — toolchain + framework, for TPU VM development
+#   elasticdl-tpu     — runtime layer job pods run on
+#   elasticdl-tpu:ci  — runtime + tests + zoo, for in-cluster CI
+# Run from the repo root. BASE_IMAGE selects the python base (a TPU VM
+# image already carrying libtpu also works).
+set -euo pipefail
+
+if [[ ! -d .git ]]; then
+    echo "run this script from the root of the source tree" >&2
+    exit 1
+fi
+
+base_img="${BASE_IMAGE:-python:3.11-slim}"
+
+docker build -t elasticdl-tpu:dev -f docker/Dockerfile.dev \
+    --build-arg BASE_IMAGE="${base_img}" .
+docker build -t elasticdl-tpu -f docker/Dockerfile \
+    --build-arg BASE_IMAGE="${base_img}" .
+docker build -t elasticdl-tpu:ci -f docker/Dockerfile.ci .

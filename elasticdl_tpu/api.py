@@ -29,12 +29,30 @@ def train(argv):
 
 
 def evaluate(argv):
-    """Evaluation-only job: requires the data + pinned checkpoint flags
-    (reference args.py add_evaluate_params)."""
-    for flag in ("--validation_data", "--checkpoint_filename_for_init"):
-        if not _has_flag(argv, flag):
-            print("edl evaluate requires %s" % flag, file=sys.stderr)
-            return 2
+    """Evaluation-only job: requires the data + a model source
+    (reference args.py add_evaluate_params). The model source is a
+    pinned checkpoint file, or — on the allreduce plane — a sharded
+    checkpoint directory from a previous elastic job."""
+    if not _has_flag(argv, "--validation_data"):
+        print("edl evaluate requires --validation_data", file=sys.stderr)
+        return 2
+    # --checkpoint_dir only counts on the allreduce plane: the PS-mode
+    # master initializes solely from --checkpoint_filename_for_init and
+    # would otherwise score a randomly-initialized model without error
+    allreduce = _flag_value(argv, "--distribution_strategy") == (
+        "AllreduceStrategy"
+    )
+    if not (
+        _has_flag(argv, "--checkpoint_filename_for_init")
+        or (allreduce and _has_flag(argv, "--checkpoint_dir"))
+    ):
+        print(
+            "edl evaluate requires --checkpoint_filename_for_init "
+            "(or, under AllreduceStrategy, --checkpoint_dir with "
+            "sharded elastic checkpoints)",
+            file=sys.stderr,
+        )
+        return 2
     argv = list(argv)
     if not _has_flag(argv, "--training_data"):
         argv += ["--training_data", ""]
@@ -74,6 +92,15 @@ def clean(argv):
 
 def _has_flag(argv, flag):
     return any(a == flag or a.startswith(flag + "=") for a in argv)
+
+
+def _flag_value(argv, flag):
+    for i, a in enumerate(argv):
+        if a == flag:
+            return argv[i + 1] if i + 1 < len(argv) else None
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
 
 
 # -- job execution ----------------------------------------------------------
@@ -148,6 +175,45 @@ def _run_local_job(args):
         )
 
         if args.distribution_strategy == "AllreduceStrategy":
+            from elasticdl_tpu.common.constants import JobType
+
+            if master.job_type == JobType.EVALUATION_ONLY:
+                # pure eval: no collective plane — the elastic worker's
+                # eval-only drain scores the saved checkpoint in-process
+                from elasticdl_tpu.worker.elastic_allreduce_worker import (
+                    ElasticAllReduceWorker,
+                )
+
+                worker = ElasticAllReduceWorker(
+                    worker_id=0,
+                    job_type=master.job_type,
+                    minibatch_size=args.minibatch_size,
+                    model_zoo=args.model_zoo,
+                    model_def=args.model_def,
+                    model_params=args.model_params,
+                    dataset_fn=args.dataset_fn,
+                    loss=args.loss,
+                    optimizer=args.optimizer,
+                    eval_metrics_fn=args.eval_metrics_fn,
+                    stub=master.master_servicer,
+                    data_reader_params=get_dict_from_params_str(
+                        args.data_reader_params
+                    ),
+                    checkpoint_dir=getattr(args, "checkpoint_dir", ""),
+                    checkpoint_filename_for_init=getattr(
+                        args, "checkpoint_filename_for_init", ""
+                    ),
+                )
+                try:
+                    worker.run()
+                except Exception:
+                    # the master would otherwise poll the requeued eval
+                    # tasks forever; shut it down, then surface the
+                    # worker's error as the job failure
+                    master.request_stop()
+                    master.run(poll_secs=0.2)
+                    raise
+                return master.run(poll_secs=0.2)
             from elasticdl_tpu.worker.allreduce_worker import (
                 AllReduceWorker,
             )
@@ -169,6 +235,11 @@ def _run_local_job(args):
                 ),
                 accum_steps=getattr(args, "grad_accum_steps", 1),
                 precision=getattr(args, "precision_policy", "") or None,
+                checkpoint_dir=getattr(args, "checkpoint_dir", ""),
+                checkpoint_steps=getattr(args, "checkpoint_steps", 0),
+                keep_checkpoint_max=getattr(
+                    args, "keep_checkpoint_max", 0
+                ),
             ).run()
             return master.run(poll_secs=0.2)
 

@@ -1,9 +1,11 @@
 """`edl` console entry point: train | evaluate | predict | clean.
 
 Parity: reference elasticdl/python/elasticdl/client.py:13-46. The
-subcommand implementations live in elasticdl_tpu.api and are wired up as
-the client layer lands; until then each subcommand fails with a clear
-message rather than a ModuleNotFoundError.
+subcommand implementations live in elasticdl_tpu.api: cluster submission
+(image build + master pod) when ``--docker_image_repository`` is set,
+else the local mode (master + workers as processes on this TPU VM). This
+shim stays import-light so failures surface as a clear message, not a
+ModuleNotFoundError.
 """
 
 import sys

@@ -364,6 +364,12 @@ def parse_worker_args(worker_args=None):
     parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument(
+        "--checkpoint_filename_for_init",
+        default="",
+        help="Exported model file evaluation-only allreduce workers "
+        "score (relayed from the master's flag of the same name)",
+    )
+    parser.add_argument(
         "--keep_checkpoint_max", type=non_neg_int, default=0
     )
     parser.add_argument(

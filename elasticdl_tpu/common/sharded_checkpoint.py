@@ -348,6 +348,7 @@ class ShardedCheckpointManager:
         self._base = base_dir
         self._steps = checkpoint_steps
         self._keep_max = keep_max
+        self._expected_writers = None
         self._async = None
         if async_io:
             from elasticdl_tpu.common.async_checkpoint import (
@@ -355,6 +356,13 @@ class ShardedCheckpointManager:
             )
 
             self._async = AsyncCheckpointer()
+
+    def set_expected_writers(self, n):
+        """Number of processes writing each version (world size for
+        sharded jobs, 1 for replicated rank-0-writes jobs). Lets ring
+        eviction distinguish a complete newer version from a torn one;
+        the elastic worker refreshes it at every (re-)establish."""
+        self._expected_writers = max(1, int(n)) if n else None
 
     @property
     def steps(self):
@@ -369,19 +377,46 @@ class ShardedCheckpointManager:
     def _dir_for(self, version):
         return os.path.join(self._base, "ckpt_v%d" % version)
 
+    def _manifest_count(self, directory):
+        return len(
+            glob.glob(os.path.join(directory, _MANIFEST_PREFIX + "*.json"))
+        )
+
     def _evict(self):
-        """Ring retention (process 0 only). In multi-writer (sharded)
-        jobs a straggler rank's async writer could still be filling an
-        old version while it is evicted; the straggler's write then
-        fails (surfaced by its next wait()) and that version reads as
-        incomplete — restores skip it. Keep keep_max comfortably above
-        the async queue bound (2) so the window is theoretical."""
+        """Ring retention (process 0 only), restorability-gated.
+
+        A version is only evicted once some NEWER version is at least as
+        complete — otherwise rank 0 could delete the last fully-written
+        checkpoint while a straggler rank is still filling the newest
+        one, and a kill in that window would leave nothing restorable.
+        "Complete" is ``expected_writers`` manifests when the worker told
+        us the world size (set_expected_writers), else — conservatively —
+        at least as many manifests as the eviction victim has (which also
+        bounds the hold after a world shrink, where old versions carry
+        more manifests than any new one ever will)."""
         kept = sorted(self.versions())
         while len(kept) > self._keep_max:
-            victim = self._dir_for(kept.pop(0))
-            for f in glob.glob(os.path.join(victim, "*")):
+            victim_dir = self._dir_for(kept[0])
+            if self._expected_writers:
+                # the authoritative bar: after a world GROW, a newer
+                # version is only restorable once every CURRENT rank's
+                # manifest landed — the victim's (smaller) count must
+                # not lower it
+                need = self._expected_writers
+            else:
+                need = self._manifest_count(victim_dir)
+            if not any(
+                self._manifest_count(self._dir_for(v)) >= need
+                for v in kept[1:]
+            ):
+                # every newer version is still torn; deleting the victim
+                # would risk the last restorable state — hold until a
+                # newer one completes (the next save retries)
+                break
+            kept.pop(0)
+            for f in glob.glob(os.path.join(victim_dir, "*")):
                 os.remove(f)
-            os.rmdir(victim)
+            os.rmdir(victim_dir)
 
     def save(self, tree, version):
         directory = self._dir_for(version)

@@ -80,6 +80,12 @@ class _EvaluationJob:
         self._acc = MetricsAccumulator(metrics_dict)
         self._report_lock = threading.Lock()
         self.published = False
+        # versions the params were ACTUALLY loaded from, when a worker
+        # could not score the pinned version exactly (e.g. the sharded
+        # plane evaluates checkpoint-assembled params lagged by the
+        # cadence) — surfaced in the published summary so consumers can
+        # see the skew instead of mis-attributing metrics
+        self.scored_versions = set()
 
     def complete_task(self):
         self._remaining -= 1
@@ -87,7 +93,9 @@ class _EvaluationJob:
     def finished(self):
         return self._remaining <= 0
 
-    def report_evaluation_metrics(self, version, model_outputs, labels):
+    def report_evaluation_metrics(
+        self, version, model_outputs, labels, scored_version=None
+    ):
         if self.model_version >= 0 and version != self.model_version:
             logger.error(
                 "Drop a wrong version evaluation: request %d, receive %d"
@@ -98,6 +106,8 @@ class _EvaluationJob:
         # read-modify-write state
         with self._report_lock:
             self._acc.update(model_outputs, labels)
+            if scored_version is not None and scored_version >= 0:
+                self.scored_versions.add(int(scored_version))
         return True
 
     def get_evaluation_summary(self):
@@ -289,12 +299,14 @@ class EvaluationService:
         # legacy alias (round-1 name), used by a few tests
         return self._round
 
-    def report_evaluation_metrics(self, version, model_outputs, labels):
+    def report_evaluation_metrics(
+        self, version, model_outputs, labels, scored_version=None
+    ):
         round_ = self._round
         if round_ is None:
             return False
         return round_.report_evaluation_metrics(
-            version, model_outputs, labels
+            version, model_outputs, labels, scored_version=scored_version
         )
 
     def complete_task(self):
@@ -334,6 +346,13 @@ class EvaluationService:
             if round_.model_version >= 0
             else self._master_servicer.get_model_version()
         )
-        logger.info(
-            "Evaluation metrics[v=%d]: %s" % (shown_version, metrics)
-        )
+        skew = round_.scored_versions - {round_.model_version}
+        if skew:
+            logger.info(
+                "Evaluation metrics[v=%d, scored from v=%s]: %s"
+                % (shown_version, sorted(round_.scored_versions), metrics)
+            )
+        else:
+            logger.info(
+                "Evaluation metrics[v=%d]: %s" % (shown_version, metrics)
+            )

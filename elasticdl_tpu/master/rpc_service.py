@@ -88,7 +88,10 @@ class MasterRpcService:
     def report_evaluation_metrics(self, req):
         outputs = {t.name: t.values for t in req.get("model_outputs", [])}
         accepted, version = self._s.report_evaluation_metrics(
-            req.get("model_version", -1), outputs, req.get("labels")
+            req.get("model_version", -1),
+            outputs,
+            req.get("labels"),
+            scored_version=req.get("scored_version"),
         )
         return {"accepted": accepted, "version": version}
 
@@ -199,7 +202,12 @@ class MasterClient:
             exec_counters=exec_counters,
         )
 
-    def report_evaluation_metrics(self, model_version, model_outputs, labels):
+    def report_evaluation_metrics(
+        self, model_version, model_outputs, labels, scored_version=None
+    ):
+        kwargs = {}
+        if scored_version is not None:
+            kwargs["scored_version"] = int(scored_version)
         resp = self._client.call(
             "report_evaluation_metrics",
             model_version=int(model_version),
@@ -207,6 +215,7 @@ class MasterClient:
                 Tensor(n, np.asarray(v)) for n, v in model_outputs.items()
             ],
             labels=np.asarray(labels),
+            **kwargs,
         )
         return resp["accepted"], resp["version"]
 

@@ -393,10 +393,17 @@ class MasterServicer:
         else:
             self._task_d.report(task_id, True)
 
-    def report_evaluation_metrics(self, model_version, model_outputs, labels):
-        """Returns (accepted, current_version)."""
+    def report_evaluation_metrics(
+        self, model_version, model_outputs, labels, scored_version=None
+    ):
+        """Returns (accepted, current_version). ``scored_version`` is the
+        version the reporting worker's params were actually loaded from
+        when it could not pin ``model_version`` exactly."""
         accepted = self._evaluation_service.report_evaluation_metrics(
-            model_version, model_outputs, labels
+            model_version,
+            model_outputs,
+            labels,
+            scored_version=scored_version,
         )
         return accepted, self._version
 

@@ -51,9 +51,14 @@ class WorldBroken(RuntimeError):
     """A collective or coordination failure that requires re-forming."""
 
 
-# shared with the master's MembershipService staleness valve: the valve
-# must outlast a member burning one full initialize timeout
-DEFAULT_WORLD_INIT_TIMEOUT = 30
+# Must sit BELOW the master's confirm/fence window (MembershipService
+# confirm_timeout_secs, default 15): a member stuck in a stale formation
+# barrier has to fail fast (WorldBroken -> re-poll, self-recovery) before
+# the fencer declares it wedged and kills the healthy process. Healthy
+# formations complete in well under a second (members only enter the
+# barrier after the two-phase confirm). Shared with the master's
+# staleness valve, which must outlast one full initialize timeout.
+DEFAULT_WORLD_INIT_TIMEOUT = 10
 
 
 def world_init_timeout():

@@ -76,6 +76,7 @@ class ElasticAllReduceWorker:
         keep_checkpoint_max=0,
         precision=None,
         accum_steps=1,
+        checkpoint_filename_for_init="",
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -113,17 +114,31 @@ class ElasticAllReduceWorker:
         zoo_module = load_module(
             get_module_file_path(model_zoo, model_def)
         ).__dict__
+        self._init_ckpt_file = checkpoint_filename_for_init
         if self._job_type == JobType.EVALUATION_ONLY:
-            # the elastic run loop only interleaves evaluation with
-            # training; a pure-eval job would deadlock (no worker ever
-            # trains, so trainer.has_state stays False and none takes
-            # eval tasks)
-            raise NotImplementedError(
-                "evaluation_only is not supported on the elastic plane; "
-                "evaluate offline from the exported model (or, for "
-                "sharded jobs, a sharded checkpoint via "
-                "load_sharded_to_host)"
-            )
+            # pure eval needs no collective at all: tasks come from the
+            # eval queue and a host-twin forward over local devices
+            # scores them. Params come from a sharded checkpoint dir
+            # (the elastic plane's own format) or an exported model file.
+            if not (checkpoint_dir or checkpoint_filename_for_init):
+                raise ValueError(
+                    "evaluation_only on the allreduce plane scores a "
+                    "saved model: pass --checkpoint_dir (sharded "
+                    "checkpoints from a previous elastic job) or "
+                    "--checkpoint_filename_for_init (an exported model "
+                    "file)"
+                )
+            if (
+                "build_collective_model" in zoo_module
+                and not checkpoint_dir
+            ):
+                # the sharded host-twin path only reads checkpoint dirs
+                raise ValueError(
+                    "evaluation_only for sharded-parameter model %s "
+                    "needs --checkpoint_dir (sharded checkpoints); an "
+                    "exported model file cannot feed the host-twin "
+                    "evaluation" % model_def
+                )
         if self._job_type == JobType.PREDICTION_ONLY:
             # the run loop would feed prediction shards into train_step
             raise NotImplementedError(
@@ -134,18 +149,38 @@ class ElasticAllReduceWorker:
         builder = None
         self._host_model_factory = None
         if (
+            self._job_type == JobType.EVALUATION_ONLY
+            and "build_distributed_model" in zoo_module
+            and "build_collective_model" not in zoo_module
+        ):
+            # score with the degenerate (mesh=None) distributed form: it
+            # has the same parameter STRUCTURE the distributed training
+            # job checkpointed (e.g. the pipelined transformer's stacked
+            # stage subtree) and runs sequentially on local devices —
+            # pass the same --model_params the training job used
+            from elasticdl_tpu.common.model_utils import (
+                get_dict_from_params_str,
+            )
+
+            self._model = zoo_module["build_distributed_model"](
+                mesh=None, **(get_dict_from_params_str(model_params) or {})
+            )
+        if (
             "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
+            and self._job_type != JobType.EVALUATION_ONLY
+            and self._zoo_wants_sharded_params(zoo_module, model_params)
         ):
             # training the plain replicated model instead would either
             # OOM (the table was sharded because it doesn't fit) or
             # silently change the declared strategy
             raise NotImplementedError(
-                "model %s declares HBM-sharded parameters "
-                "(build_distributed_model) but no build_collective_model "
-                "hook; the multi-process elastic plane needs the "
-                "collective-lookup form — add build_collective_model "
-                "(see model_zoo/deepfm_edl_embedding) or run the "
+                "model %s declares sharded parameters for this config "
+                "(param_shardings is non-empty) but no "
+                "build_collective_model hook; the multi-process elastic "
+                "plane needs the collective-lookup form — add "
+                "build_collective_model (see "
+                "model_zoo/deepfm_edl_embedding) or run the "
                 "single-process ALLREDUCE strategy" % model_def
             )
         if "build_collective_model" in zoo_module:
@@ -171,7 +206,10 @@ class ElasticAllReduceWorker:
                         "build_host_model"
                     ](**_extra)
                 )
-            evaluating = self._job_type == JobType.TRAINING_WITH_EVALUATION
+            evaluating = self._job_type in (
+                JobType.TRAINING_WITH_EVALUATION,
+                JobType.EVALUATION_ONLY,
+            )
             if evaluating and self._host_model_factory is None:
                 raise NotImplementedError(
                     "evaluation for sharded-parameter elastic jobs "
@@ -179,7 +217,9 @@ class ElasticAllReduceWorker:
                     "structure, dense lookups) — see "
                     "model_zoo/deepfm_edl_embedding"
                 )
-            if evaluating and not (checkpoint_dir and checkpoint_steps):
+            if self._job_type == JobType.TRAINING_WITH_EVALUATION and not (
+                checkpoint_dir and checkpoint_steps
+            ):
                 raise ValueError(
                     "evaluation for sharded-parameter elastic jobs "
                     "assembles eval params from sharded checkpoints; "
@@ -214,6 +254,13 @@ class ElasticAllReduceWorker:
                 async_io=True,
             )
             self.trainer.restore_provider = self._ckpt_dirs_newest_first
+        elif checkpoint_dir and self._job_type == JobType.EVALUATION_ONLY:
+            from elasticdl_tpu.common.sharded_checkpoint import (
+                ShardedCheckpointManager,
+            )
+
+            # read-only: eval-only jobs load checkpoints, never write
+            self._ckpt = ShardedCheckpointManager(checkpoint_dir)
         elif builder is not None:
             logger.warning(
                 "sharded-parameter elastic job without --checkpoint_steps:"
@@ -228,7 +275,32 @@ class ElasticAllReduceWorker:
         self._forward_fn = None
         self._eval_params_version = None
         self._eval_params = None
+        self._eval_scored_version = None  # version params actually carry
         self._overflow_alarmed = 0
+
+    @staticmethod
+    def _zoo_wants_sharded_params(zoo_module, model_params):
+        """Does this zoo + model_params combination actually shard
+        parameters? Keying the collective-hook requirement on
+        build_distributed_model's mere PRESENCE would wrongly reject
+        configs whose distributed form is optional (e.g. transformer_lm
+        without pipeline_stages trains replicated). param_shardings is
+        probed with mesh=None — zoo hooks accept that and answer from
+        the params alone; no mesh (= no JAX backend init) may happen
+        before the world forms."""
+        ps = zoo_module.get("param_shardings")
+        if ps is None:
+            return True  # conservative: hook declared, intent unknown
+        from elasticdl_tpu.common.model_utils import (
+            get_dict_from_params_str,
+        )
+
+        try:
+            return bool(
+                ps(None, **(get_dict_from_params_str(model_params) or {}))
+            )
+        except Exception:
+            return True
 
     def _ckpt_dirs_newest_first(self):
         """Candidate checkpoint dirs, newest first; drains in-flight
@@ -372,6 +444,8 @@ class ElasticAllReduceWorker:
             self._drain_ckpt()
 
     def _run(self):
+        if self._job_type == JobType.EVALUATION_ONLY:
+            return self._run_eval_only()
         losses = []
         self._batch_gen = self._batches()
         first = self._prime()
@@ -388,6 +462,15 @@ class ElasticAllReduceWorker:
             try:
                 example = self._retry_batch or self.trainer._last_local
                 self.trainer.establish(world, example_batch=example)
+                if self._ckpt is not None:
+                    # ring eviction must know what "complete" means in
+                    # this world: every rank writes sharded versions,
+                    # rank 0 alone writes replicated ones
+                    self._ckpt.set_expected_writers(
+                        world.num_processes
+                        if self.trainer.is_sharded
+                        else 1
+                    )
                 if (
                     self._ckpt is not None
                     and not self._restore_attempted
@@ -611,22 +694,156 @@ class ElasticAllReduceWorker:
 
     # -- evaluation (local devices only, host-fetched params) ---------------
 
-    def _local_forward(self, features):
-        if self.trainer.is_sharded:
-            return self._sharded_forward(features)
+    def _run_eval_only(self):
+        """Pure evaluation: drain the eval queue against saved params.
+
+        No collective, no world membership, no training loop — the
+        reference serves eval-only from the same worker loop
+        (reference worker/worker.py:866-876); here the loop shrinks to
+        the eval-task drain the interleaved path already uses. Params
+        come from the newest complete sharded checkpoint (sharded zoos
+        score through their host twin via _sharded_forward) or an
+        exported model file."""
+        drained_rounds = 0
+        while True:
+            executed = self._evaluate_only()
+            task = self.get_task()  # non-eval queue: detects job end
+            if task.shard_name:
+                # unexpected non-eval work (mixed job?): report it back
+                # untouched as failed so the master re-routes it
+                self.report_task_result(
+                    task.task_id,
+                    err_msg="eval-only worker cannot run task type %s"
+                    % task.type,
+                )
+            if not executed and not task.shard_name:
+                drained_rounds += 1
+                if drained_rounds >= 3:
+                    break
+                time.sleep(0.5)
+            else:
+                drained_rounds = 0
+        # giving up: a drained eval queue is normal completion, but a
+        # task that is STILL there means every attempt deferred (e.g. the
+        # checkpoint dir is empty and no trainer will ever fill it) —
+        # fail loudly instead of letting the master wait on requeues
+        # forever
+        from elasticdl_tpu.common.constants import TaskType
+
+        leftover = self.get_task(TaskType.EVALUATION)
+        if leftover.shard_name:
+            self.report_task_result(
+                leftover.task_id,
+                err_msg="eval-only worker giving up: no scoreable params",
+            )
+            raise RuntimeError(
+                "evaluation-only job cannot make progress: eval tasks "
+                "keep deferring (is --checkpoint_dir empty / "
+                "--checkpoint_filename_for_init unreadable, or does the "
+                "checkpoint's parameter structure mismatch the model "
+                "built from --model_params?)"
+            )
+        return []
+
+    def _eval_only_forward(self, features):
+        if self._eval_params is None:
+            self._load_eval_only_params(features)
         if self._forward_fn is None:
             from elasticdl_tpu.training.step import make_forward_fn
 
             self._forward_fn = make_forward_fn(self._model)
-        version = self.trainer.version
-        if self._eval_params_version != version:
-            host_ts = self.trainer.snapshot()
-            if host_ts is None:
-                # never trained (peers drained the queue before this
-                # process got a task): no params to evaluate with
-                raise RuntimeError("no local train state for evaluation")
-            self._eval_params = (host_ts.params, host_ts.state)
-            self._eval_params_version = version
+        params, state = self._eval_params
+        return self._forward_fn(params, state, features)
+
+    def _load_eval_only_params(self, features):
+        """Newest complete sharded checkpoint, else the exported model
+        file (params only — exported models carry no mutable state, so
+        stateful models evaluate with init-fresh state)."""
+        if self._ckpt is not None:
+            from elasticdl_tpu.common.sharded_checkpoint import (
+                load_sharded_to_host,
+            )
+
+            for directory in self._ckpt_dirs_newest_first():
+                try:
+                    loaded_version, tree = load_sharded_to_host(directory)
+                except Exception:
+                    continue
+                self._eval_params = (
+                    tree["params"],
+                    tree.get("state") or {},
+                )
+                self._eval_scored_version = loaded_version
+                return
+        if self._init_ckpt_file:
+            import jax
+
+            from elasticdl_tpu.common.model_utils import (
+                load_from_checkpoint_file,
+            )
+            from elasticdl_tpu.common.tensor import named_arrays_to_pytree
+            from elasticdl_tpu.nn.model_api import (
+                init_variables,
+                split_variables,
+            )
+
+            version, named = load_from_checkpoint_file(
+                self._init_ckpt_file
+            )
+            one = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:1], features
+            )
+            template, state = split_variables(
+                init_variables(self._model, jax.random.PRNGKey(0), one)
+            )
+            params = named_arrays_to_pytree(named, template)
+            logger.info(
+                "eval-only: scoring exported model v%d from %s",
+                version,
+                self._init_ckpt_file,
+            )
+            self._eval_params = (params, state)
+            self._eval_scored_version = version
+            return
+        raise RuntimeError(
+            "no restorable checkpoint in %r for evaluation"
+            % (self._ckpt._base if self._ckpt is not None else "")
+        )
+
+    def _local_forward(self, features, pinned_version=None):
+        if self.trainer.is_sharded:
+            return self._sharded_forward(features)
+        if self._job_type == JobType.EVALUATION_ONLY:
+            return self._eval_only_forward(features)
+        if self._forward_fn is None:
+            from elasticdl_tpu.training.step import make_forward_fn
+
+            self._forward_fn = make_forward_fn(self._model)
+        if (
+            pinned_version is None
+            or self._eval_params_version != pinned_version
+        ):
+            # eval rounds pin the version a sync-point report carried,
+            # and the run loop polls the eval queue at the NEXT iteration
+            # (before any further step), so the common case snapshots at
+            # exactly the pinned version — the cached snapshot then
+            # serves every task of the round even after training moves
+            # on (the reference's pinned-checkpoint semantics,
+            # reference master/evaluation_service.py:186-203). A late
+            # grab (re-form raced the round) scores current params and
+            # reports the true version alongside.
+            version = self.trainer.version
+            if self._eval_params_version != version:
+                host_ts = self.trainer.snapshot()
+                if host_ts is None:
+                    # never trained (peers drained the queue before this
+                    # process got a task): no params to evaluate with
+                    raise RuntimeError(
+                        "no local train state for evaluation"
+                    )
+                self._eval_params = (host_ts.params, host_ts.state)
+                self._eval_params_version = version
+        self._eval_scored_version = self._eval_params_version
         params, state = self._eval_params
         return self._forward_fn(params, state, features)
 
@@ -656,7 +873,10 @@ class ElasticAllReduceWorker:
             tree = None
             for directory in candidates:
                 try:
-                    _, tree = load_sharded_to_host(directory)
+                    loaded_version, tree = load_sharded_to_host(directory)
+                    # reported alongside the pinned round version so the
+                    # published summary shows the cadence lag honestly
+                    self._eval_scored_version = loaded_version
                     break
                 except Exception:
                     # newest may be mid-write by a peer; older complete
@@ -692,10 +912,12 @@ class ElasticAllReduceWorker:
         requeued eval task is never abandoned with the job unfinished."""
         from elasticdl_tpu.common.constants import TaskType
 
-        if not self.trainer.has_state:
+        eval_only = self._job_type == JobType.EVALUATION_ONLY
+        if not eval_only and not self.trainer.has_state:
             # no params to evaluate with (never trained): leave the eval
             # tasks for peers that have state — grabbing one here would
-            # fail-requeue-regrab in a tight livelock
+            # fail-requeue-regrab in a tight livelock. Eval-only workers
+            # instead score saved checkpoints, so they always proceed.
             return False
         executed = False
         retries = 30 if final else 0
@@ -729,7 +951,10 @@ class ElasticAllReduceWorker:
             self._task_data_service.data_reader.metadata,
         )
         dataset = dataset.batch(self._minibatch_size)
-        if not self.trainer.has_state:
+        if (
+            self._job_type != JobType.EVALUATION_ONLY
+            and not self.trainer.has_state
+        ):
             # fail the task so a worker that has trained state redoes it
             self.report_task_result(
                 task_id, err_msg="no local train state for evaluation"
@@ -738,7 +963,9 @@ class ElasticAllReduceWorker:
         out_chunks, label_chunks = {}, []
         try:
             for features, labels in dataset:
-                outputs = self._local_forward(features)
+                outputs = self._local_forward(
+                    features, pinned_version=model_version
+                )
                 if not isinstance(outputs, dict):
                     outputs = {MetricsDictKey.MODEL_OUTPUT: outputs}
                 for k, v in outputs.items():
@@ -757,6 +984,7 @@ class ElasticAllReduceWorker:
                 model_version,
                 {k: np.concatenate(v) for k, v in out_chunks.items()},
                 np.concatenate(label_chunks),
+                scored_version=self._eval_scored_version,
             )
         self.report_task_result(task_id)
         return True

@@ -73,6 +73,7 @@ def _run(args):
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_steps=args.checkpoint_steps,
             keep_checkpoint_max=args.keep_checkpoint_max,
+            checkpoint_filename_for_init=args.checkpoint_filename_for_init,
             precision=args.precision_policy or None,
             accum_steps=args.grad_accum_steps,
         ).run()

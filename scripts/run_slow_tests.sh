@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# The slow tier: multi-OS-process elastic jobs (SIGKILL recovery, sharded
+# checkpointing, eval interleave) and compile-heavy model tests that the
+# default `pytest tests/` run skips (pyproject addopts: -m 'not slow').
+# Run this before cutting a release or after touching the elastic plane:
+#
+#   scripts/run_slow_tests.sh            # the whole slow tier
+#   scripts/run_slow_tests.sh -k kill    # just the kill-recovery rungs
+#
+# Wall-clock: ~6-10 min on an 8-core host (worker subprocesses run over
+# gloo CPU collectives; no TPU needed).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -m slow --override-ini="addopts=" -q "$@"

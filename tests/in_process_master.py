@@ -42,10 +42,15 @@ class InProcessMaster:
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
         return self._m.report_task_result(task_id, err_msg, exec_counters)
 
-    def report_evaluation_metrics(self, model_version, model_outputs, labels):
+    def report_evaluation_metrics(
+        self, model_version, model_outputs, labels, scored_version=None
+    ):
         for callback in self._callbacks:
             if ON_REPORT_EVALUATION_METRICS_BEGIN in callback.call_times:
                 callback()
         return self._m.report_evaluation_metrics(
-            model_version, model_outputs, labels
+            model_version,
+            model_outputs,
+            labels,
+            scored_version=scored_version,
         )

@@ -268,6 +268,112 @@ def test_weighted_step_with_accumulation_matches_plain():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+def test_replicated_eval_pins_version_snapshot():
+    """Eval rounds pin a version; the replicated plane must score every
+    task of round V with version-V params even after training moves on
+    (reference pinned-checkpoint semantics), and report the version it
+    actually scored when it cannot pin exactly."""
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.worker.elastic_allreduce_worker import (
+        ElasticAllReduceWorker,
+    )
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    worker = ElasticAllReduceWorker(
+        worker_id=0,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=4,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def="mnist_subclass.mnist_subclass.CustomModel",
+        stub=None,
+    )
+
+    class FakeTS:
+        def __init__(self, tag):
+            self.params = {"w": tag}
+            self.state = {}
+
+    class FakeTrainer:
+        is_sharded = False
+        version = 5
+
+        def snapshot(self):
+            return FakeTS(self.version)
+
+    worker.trainer = FakeTrainer()
+    worker._forward_fn = lambda params, state, x: params["w"]
+
+    # round pinned at the current version: exact
+    assert worker._local_forward("x", pinned_version=5) == 5
+    assert worker._eval_scored_version == 5
+
+    # training advances; round-5 tasks KEEP scoring the v5 snapshot
+    worker.trainer.version = 7
+    assert worker._local_forward("x", pinned_version=5) == 5
+    assert worker._eval_scored_version == 5
+
+    # a new round at v7 refreshes
+    assert worker._local_forward("x", pinned_version=7) == 7
+    assert worker._eval_scored_version == 7
+
+    # late grab (round pinned v6 never snapshotted): scores current and
+    # reports the true version
+    worker.trainer.version = 9
+    assert worker._local_forward("x", pinned_version=6) == 9
+    assert worker._eval_scored_version == 9
+
+
+def test_elastic_worker_accepts_transformer_without_pipeline():
+    """transformer_lm now declares build_distributed_model (the
+    single-process pipeline path); the multi-process elastic worker must
+    keep training it REPLICATED when no pipeline is requested, and only
+    reject configs that actually shard parameters."""
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.worker.elastic_allreduce_worker import (
+        ElasticAllReduceWorker,
+    )
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    kwargs = dict(
+        worker_id=0,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=4,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def="transformer_lm.transformer_lm.custom_model",
+        stub=None,
+    )
+    # replicated training: fine
+    worker = ElasticAllReduceWorker(
+        model_params="vocab_size=64,num_layers=2", **kwargs
+    )
+    assert not worker.trainer.is_sharded
+
+    # pipelined config shards stage params -> needs the collective form
+    with pytest.raises(NotImplementedError, match="collective"):
+        ElasticAllReduceWorker(
+            model_params="vocab_size=64,num_layers=2,pipeline_stages=2",
+            **kwargs,
+        )
+
+
+def test_evaluation_round_records_scored_versions():
+    from elasticdl_tpu.master.evaluation_service import _EvaluationJob
+
+    job = _EvaluationJob(
+        {"acc": lambda labels, predictions: np.equal(labels, predictions)},
+        model_version=10,
+        total_tasks=2,
+    )
+    assert job.report_evaluation_metrics(
+        10, {"output": np.ones(2)}, np.ones(2), scored_version=8
+    )
+    assert job.scored_versions == {8}
+    # wrong pinned version still dropped
+    assert not job.report_evaluation_metrics(
+        9, {"output": np.ones(2)}, np.ones(2), scored_version=9
+    )
+
+
 # -- rung 2: real OS processes over gloo ------------------------------------
 
 

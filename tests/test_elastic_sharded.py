@@ -437,6 +437,50 @@ def test_sharded_elastic_job_survives_worker_kill(tmp_path, monkeypatch):
     assert table is not None and table.shape == (96, 8)
 
 
+def test_cross_leaf_optimizer_rejected_for_sharded_jobs(monkeypatch):
+    """optax.clip_by_global_norm folds each rank's different local shard
+    gradients into a per-rank scale — the trainer must refuse it at
+    build time for sharded jobs (advisor finding), and accept per-leaf
+    optimizers and replicated jobs unchanged."""
+    from elasticdl_tpu.parallel.elastic import (
+        ElasticDPTrainer,
+        optimizer_couples_leaves,
+    )
+
+    coupled = optax.chain(
+        optax.clip_by_global_norm(1.0), optax.sgd(0.1)
+    )
+    assert optimizer_couples_leaves(coupled)
+    for ok in (optax.sgd(0.1), optax.adam(1e-3), optax.adagrad(0.1),
+               optax.chain(optax.clip(1.0), optax.sgd(0.1))):
+        assert not optimizer_couples_leaves(ok)
+
+    def model():
+        import flax.linen as nn
+
+        return nn.Dense(2)
+
+    # the gate runs at establish (after ensure_world — probing earlier
+    # would initialize the XLA backend and break world formation); here
+    # the internal check is driven directly with sharded paths present
+    trainer = ElasticDPTrainer(model(), lambda o, l: o.sum(), coupled)
+    trainer._sharded_paths = {("table",): P("data", None)}
+    with pytest.raises(ValueError, match="couples gradients"):
+        trainer._check_optimizer_coupling()
+
+    # escape hatch
+    monkeypatch.setenv("EDL_ALLOW_CROSS_LEAF_OPT", "1")
+    trainer2 = ElasticDPTrainer(model(), lambda o, l: o.sum(), coupled)
+    trainer2._sharded_paths = {("table",): P("data", None)}
+    trainer2._check_optimizer_coupling()
+    monkeypatch.delenv("EDL_ALLOW_CROSS_LEAF_OPT")
+
+    # replicated jobs (no sharded paths) keep accepting global-norm
+    # clipping: every rank sees identical gradients there
+    trainer3 = ElasticDPTrainer(model(), lambda o, l: o.sum(), coupled)
+    trainer3._check_optimizer_coupling()
+
+
 def test_host_model_matches_collective_param_structure():
     """build_host_model must accept the collective model's params
     verbatim (eval/export assemble checkpoints written by it)."""

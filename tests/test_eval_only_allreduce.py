@@ -1,0 +1,171 @@
+"""Evaluation-only jobs on the allreduce plane.
+
+The reference serves train/eval/predict from one worker loop
+(reference worker/worker.py:866-876). The elastic allreduce worker now
+serves eval-only too: no collective, no world membership — the eval queue
+drains against params loaded from a sharded checkpoint directory or an
+exported model file, scored with host-twin forwards over local devices.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.common.constants import JobType
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.worker.elastic_allreduce_worker import (
+    ElasticAllReduceWorker,
+)
+from tests.test_utils import MODEL_ZOO_PATH, DatasetName, create_recordio_file
+
+MODEL_DEF = "mnist_subclass.mnist_subclass.CustomModel"
+
+
+def _trained_params():
+    from elasticdl_tpu.common.model_utils import (
+        get_model_spec,
+    )
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+
+    spec = get_model_spec(
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=MODEL_DEF,
+        model_params="",
+        dataset_fn="dataset_fn",
+        loss="loss",
+        optimizer="optimizer",
+        eval_metrics_fn="eval_metrics_fn",
+    )
+    variables = init_variables(
+        spec.model,
+        jax.random.PRNGKey(3),
+        {"image": np.zeros((1, 28, 28), np.float32)},
+    )
+    return split_variables(variables)
+
+
+def _eval_only_master(val_dir, extra=()):
+    args = parse_master_args(
+        [
+            "--job_name",
+            "eval-only-test",
+            "--model_zoo",
+            MODEL_ZOO_PATH,
+            "--model_def",
+            MODEL_DEF,
+            "--minibatch_size",
+            "16",
+            "--num_minibatches_per_task",
+            "2",
+            "--num_epochs",
+            "1",
+            "--training_data",
+            "",
+            "--validation_data",
+            str(val_dir),
+            "--num_workers",
+            "1",
+            "--num_ps_pods",
+            "0",
+            "--port",
+            "0",
+            "--distribution_strategy",
+            "AllreduceStrategy",
+        ]
+        + list(extra)
+    )
+    master = Master(args)
+    assert master.job_type == JobType.EVALUATION_ONLY
+    return master
+
+
+def _run_eval_only(master, worker_kwargs):
+    published = []
+    orig = master.evaluation_service._publish_summary
+
+    def capture(round_):
+        published.append(round_.get_evaluation_summary())
+        return orig(round_)
+
+    master.evaluation_service._publish_summary = capture
+    master.evaluation_service.start()
+    worker = ElasticAllReduceWorker(
+        worker_id=0,
+        job_type=JobType.EVALUATION_ONLY,
+        minibatch_size=16,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=MODEL_DEF,
+        stub=master.master_servicer,
+        **worker_kwargs,
+    )
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.2}, daemon=True
+    )
+    runner.start()
+    worker.run()
+    runner.join(timeout=60)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    return published
+
+
+def test_eval_only_rejected_without_a_model_source(tmp_path):
+    create_recordio_file(
+        32, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+    )
+    with pytest.raises(ValueError, match="scores a saved"):
+        _eval_only_master(tmp_path)
+
+
+def test_eval_only_from_sharded_checkpoint(tmp_path):
+    from elasticdl_tpu.common.sharded_checkpoint import save_sharded
+
+    val_dir = tmp_path / "val"
+    val_dir.mkdir()
+    create_recordio_file(
+        64, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(val_dir)
+    )
+    ckpt_dir = tmp_path / "ckpt"
+    params, state = _trained_params()
+    save_sharded(
+        str(ckpt_dir / "ckpt_v7"),
+        {"params": params, "state": state},
+        version=7,
+    )
+
+    master = _eval_only_master(
+        val_dir, extra=("--checkpoint_dir", str(ckpt_dir))
+    )
+    published = _run_eval_only(
+        master, {"checkpoint_dir": str(ckpt_dir)}
+    )
+    assert published, "no evaluation round completed"
+    assert any("accuracy" in m for m in published), published
+
+
+def test_eval_only_from_exported_model_file(tmp_path):
+    from elasticdl_tpu.common.model_utils import save_checkpoint_to_file
+    from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+
+    val_dir = tmp_path / "val"
+    val_dir.mkdir()
+    create_recordio_file(
+        64, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(val_dir)
+    )
+    params, _ = _trained_params()
+    model_file = str(tmp_path / "model.chkpt")
+    save_checkpoint_to_file(
+        pytree_to_named_arrays(params), 11, model_file
+    )
+
+    master = _eval_only_master(
+        val_dir, extra=("--checkpoint_filename_for_init", model_file)
+    )
+    published = _run_eval_only(
+        master, {"checkpoint_filename_for_init": model_file}
+    )
+    assert published, "no evaluation round completed"
+    assert any("accuracy" in m for m in published), published

@@ -1,0 +1,104 @@
+"""Real-cluster job rung (opt-in): submit through the actual CLI and
+poll pod status, like the reference CI does against minikube
+(reference scripts/client_test.sh + validate_job_status.sh).
+
+Everything else in tests/test_k8s_client.py runs against fake SDKs; this
+rung is the one place a real apiserver, image registry, and kubelet are
+in the loop. It is gated on ``K8S_TESTS=1`` plus:
+
+- a reachable cluster (current kubeconfig context or in-cluster SA),
+- ``EDL_TEST_REGISTRY`` — a registry the cluster can pull from, used as
+  ``--docker_image_repository`` (images built by docker/build_all.sh).
+
+Run it via::
+
+    K8S_TESTS=1 EDL_TEST_REGISTRY=registry.example/elasticdl \
+        python -m pytest tests/test_k8s_job_rung.py -m slow --override-ini="addopts="
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.environ.get("K8S_TESTS"),
+        reason="set K8S_TESTS=1 (and EDL_TEST_REGISTRY) with a reachable "
+        "cluster to run the real-pod job rung",
+    ),
+]
+
+
+def _sh(cmd, **kw):
+    return subprocess.run(
+        cmd, cwd=REPO, text=True, capture_output=True, **kw
+    )
+
+
+def test_cluster_train_job_reaches_succeeded():
+    registry = os.environ.get("EDL_TEST_REGISTRY")
+    if not registry:
+        pytest.skip("EDL_TEST_REGISTRY not set")
+    probe = _sh(["kubectl", "version", "--request-timeout=5s"])
+    if probe.returncode != 0:
+        pytest.skip("no reachable cluster: %s" % probe.stderr[-200:])
+
+    job_name = "edl-rung-%d" % os.getpid()
+    data_dir = tempfile.mkdtemp(prefix="edl_rung_")
+    gen = _sh(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.data.recordio_gen.image_label",
+            "--output_dir",
+            os.path.join(data_dir, "data"),
+            "--records_per_shard",
+            "128",
+            "--dataset",
+            "synthetic-mnist",
+        ]
+    )
+    assert gen.returncode == 0, gen.stderr
+
+    submit = _sh(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.cli",
+            "train",
+            "--job_name",
+            job_name,
+            "--model_zoo",
+            "model_zoo",
+            "--model_def",
+            "mnist_subclass.mnist_subclass.CustomModel",
+            "--minibatch_size",
+            "64",
+            "--num_epochs",
+            "1",
+            "--num_workers",
+            "2",
+            "--use_async",
+            "true",
+            "--training_data",
+            os.path.join(data_dir, "data"),
+            "--docker_image_repository",
+            registry,
+        ],
+        timeout=600,
+    )
+    assert submit.returncode == 0, submit.stderr
+
+    validate = _sh(
+        ["bash", "scripts/validate_job_status.sh", job_name, "600"],
+        timeout=700,
+    )
+    assert validate.returncode == 0, (
+        validate.stdout[-2000:] + validate.stderr[-2000:]
+    )

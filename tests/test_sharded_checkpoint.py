@@ -102,6 +102,67 @@ def test_manager_ring_retention(tmp_path):
     assert mgr.latest_dir().endswith("ckpt_v30")
 
 
+def test_ring_eviction_holds_while_newer_version_is_torn(tmp_path):
+    """Multi-writer window (advisor finding): with keep_max=1, rank 0
+    must NOT evict the last fully-written version while the newest one is
+    still missing a straggler rank's manifest — a kill in that window
+    would leave nothing restorable."""
+    import json
+    import os
+
+    mgr = ShardedCheckpointManager(str(tmp_path), 10, keep_max=1)
+    mgr.set_expected_writers(2)
+
+    def write_manifest(version, pid):
+        d = mgr._dir_for(version)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "manifest-%d.json" % pid), "w") as f:
+            json.dump({"version": version, "leaves": {}}, f)
+
+    # v10 complete (both ranks); v20 torn (rank 1 still writing)
+    write_manifest(10, 0)
+    write_manifest(10, 1)
+    write_manifest(20, 0)
+    mgr._evict()
+    assert mgr.versions() == [10, 20], "evicted the only complete version"
+
+    # straggler lands: v20 complete -> v10 becomes evictable
+    write_manifest(20, 1)
+    mgr._evict()
+    assert mgr.versions() == [20]
+
+    # world GROWS to 4: a newer version with only the old world's count
+    # of manifests is still torn — must not unlock eviction
+    mgr.set_expected_writers(4)
+    write_manifest(30, 0)
+    write_manifest(30, 1)
+    mgr._evict()
+    assert mgr.versions() == [20, 30], "torn post-grow version evicted v20"
+    write_manifest(30, 2)
+    write_manifest(30, 3)
+    mgr._evict()
+    assert mgr.versions() == [30]
+
+    # without expected_writers the conservative rule (newer must match
+    # the victim's manifest count) gives the same protection
+    mgr2 = ShardedCheckpointManager(str(tmp_path / "b"), 10, keep_max=1)
+
+    def wm2(version, pid):
+        d = mgr2._dir_for(version)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "manifest-%d.json" % pid), "w") as f:
+            json.dump({"version": version, "leaves": {}}, f)
+
+    wm2(10, 0)
+    wm2(10, 1)
+    wm2(20, 0)
+    mgr2._evict()
+    assert mgr2.versions() == [10, 20]
+    wm2(20, 1)
+    mgr2._evict()
+    assert mgr2.versions() == [20]
+
+
 def test_trainer_sharded_checkpoint_roundtrip(tmp_path):
     """AllReduceTrainer with an HBM-sharded deepfm: save, mutate, restore
     — exact state recovery including co-sharded optimizer slots."""
