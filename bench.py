@@ -117,7 +117,9 @@ def bench_transformer(quick=False, use_flash=True):
             vocab_size=32768, num_layers=12, num_heads=12, head_dim=64,
             embed_dim=768, mlp_dim=3072,
         )
-        batch, seq, steps = 8, 1024, 10
+        # b16 measured best on v5e (config sweep, BASELINE.md r3):
+        # 42% MFU vs 37% at b8 and 38% at b32
+        batch, seq, steps = 16, 1024, 10
     model = zoo.custom_model(dtype="bfloat16", use_flash=use_flash, **cfg)
     rng = np.random.default_rng(0)
     tokens = rng.integers(
@@ -195,51 +197,61 @@ def bench_transformer(quick=False, use_flash=True):
     return tokens_per_sec, mfu, desc
 
 
-def bench_flash(quick=False):
-    """Flash vs reference attention fwd+bwd across L (scan, DCE-proof)."""
+def _time_attention_grad(fn, b, l, h, d, iters, repeats=3):
+    """Seconds per fwd+bwd of ``fn(q, k, v)`` (scan-measured, DCE-proof).
+
+    The carry perturbs q AND consumes all three gradients: gq and gk/gv
+    come from SEPARATE pallas_calls in the flash VJP, so a carry that
+    only reads gq would let XLA dead-code-eliminate the dk/dv kernel and
+    time a partial backward."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def step(carry, i):
+            gq, gk, gv = grad(q + carry * 1e-30, k, v)
+            return (
+                carry
+                + gq.astype(jnp.float32).sum() * 1e-30
+                + gk.astype(jnp.float32).sum() * 1e-30
+                + gv.astype(jnp.float32).sum() * 1e-30
+            ), ()
+
+        c, _ = lax.scan(step, jnp.float32(0.0), jnp.arange(iters))
+        return c
+
+    float(run(q, k, v))  # compile+warm
+    best = 1e9
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(run(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
+
+
+def bench_flash(quick=False):
+    """Flash vs reference attention fwd+bwd across L (scan, DCE-proof)."""
     from elasticdl_tpu.ops.flash_attention import flash_attention
     from elasticdl_tpu.parallel.ring_attention import reference_attention
 
     iters = 5 if quick else 50
 
     def one(fn, b, l, h, d):
-        rng = np.random.default_rng(0)
-        q = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
-        k = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
-        v = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
-
-        def loss(q, k, v):
-            return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
-
-        grad = jax.grad(loss, argnums=(0, 1, 2))
-
-        @jax.jit
-        def run(q, k, v):
-            def step(carry, i):
-                # perturb q by the carry so each iteration's grads depend
-                # on the previous one — nothing can be hoisted or elided
-                gq, gk, gv = grad(q + carry * 1e-30, k, v)
-                return (
-                    carry
-                    + gq.astype(jnp.float32).sum() * 1e-30
-                    + gk.astype(jnp.float32).sum() * 1e-30
-                    + gv.astype(jnp.float32).sum() * 1e-30
-                ), ()
-
-            c, _ = lax.scan(step, jnp.float32(0.0), jnp.arange(iters))
-            return c
-
-        float(run(q, k, v))  # compile+warm
-        best = 1e9
-        for _ in range(2 if quick else 3):
-            t0 = time.perf_counter()
-            float(run(q, k, v))
-            best = min(best, time.perf_counter() - t0)
-        return best / iters
+        return _time_attention_grad(
+            fn, b, l, h, d, iters, repeats=2 if quick else 3
+        )
 
     b, h, d = 4, 8, 64
     lengths = (512, 1024) if quick else (512, 1024, 2048, 4096)
@@ -269,6 +281,46 @@ def bench_flash(quick=False):
         if L == speedup_at:
             speedup = t_ref / t_flash
     return speedup, speedup_at
+
+
+def bench_longcontext(quick=False):
+    """Flash attention fwd+bwd at long L — the lengths where an unfused
+    attention cannot run at all (the (L, L) bf16 score tensor at L=16k+
+    with b1 h8 exceeds single-chip HBM). Reports tokens/s/layer at the
+    longest length that completes; the per-L table goes to stderr."""
+    from elasticdl_tpu.ops.flash_attention import flash_attention
+    from elasticdl_tpu.parallel.ring_attention import reference_attention
+
+    iters = 3 if quick else 10
+    h, d = 8, 64
+
+    def one(fn, b, l):
+        return _time_attention_grad(fn, b, l, h, d, iters, repeats=2)
+
+    shapes = ((2, 4096), (1, 8192)) if quick else (
+        (2, 8192), (1, 16384), (1, 32768), (1, 65536),
+    )
+    best = None
+    for b, L in shapes:
+        row = "b=%d L=%5d:" % (b, L)
+        try:
+            t = one(lambda q, k, v: flash_attention(q, k, v, True), b, L)
+            tok_s = b * L / t
+            best = (L, tok_s)
+            row += " flash %8.1fms (%7.0f tok/s/layer)" % (t * 1e3, tok_s)
+        except Exception as e:
+            row += " flash FAIL(%s)" % type(e).__name__
+        try:
+            t = one(
+                lambda q, k, v: reference_attention(q, k, v, causal=True),
+                b, L,
+            )
+            row += "  ref %8.1fms" % (t * 1e3)
+        except Exception as e:
+            # expected from L=16k up: the (L,L) score tensor OOMs
+            row += "  ref FAIL(%s)" % type(e).__name__
+        print(row, file=sys.stderr, flush=True)
+    return best
 
 
 def bench_embedding(quick=False):
@@ -493,6 +545,21 @@ def main(argv=None):
             "flash_attention_speedup_l%d" % at_len,
             round(speedup, 2),
             "x vs XLA reference attention (fwd+bwd, b4 h8 d64, causal)",
+            update,
+        )
+        return 0
+
+    if "--longcontext" in argv:
+        best = bench_longcontext(quick)
+        if best is None:
+            print(json.dumps({"error": "no long-context shape completed"}))
+            return 1
+        max_len, tok_s = best
+        _emit(
+            "flash_attention_max_context_tokens_per_sec",
+            round(tok_s, 0),
+            "tokens/sec/layer fwd+bwd at L=%d, b1 h8 d64 (XLA unfused "
+            "attention fails from L=16384 up)" % max_len,
             update,
         )
         return 0

@@ -28,17 +28,19 @@ def train(argv):
     return _run_job(args, argv)
 
 
-def evaluate(argv):
-    """Evaluation-only job: requires the data + a model source
-    (reference args.py add_evaluate_params). The model source is a
-    pinned checkpoint file, or — on the allreduce plane — a sharded
-    checkpoint directory from a previous elastic job."""
-    if not _has_flag(argv, "--validation_data"):
-        print("edl evaluate requires --validation_data", file=sys.stderr)
+def _serving_job(argv, verb, data_flag):
+    """Shared gate + launch for serving-only jobs (evaluate / predict).
+
+    Both need their data flag plus a model source: a pinned checkpoint
+    file, or — only on the allreduce plane, whose workers read the
+    sharded elastic format — a --checkpoint_dir (the PS-mode master
+    initializes solely from --checkpoint_filename_for_init and would
+    otherwise score a randomly-initialized model without error). One
+    definition of "valid model source" here; Master.__init__ re-checks
+    it server-side."""
+    if not _has_flag(argv, data_flag):
+        print("edl %s requires %s" % (verb, data_flag), file=sys.stderr)
         return 2
-    # --checkpoint_dir only counts on the allreduce plane: the PS-mode
-    # master initializes solely from --checkpoint_filename_for_init and
-    # would otherwise score a randomly-initialized model without error
     allreduce = _flag_value(argv, "--distribution_strategy") == (
         "AllreduceStrategy"
     )
@@ -47,9 +49,9 @@ def evaluate(argv):
         or (allreduce and _has_flag(argv, "--checkpoint_dir"))
     ):
         print(
-            "edl evaluate requires --checkpoint_filename_for_init "
+            "edl %s requires --checkpoint_filename_for_init "
             "(or, under AllreduceStrategy, --checkpoint_dir with "
-            "sharded elastic checkpoints)",
+            "sharded elastic checkpoints)" % verb,
             file=sys.stderr,
         )
         return 2
@@ -60,17 +62,14 @@ def evaluate(argv):
     return _run_job(args, argv)
 
 
+def evaluate(argv):
+    """Evaluation-only job (reference args.py add_evaluate_params)."""
+    return _serving_job(argv, "evaluate", "--validation_data")
+
+
 def predict(argv):
     """Prediction-only job (reference args.py add_predict_params)."""
-    for flag in ("--prediction_data", "--checkpoint_filename_for_init"):
-        if not _has_flag(argv, flag):
-            print("edl predict requires %s" % flag, file=sys.stderr)
-            return 2
-    argv = list(argv)
-    if not _has_flag(argv, "--training_data"):
-        argv += ["--training_data", ""]
-    args = args_module.parse_master_args(argv)
-    return _run_job(args, argv)
+    return _serving_job(argv, "predict", "--prediction_data")
 
 
 def clean(argv):
@@ -177,9 +176,12 @@ def _run_local_job(args):
         if args.distribution_strategy == "AllreduceStrategy":
             from elasticdl_tpu.common.constants import JobType
 
-            if master.job_type == JobType.EVALUATION_ONLY:
-                # pure eval: no collective plane — the elastic worker's
-                # eval-only drain scores the saved checkpoint in-process
+            if master.job_type in (
+                JobType.EVALUATION_ONLY,
+                JobType.PREDICTION_ONLY,
+            ):
+                # pure eval/predict: no collective plane — the elastic
+                # worker's serving drain scores the saved checkpoint
                 from elasticdl_tpu.worker.elastic_allreduce_worker import (
                     ElasticAllReduceWorker,
                 )
@@ -202,6 +204,11 @@ def _run_local_job(args):
                     checkpoint_dir=getattr(args, "checkpoint_dir", ""),
                     checkpoint_filename_for_init=getattr(
                         args, "checkpoint_filename_for_init", ""
+                    ),
+                    prediction_outputs_processor=getattr(
+                        args,
+                        "prediction_outputs_processor",
+                        "PredictionOutputsProcessor",
                     ),
                 )
                 try:
@@ -262,6 +269,11 @@ def _run_local_job(args):
                 args.data_reader_params
             ),
             precision=getattr(args, "precision_policy", "") or None,
+            prediction_outputs_processor=getattr(
+                args,
+                "prediction_outputs_processor",
+                "PredictionOutputsProcessor",
+            ),
         )
         from elasticdl_tpu.common.args import warn_accum_unsupported
 

@@ -310,6 +310,10 @@ def parse_master_args(master_args=None):
     parser.add_argument("--worker_image", default="")
     parser.add_argument("--prediction_data", default="")
     parser.add_argument(
+        "--prediction_outputs_processor",
+        default="PredictionOutputsProcessor",
+    )
+    parser.add_argument(
         "--comm_base_port",
         type=non_neg_int,
         default=0,

@@ -71,30 +71,20 @@ class Master:
         if (
             getattr(args, "distribution_strategy", "")
             == DistributionStrategy.ALLREDUCE
-            and self.job_type == JobType.PREDICTION_ONLY
-        ):
-            # reject at submit time: the allreduce workers would
-            # otherwise crash-loop on the same rejection pod by pod.
-            # (Eval-only IS served: workers score saved checkpoints with
-            # host-twin forwards — no collective involved.)
-            raise ValueError(
-                "%s is not supported under AllreduceStrategy; run it "
-                "under ParameterServerStrategy against the exported "
-                "model" % self.job_type
-            )
-        if (
-            getattr(args, "distribution_strategy", "")
-            == DistributionStrategy.ALLREDUCE
-            and self.job_type == JobType.EVALUATION_ONLY
+            and self.job_type
+            in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY)
             and not (
                 getattr(args, "checkpoint_dir", "")
                 or getattr(args, "checkpoint_filename_for_init", "")
             )
         ):
+            # serving jobs (no training) score a saved model; reject a
+            # sourceless submit before pods crash-loop on it
             raise ValueError(
-                "evaluation_only under AllreduceStrategy scores a saved "
-                "model: pass --checkpoint_dir (sharded checkpoints) or "
+                "%s under AllreduceStrategy scores a saved model: pass "
+                "--checkpoint_dir (sharded checkpoints) or "
                 "--checkpoint_filename_for_init (exported model file)"
+                % self.job_type
             )
 
         records_per_task = (
@@ -122,19 +112,21 @@ class Master:
         if (
             getattr(args, "distribution_strategy", "")
             == DistributionStrategy.ALLREDUCE
-            and self.job_type == JobType.EVALUATION_ONLY
+            and self.job_type
+            in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY)
             and "build_collective_model" in model_module
             and not getattr(args, "checkpoint_dir", "")
         ):
-            # sharded-table zoos evaluate through the host twin, which
+            # sharded-table zoos serve through the host twin, which
             # assembles params from sharded checkpoint DIRECTORIES only;
             # accepting an exported-file-only job here would defer every
-            # eval task until the worker gives up
+            # task until the worker gives up
             raise ValueError(
-                "evaluation_only for model %s (sharded parameters) "
-                "needs --checkpoint_dir pointing at sharded elastic "
+                "%s for model %s (sharded parameters) needs "
+                "--checkpoint_dir pointing at sharded elastic "
                 "checkpoints; --checkpoint_filename_for_init alone "
-                "cannot feed the host-twin evaluation" % args.model_def
+                "cannot feed the host-twin forward"
+                % (self.job_type, args.model_def)
             )
         self.optimizer = model_module[args.optimizer]()
 

@@ -77,6 +77,7 @@ class ElasticAllReduceWorker:
         precision=None,
         accum_steps=1,
         checkpoint_filename_for_init="",
+        prediction_outputs_processor="PredictionOutputsProcessor",
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -102,6 +103,7 @@ class ElasticAllReduceWorker:
             loss=loss,
             optimizer=optimizer,
             eval_metrics_fn=eval_metrics_fn,
+            prediction_outputs_processor=prediction_outputs_processor,
         )
         self._dataset_fn = spec.dataset_fn
         self._model = spec.model
@@ -115,18 +117,27 @@ class ElasticAllReduceWorker:
             get_module_file_path(model_zoo, model_def)
         ).__dict__
         self._init_ckpt_file = checkpoint_filename_for_init
-        if self._job_type == JobType.EVALUATION_ONLY:
-            # pure eval needs no collective at all: tasks come from the
-            # eval queue and a host-twin forward over local devices
-            # scores them. Params come from a sharded checkpoint dir
-            # (the elastic plane's own format) or an exported model file.
+        self._prediction_outputs_processor = (
+            spec.prediction_outputs_processor
+        )
+        # serving jobs (pure eval / pure predict) need no collective at
+        # all: tasks drain against a host-twin forward over local
+        # devices with params loaded from a sharded checkpoint dir (the
+        # elastic plane's own format) or an exported model file — the
+        # reference serves all three modes from one worker loop
+        # (reference worker/worker.py:866-876)
+        self._serving_only = self._job_type in (
+            JobType.EVALUATION_ONLY,
+            JobType.PREDICTION_ONLY,
+        )
+        if self._serving_only:
             if not (checkpoint_dir or checkpoint_filename_for_init):
                 raise ValueError(
-                    "evaluation_only on the allreduce plane scores a "
-                    "saved model: pass --checkpoint_dir (sharded "
-                    "checkpoints from a previous elastic job) or "
+                    "%s on the allreduce plane scores a saved model: "
+                    "pass --checkpoint_dir (sharded checkpoints from a "
+                    "previous elastic job) or "
                     "--checkpoint_filename_for_init (an exported model "
-                    "file)"
+                    "file)" % self._job_type
                 )
             if (
                 "build_collective_model" in zoo_module
@@ -134,22 +145,15 @@ class ElasticAllReduceWorker:
             ):
                 # the sharded host-twin path only reads checkpoint dirs
                 raise ValueError(
-                    "evaluation_only for sharded-parameter model %s "
-                    "needs --checkpoint_dir (sharded checkpoints); an "
+                    "%s for sharded-parameter model %s needs "
+                    "--checkpoint_dir (sharded checkpoints); an "
                     "exported model file cannot feed the host-twin "
-                    "evaluation" % model_def
+                    "forward" % (self._job_type, model_def)
                 )
-        if self._job_type == JobType.PREDICTION_ONLY:
-            # the run loop would feed prediction shards into train_step
-            raise NotImplementedError(
-                "prediction is not supported on the elastic plane; run "
-                "predict under ParameterServerStrategy (the reference's "
-                "predict plane) against the exported model"
-            )
         builder = None
         self._host_model_factory = None
         if (
-            self._job_type == JobType.EVALUATION_ONLY
+            self._serving_only
             and "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
         ):
@@ -168,7 +172,7 @@ class ElasticAllReduceWorker:
         if (
             "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
-            and self._job_type != JobType.EVALUATION_ONLY
+            and not self._serving_only
             and self._zoo_wants_sharded_params(zoo_module, model_params)
         ):
             # training the plain replicated model instead would either
@@ -206,16 +210,17 @@ class ElasticAllReduceWorker:
                         "build_host_model"
                     ](**_extra)
                 )
-            evaluating = self._job_type in (
+            needs_host_twin = self._job_type in (
                 JobType.TRAINING_WITH_EVALUATION,
                 JobType.EVALUATION_ONLY,
+                JobType.PREDICTION_ONLY,
             )
-            if evaluating and self._host_model_factory is None:
+            if needs_host_twin and self._host_model_factory is None:
                 raise NotImplementedError(
-                    "evaluation for sharded-parameter elastic jobs "
-                    "needs the zoo's build_host_model hook (same param "
-                    "structure, dense lookups) — see "
-                    "model_zoo/deepfm_edl_embedding"
+                    "%s for sharded-parameter elastic jobs needs the "
+                    "zoo's build_host_model hook (same param structure, "
+                    "dense lookups) — see model_zoo/deepfm_edl_embedding"
+                    % self._job_type
                 )
             if self._job_type == JobType.TRAINING_WITH_EVALUATION and not (
                 checkpoint_dir and checkpoint_steps
@@ -254,12 +259,12 @@ class ElasticAllReduceWorker:
                 async_io=True,
             )
             self.trainer.restore_provider = self._ckpt_dirs_newest_first
-        elif checkpoint_dir and self._job_type == JobType.EVALUATION_ONLY:
+        elif checkpoint_dir and self._serving_only:
             from elasticdl_tpu.common.sharded_checkpoint import (
                 ShardedCheckpointManager,
             )
 
-            # read-only: eval-only jobs load checkpoints, never write
+            # read-only: serving jobs load checkpoints, never write
             self._ckpt = ShardedCheckpointManager(checkpoint_dir)
         elif builder is not None:
             logger.warning(
@@ -446,6 +451,8 @@ class ElasticAllReduceWorker:
     def _run(self):
         if self._job_type == JobType.EVALUATION_ONLY:
             return self._run_eval_only()
+        if self._job_type == JobType.PREDICTION_ONLY:
+            return self._run_predict_only()
         losses = []
         self._batch_gen = self._batches()
         first = self._prime()
@@ -744,6 +751,67 @@ class ElasticAllReduceWorker:
                 "built from --model_params?)"
             )
         return []
+
+    def _run_predict_only(self):
+        """Pure prediction: stream prediction tasks through the dataset
+        machinery, forward with saved params, hand outputs to the zoo's
+        processor — the PS worker's _predict_only shape (reference
+        worker.py:879-899), with record accounting via
+        report_record_done so a failed batch fail-reports its task."""
+        import jax
+
+        if self._prediction_outputs_processor is None:
+            # reference contract (worker.py:230-240): warn, don't fail —
+            # outputs are simply not processed
+            logger.warning(
+                "prediction_outputs_processor is not defined in the "
+                "model definition. Prediction outputs are not processed."
+            )
+        while True:
+            dataset = self._task_data_service.get_dataset()
+            if not dataset:
+                break
+            dataset = self._dataset_fn(
+                dataset,
+                Mode.PREDICTION,
+                self._task_data_service.data_reader.metadata,
+            )
+            dataset = dataset.batch(self._minibatch_size).prefetch(1)
+            for features in dataset:
+                count = int(
+                    np.asarray(
+                        jax.tree_util.tree_leaves(features)[0]
+                    ).shape[0]
+                )
+                err_msg = ""
+                try:
+                    outputs = self._serving_forward(features)
+                    if self._prediction_outputs_processor is not None:
+                        self._prediction_outputs_processor.process(
+                            outputs, self._worker_id
+                        )
+                except RuntimeError as e:
+                    # e.g. no restorable checkpoint: fail-report so the
+                    # task requeues; the give-up below keeps a dead
+                    # checkpoint source from spinning forever
+                    logger.warning("prediction batch deferred: %s", e)
+                    err_msg = str(e)
+                self._task_data_service.report_record_done(
+                    count, err_msg
+                )
+                if err_msg:
+                    raise RuntimeError(
+                        "prediction-only job cannot make progress: %s"
+                        % err_msg
+                    )
+        return []
+
+    def _serving_forward(self, features):
+        """Forward for serving jobs: sharded zoos go through the host
+        twin, everything else through the checkpoint-loaded params."""
+        if self.trainer.is_sharded:
+            return self._sharded_forward(features)
+        return self._eval_only_forward(features)
 
     def _eval_only_forward(self, features):
         if self._eval_params is None:

@@ -74,6 +74,7 @@ def _run(args):
             checkpoint_steps=args.checkpoint_steps,
             keep_checkpoint_max=args.keep_checkpoint_max,
             checkpoint_filename_for_init=args.checkpoint_filename_for_init,
+            prediction_outputs_processor=args.prediction_outputs_processor,
             precision=args.precision_policy or None,
             accum_steps=args.grad_accum_steps,
         ).run()

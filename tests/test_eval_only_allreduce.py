@@ -1,9 +1,9 @@
-"""Evaluation-only jobs on the allreduce plane.
+"""Serving-only jobs (pure eval / pure predict) on the allreduce plane.
 
 The reference serves train/eval/predict from one worker loop
 (reference worker/worker.py:866-876). The elastic allreduce worker now
-serves eval-only too: no collective, no world membership — the eval queue
-drains against params loaded from a sharded checkpoint directory or an
+serves both pure modes too: no collective, no world membership — tasks
+drain against params loaded from a sharded checkpoint directory or an
 exported model file, scored with host-twin forwards over local devices.
 """
 
@@ -144,6 +144,100 @@ def test_eval_only_from_sharded_checkpoint(tmp_path):
     )
     assert published, "no evaluation round completed"
     assert any("accuracy" in m for m in published), published
+
+
+def test_predict_only_on_allreduce_plane(tmp_path):
+    """Prediction-only under AllreduceStrategy: tasks stream through the
+    dataset machinery, forward runs on checkpoint-loaded params, outputs
+    reach the zoo's processor — no collective anywhere."""
+    from elasticdl_tpu.common.model_utils import save_checkpoint_to_file
+    from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+    from elasticdl_tpu.worker.prediction_outputs_processor import (
+        BasePredictionOutputsProcessor,
+    )
+
+    records = 64
+    pred_dir = tmp_path / "pred"
+    pred_dir.mkdir()
+    create_recordio_file(
+        records, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(pred_dir)
+    )
+    params, _ = _trained_params()
+    model_file = str(tmp_path / "model.chkpt")
+    save_checkpoint_to_file(
+        pytree_to_named_arrays(params), 5, model_file
+    )
+
+    args = parse_master_args(
+        [
+            "--job_name", "predict-only-test",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", MODEL_DEF,
+            "--minibatch_size", "16",
+            "--num_minibatches_per_task", "2",
+            "--num_epochs", "1",
+            "--training_data", "",
+            "--prediction_data", str(pred_dir),
+            "--num_workers", "1",
+            "--num_ps_pods", "0",
+            "--port", "0",
+            "--distribution_strategy", "AllreduceStrategy",
+            "--checkpoint_filename_for_init", model_file,
+        ]
+    )
+    master = Master(args)
+    assert master.job_type == JobType.PREDICTION_ONLY
+
+    class CapturingProcessor(BasePredictionOutputsProcessor):
+        def __init__(self):
+            self.chunks = []
+
+        def process(self, predictions, worker_id):
+            self.chunks.append((worker_id, np.asarray(predictions)))
+
+    worker = ElasticAllReduceWorker(
+        worker_id=3,
+        job_type=JobType.PREDICTION_ONLY,
+        minibatch_size=16,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=MODEL_DEF,
+        stub=master.master_servicer,
+        checkpoint_filename_for_init=model_file,
+    )
+    processor = CapturingProcessor()
+    worker._prediction_outputs_processor = processor
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.2}, daemon=True
+    )
+    runner.start()
+    worker.run()
+    runner.join(timeout=60)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    total = sum(chunk.shape[0] for _, chunk in processor.chunks)
+    assert total == records
+    for worker_id, chunk in processor.chunks:
+        assert worker_id == 3
+        assert chunk.shape[1:] == (10,)
+        assert np.isfinite(chunk).all()
+
+
+def test_predict_only_rejected_without_a_model_source(tmp_path):
+    create_recordio_file(
+        32, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+    )
+    args = parse_master_args(
+        [
+            "--job_name", "p", "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", MODEL_DEF, "--minibatch_size", "16",
+            "--num_epochs", "1", "--training_data", "",
+            "--prediction_data", str(tmp_path), "--num_workers", "1",
+            "--num_ps_pods", "0", "--port", "0",
+            "--distribution_strategy", "AllreduceStrategy",
+        ]
+    )
+    with pytest.raises(ValueError, match="scores a saved"):
+        Master(args)
 
 
 def test_eval_only_from_exported_model_file(tmp_path):
