@@ -26,6 +26,12 @@ surfaces as a step error; the pre-step state is still addressable
 master to notice the death and bump the epoch, and re-forms. Evaluation
 tasks run between steps on host-fetched params over local devices only —
 never on the global mesh — so slow eval can't wedge the collective plane.
+
+Serving-only jobs (JobType.EVALUATION_ONLY / PREDICTION_ONLY) skip the
+whole collective machinery: no membership, no world, no trainer state —
+tasks drain against host-twin forwards over checkpoint-loaded params
+(_run_eval_only / _run_predict_only), matching the reference's
+one-loop-serves-all-modes worker (reference worker/worker.py:866-876).
 """
 
 import os

@@ -377,6 +377,21 @@ def test_evaluation_round_records_scored_versions():
 # -- rung 2: real OS processes over gloo ------------------------------------
 
 
+def _count_successes(task_d):
+    """Patch task_d.report to collect successful task ids (shared by the
+    kill and scale-up rungs)."""
+    completed = []
+    orig_report = task_d.report
+
+    def counting_report(task_id, success):
+        if success:
+            completed.append(task_id)
+        return orig_report(task_id, success)
+
+    task_d.report = counting_report
+    return completed
+
+
 def _master_for(data_dir, num_workers, num_epochs=2, extra=()):
     args = parse_master_args(
         [
@@ -491,15 +506,7 @@ def test_elastic_allreduce_survives_worker_kill(tmp_path):
     )
     master = _master_for(str(tmp_path), num_workers=3, num_epochs=2)
 
-    completed = []
-    orig_report = master.task_d.report
-
-    def counting_report(task_id, success):
-        if success:
-            completed.append(task_id)
-        return orig_report(task_id, success)
-
-    master.task_d.report = counting_report
+    completed = _count_successes(master.task_d)
 
     manager = LocalInstanceManager(
         master.task_d,
@@ -532,6 +539,73 @@ def test_elastic_allreduce_survives_worker_kill(tmp_path):
     # every task eventually completed despite the kill (3 workers,
     # 384*2 records / 64 records-per-task = 12 tasks)
     assert len(set(completed)) == 12
+    manager.stop_relaunch_and_remove_all_pods()
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_scales_up_mid_job(tmp_path):
+    """Pure growth (no kill): a worker added mid-job parks in the
+    joiner lobby until the 2-worker formation is seen training, then a
+    growth bump folds it in — the job finishes with all tasks done and
+    the world actually reached size 3."""
+    create_recordio_file(
+        768, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+    )
+    # 8 lazy epochs x 12 tasks: the job must outlive the joiner's cold
+    # start (jax import + reader prime) by a wide margin — each worker's
+    # shuffle buffer alone swallows 16 tasks (1024 records) at priming,
+    # so small jobs drain before a late joiner can ever grab a task
+    master = _master_for(str(tmp_path), num_workers=2, num_epochs=8)
+
+    completed = _count_successes(master.task_d)
+
+    # every get_world registers; record the live-set size at each one so
+    # a short-lived 3-member world cannot be missed by polling
+    live_sizes = []
+    orig_register = master.membership.register
+
+    def spy_register(worker_id, host="localhost"):
+        result = orig_register(worker_id, host)
+        live_sizes.append(len(master.membership._live))
+        return result
+
+    master.membership.register = spy_register
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        2,
+        _worker_command_for(master),
+        env=_worker_env(),
+        membership=master.membership,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+
+    # add the third worker the moment the 2-worker world forms (the
+    # first completion REPORT lands much later: record counts are held
+    # back through the deferred-sync window)
+    deadline = time.time() + 240
+    while master.membership.epoch < 1:
+        assert time.time() < deadline, "initial world never formed"
+        assert runner.is_alive(), "master exited early"
+        time.sleep(0.2)
+    manager._start_worker()
+
+    runner.join(timeout=420)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    # >= not ==: a fence-and-relaunch race on a loaded host can push
+    # the live set past 3 transiently; growth is what matters
+    assert max(live_sizes) >= 3, (
+        "third worker never joined the live set (max=%d)"
+        % max(live_sizes)
+    )
+    # 768*8 records / 64 per task = 96 tasks, all completed exactly once
+    assert len(set(completed)) == 96
     manager.stop_relaunch_and_remove_all_pods()
 
 
