@@ -8,7 +8,10 @@
 #   scripts/run_slow_tests.sh -k kill    # just the kill-recovery rungs
 #
 # Wall-clock: ~6-10 min on an 8-core host (worker subprocesses run over
-# gloo CPU collectives; no TPU needed).
+# gloo CPU collectives; no TPU needed). Run it on an otherwise idle
+# host: the elastic rungs spawn real worker processes with liveness
+# windows, and heavy concurrent load (e.g. another pytest run) can push
+# them past their progress deadlines.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m pytest tests/ -m slow --override-ini="addopts=" -q "$@"
