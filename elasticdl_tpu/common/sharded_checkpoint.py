@@ -464,3 +464,12 @@ class ShardedCheckpointManager:
     def latest_dir(self):
         versions = self.versions()
         return self._dir_for(versions[-1]) if versions else None
+
+    def dirs_newest_first(self):
+        """Candidate restore directories, newest first. Callers iterate
+        and fall through on load errors: a killed rank can leave the
+        newest version torn (load raises on incomplete shard coverage)
+        while an older complete one sits behind it."""
+        return [
+            self._dir_for(v) for v in sorted(self.versions(), reverse=True)
+        ]

@@ -154,6 +154,7 @@ class AllReduceWorker:
         # plane, so eval-only jobs and resumes read either
         self._ckpt = None
         self._last_ckpt_version = 0
+        self._restore_attempted = False
         if checkpoint_dir and checkpoint_steps:
             from elasticdl_tpu.common.sharded_checkpoint import (
                 ShardedCheckpointManager,
@@ -206,9 +207,36 @@ class AllReduceWorker:
             b,
         )
 
+    def _maybe_restore(self):
+        """Resume from the newest restorable checkpoint once state
+        exists (first batch). Same fall-through-older semantics as the
+        elastic plane: a torn newest directory must not wedge resume —
+        and without this, a restarted local job would silently
+        re-initialize and overwrite the previous run's versions."""
+        if self._ckpt is None or self._restore_attempted:
+            return
+        self._restore_attempted = True
+        for directory in self._ckpt.dirs_newest_first():
+            try:
+                restored = self.trainer.restore_sharded(directory)
+                self._last_ckpt_version = restored
+                logger.info(
+                    "resumed from checkpoint v%d (%s)", restored, directory
+                )
+                return
+            except Exception:
+                logger.warning(
+                    "checkpoint %s unrestorable; trying older",
+                    directory,
+                    exc_info=True,
+                )
+
     def _train_batch(self, dataset_batch):
         features, labels = dataset_batch
         features, labels, count = self._pad_to_devices(features, labels)
+        if self.trainer.train_state is None:
+            self.trainer.init_from_batch((features, labels))
+            self._maybe_restore()
         # the per-step fetch keeps failure accounting exact (a failed
         # step surfaces on the batch that failed, before its records are
         # reported done); the multi-process elastic worker is the plane

@@ -329,10 +329,7 @@ class ElasticAllReduceWorker:
                 "previous complete checkpoint",
                 exc_info=True,
             )
-        return [
-            self._ckpt._dir_for(v)
-            for v in sorted(self._ckpt.versions(), reverse=True)
-        ]
+        return self._ckpt.dirs_newest_first()
 
     def _latest_ckpt_dir(self):
         dirs = self._ckpt_dirs_newest_first()
@@ -520,8 +517,7 @@ class ElasticAllReduceWorker:
         corrupt directory falls back to the next-older one instead of
         crash-looping the worker."""
         self._ckpt.wait()  # an in-flight async save must land first
-        for version in sorted(self._ckpt.versions(), reverse=True):
-            directory = self._ckpt._dir_for(version)
+        for directory in self._ckpt.dirs_newest_first():
             try:
                 self.trainer.restore_sharded(directory)
                 self._last_ckpt_version = self.trainer.version
@@ -1134,9 +1130,9 @@ class ElasticAllReduceWorker:
             directory,
             last_err,
         )
-        for version in sorted(self._ckpt.versions(), reverse=True)[1:]:
+        for older in self._ckpt.dirs_newest_first()[1:]:
             try:
-                v, tree = load_sharded_to_host(self._ckpt._dir_for(version))
+                v, tree = load_sharded_to_host(older)
                 return pytree_to_named_arrays(tree["params"]), v
             except Exception:
                 continue

@@ -115,3 +115,50 @@ def test_allreduce_rejects_eval_and_predict_only_jobs():
                 model_def="mnist_subclass.mnist_subclass.CustomModel",
                 stub=None,
             )
+
+
+def test_allreduce_worker_resumes_from_sharded_checkpoint(tmp_path):
+    """Job 2 on the same checkpoint dir must CONTINUE job 1's version
+    counter (restore at first batch), not silently re-initialize and
+    overwrite job 1's checkpoint directories."""
+    from elasticdl_tpu.common.sharded_checkpoint import (
+        ShardedCheckpointManager,
+    )
+
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def run_job():
+        f = create_recordio_file(128, DatasetName.IMAGE_DEFAULT, (28, 28))
+        task_d = TaskDispatcher({f: (0, 128)}, {}, {}, 64, 1)
+        master = MasterServicer(
+            1,
+            16,
+            None,
+            task_d,
+            checkpoint_service=CheckpointService("", 0, 0, False),
+            use_async=True,
+        )
+        worker = AllReduceWorker(
+            worker_id=0,
+            job_type=JobType.TRAINING_ONLY,
+            minibatch_size=16,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def="mnist_subclass.mnist_subclass.CustomModel",
+            stub=InProcessMaster(master),
+            checkpoint_dir=ckpt_dir,
+            checkpoint_steps=4,
+        )
+        worker.run()
+        assert task_d.finished()
+        return worker.trainer.version
+
+    v1 = run_job()
+    assert v1 == 8  # 128 records / batch 16
+    versions_after_1 = ShardedCheckpointManager(ckpt_dir).versions()
+    assert versions_after_1, "job 1 wrote no checkpoints"
+
+    v2 = run_job()
+    # job 2 restored job 1's final state: its counter continued
+    assert v2 == v1 + 8, (v1, v2)
+    versions_after_2 = ShardedCheckpointManager(ckpt_dir).versions()
+    assert max(versions_after_2) > max(versions_after_1)
