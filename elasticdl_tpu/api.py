@@ -242,6 +242,7 @@ def _run_local_job(args):
                 ),
                 accum_steps=getattr(args, "grad_accum_steps", 1),
                 precision=getattr(args, "precision_policy", "") or None,
+                remat=getattr(args, "remat", ""),
                 checkpoint_dir=getattr(args, "checkpoint_dir", ""),
                 checkpoint_steps=getattr(args, "checkpoint_steps", 0),
                 keep_checkpoint_max=getattr(

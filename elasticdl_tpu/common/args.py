@@ -30,6 +30,16 @@ def warn_accum_unsupported(args, plane="this training plane"):
             args.grad_accum_steps,
             plane,
         )
+    if getattr(args, "remat", ""):
+        from elasticdl_tpu.common.log_utils import default_logger
+
+        default_logger.warning(
+            "--remat=%s is only honored by the ALLREDUCE strategy; %s "
+            "runs WITHOUT activation rematerialization (memory will "
+            "NOT be bounded as requested)",
+            args.remat,
+            plane,
+        )
 
 
 def pos_int(arg):
@@ -283,6 +293,15 @@ def add_common_args_between_master_and_worker(parser):
         help="Gradient accumulation: split each minibatch into this "
         "many microbatches inside the jitted step (activation memory "
         "drops to one microbatch; one optimizer update per minibatch)",
+    )
+    parser.add_argument(
+        "--remat",
+        default="",
+        help="Activation rematerialization on the ALLREDUCE planes: "
+        "'full' (jax.checkpoint the whole forward) or a "
+        "jax.checkpoint_policies name (e.g. "
+        "dots_with_no_batch_dims_saveable); trades recompute FLOPs for "
+        "HBM so deeper models / longer sequences fit per chip",
     )
     parser.add_argument(
         "--precision_policy",

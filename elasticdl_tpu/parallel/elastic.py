@@ -242,6 +242,7 @@ def make_elastic_train_step(
     precision=None,
     accum_steps=1,
     state_specs=None,
+    remat=False,
 ):
     """Weighted lockstep step: ``(ts, features, labels, weights, rng) ->
     (ts', loss, n_active)``.
@@ -281,8 +282,10 @@ def make_elastic_train_step(
     of ``accum_steps * local_devices``.
     """
     from elasticdl_tpu.training.precision import get_policy
+    from elasticdl_tpu.training.step import make_remat_forward
 
     pol = get_policy(precision)
+    forward = make_remat_forward(module, remat)
 
     def _is_sharded(spec):
         return spec is not None and any(a is not None for a in spec)
@@ -304,8 +307,8 @@ def make_elastic_train_step(
                     features_c = pol.cast_to_compute(features_mb)
                 else:
                     features_c = features_mb
-                output, new_state = apply_model(
-                    module, p, state, features_c, training=True, rng=rng_mb
+                output, new_state = forward(
+                    p, state, features_c, rng_mb
                 )
                 if pol is not None:
                     output = pol.cast_output(output)
@@ -408,6 +411,7 @@ class ElasticDPTrainer:
         accum_steps=1,
         distributed_builder=None,
         restore_provider=None,
+        remat=False,
     ):
         """``distributed_builder``: optional ``mesh -> (module,
         param_specs)`` hook for HBM-sharded parameters (the zoo's
@@ -425,6 +429,7 @@ class ElasticDPTrainer:
         self._coupling_checked = False
         self._seed = seed
         self._precision = precision
+        self._remat = remat
         self._accum_steps = max(1, accum_steps)
         self._builder = distributed_builder
         self.restore_provider = restore_provider
@@ -517,6 +522,7 @@ class ElasticDPTrainer:
             precision=self._precision,
             accum_steps=self._accum_steps,
             state_specs=self._state_specs,
+            remat=self._remat,
         )
         logger.info(
             "elastic plane established: epoch=%d rank=%d/%d devices=%d%s",

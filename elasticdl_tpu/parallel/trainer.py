@@ -42,6 +42,7 @@ class AllReduceTrainer:
         param_specs=None,
         accum_steps=1,
         precision=None,
+        remat=False,
     ):
         """``param_specs``: optional nested dict mirroring (a prefix of)
         the params tree whose leaves are PartitionSpecs — parameters it
@@ -63,6 +64,7 @@ class AllReduceTrainer:
             optimizer,
             accum_steps=accum_steps,
             precision=precision,
+            remat=remat,
         )
         self._mesh = mesh if mesh is not None else create_mesh(devices=devices)
         self._ts = None

@@ -145,6 +145,61 @@ def make_grad_fn(module, loss_fn, precision=None):
     return jax.jit(step)
 
 
+def parse_remat(value):
+    """CLI string -> the step builders' ``remat``: '' -> False,
+    'full'/'true'/'1' -> True, anything else names a
+    jax.checkpoint_policies policy — validated HERE so a typo fails at
+    submit/construction, not after an elastic worker has already joined
+    its collective world (where it would crash-loop under relaunch)."""
+    if not value:
+        return False
+    if str(value).lower() in ("full", "true", "1"):
+        return True
+    import jax
+
+    if getattr(jax.checkpoint_policies, str(value), None) is None:
+        raise ValueError(
+            "unknown remat policy %r (see jax.checkpoint_policies)"
+            % (value,)
+        )
+    return str(value)
+
+
+def make_remat_forward(module, remat):
+    """The standard training forward, optionally rematerialized.
+
+    One definition for every step builder (plain and elastic).
+    Rematerialization trades FLOPs for HBM: the backward recomputes the
+    forward's activations instead of keeping them alive, so deeper
+    models / longer sequences / bigger batches fit on a chip. ``remat``
+    may be True (full ``jax.checkpoint``) or a string naming a
+    jax.checkpoint_policies policy (e.g.
+    "dots_with_no_batch_dims_saveable" keeps matmul outputs and
+    recomputes the cheap elementwise ops only). ``prevent_cse=False``:
+    the wrapped forward is only ever differentiated under jit (and the
+    grad-accumulation ``lax.scan``), where the CSE workaround barriers
+    are unnecessary and cost step time.
+    """
+    import jax
+
+    def forward(p, state, features, rng):
+        return apply_model(
+            module, p, state, features, training=True, rng=rng
+        )
+
+    if not remat:
+        return forward
+    if remat is True:
+        return jax.checkpoint(forward, prevent_cse=False)
+    policy = getattr(jax.checkpoint_policies, str(remat), None)
+    if policy is None:
+        raise ValueError(
+            "unknown remat policy %r (see jax.checkpoint_policies)"
+            % (remat,)
+        )
+    return jax.checkpoint(forward, prevent_cse=False, policy=policy)
+
+
 def make_train_step(
     module,
     loss_fn,
@@ -152,6 +207,7 @@ def make_train_step(
     pmean_axis=None,
     accum_steps=1,
     precision=None,
+    remat=False,
 ):
     """Jitted fused step ``(train_state, features, labels, rng) ->
     (train_state, loss)`` with donated state.
@@ -174,10 +230,15 @@ def make_train_step(
     are cast to ``compute_dtype`` inside the differentiated function (so
     gradients and optimizer math stay in ``param_dtype``), the model
     output is upcast to ``output_dtype`` before the loss.
+
+    ``remat``: activation rematerialization (see :func:`_maybe_remat`) —
+    True for full checkpointing of the forward, or a
+    ``jax.checkpoint_policies`` name for selective.
     """
     from elasticdl_tpu.training.precision import get_policy
 
     pol = get_policy(precision)
+    forward = make_remat_forward(module, remat)
 
     def grads_of(params, state, features, labels, rng):
         def loss_of(p):
@@ -186,9 +247,7 @@ def make_train_step(
                 features_c = pol.cast_to_compute(features)
             else:
                 features_c = features
-            output, new_state = apply_model(
-                module, p, state, features_c, training=True, rng=rng
-            )
+            output, new_state = forward(p, state, features_c, rng)
             if pol is not None:
                 output = pol.cast_output(output)
             loss = loss_fn(output, labels) + aux_loss_total(new_state)

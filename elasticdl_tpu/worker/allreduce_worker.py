@@ -63,6 +63,7 @@ class AllReduceWorker:
         checkpoint_dir="",
         checkpoint_steps=0,
         keep_checkpoint_max=0,
+        remat="",
     ):
         if job_type in (
             JobType.EVALUATION_ONLY,
@@ -135,10 +136,13 @@ class AllReduceWorker:
                 param_specs = module["param_shardings"](
                     mesh, **params_dict
                 )
+        from elasticdl_tpu.training.step import parse_remat
+
         self.trainer = AllReduceTrainer(
             model, spec.loss, spec.optimizer(), mesh=mesh,
             param_specs=param_specs, seed=seed,
             accum_steps=accum_steps, precision=precision,
+            remat=parse_remat(remat),
         )
         self._forward_fn = None
         self._model = model

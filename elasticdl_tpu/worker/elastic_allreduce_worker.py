@@ -84,6 +84,7 @@ class ElasticAllReduceWorker:
         accum_steps=1,
         checkpoint_filename_for_init="",
         prediction_outputs_processor="PredictionOutputsProcessor",
+        remat="",
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -236,6 +237,8 @@ class ElasticAllReduceWorker:
                     "assembles eval params from sharded checkpoints; "
                     "set --checkpoint_dir and --checkpoint_steps"
                 )
+        from elasticdl_tpu.training.step import parse_remat
+
         self.trainer = ElasticDPTrainer(
             spec.model,
             spec.loss,
@@ -244,6 +247,7 @@ class ElasticAllReduceWorker:
             precision=precision,
             accum_steps=accum_steps,
             distributed_builder=builder,
+            remat=parse_remat(remat),
         )
         self._task_data_service = TaskDataService(
             self,

@@ -77,6 +77,7 @@ def _run(args):
             prediction_outputs_processor=args.prediction_outputs_processor,
             precision=args.precision_policy or None,
             accum_steps=args.grad_accum_steps,
+            remat=args.remat,
         ).run()
         return 0
 

@@ -150,3 +150,108 @@ class TestPrecisionPolicy:
         assert int(ts.version) == 1
         for leaf in jax.tree_util.tree_leaves(ts.params):
             assert leaf.dtype == jnp.float32
+
+
+def test_remat_step_matches_plain():
+    """Full and policy-based rematerialization must be numerically
+    identical to the plain step (same forward math, just recomputed in
+    the backward), on both the plain and elastic step builders."""
+    import flax.linen as nn
+    import jax
+    import optax
+
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+    from elasticdl_tpu.training.step import (
+        TrainState,
+        make_train_step,
+        parse_remat,
+    )
+
+    assert parse_remat("") is False
+    assert parse_remat("full") is True
+    assert parse_remat("dots_saveable") == "dots_saveable"
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, inputs, training=False):
+            x = inputs["x"]
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(4)(x)
+
+    def loss_fn(output, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            output, labels.reshape(-1)
+        ).mean()
+
+    model = MLP()
+    rng = np.random.default_rng(0)
+    features = {"x": rng.random((8, 16), dtype=np.float32)}
+    labels = rng.integers(0, 4, size=(8, 1)).astype(np.int32)
+    variables = init_variables(
+        model, jax.random.PRNGKey(0), {"x": features["x"][:1]}
+    )
+    params, state = split_variables(variables)
+    opt = optax.sgd(0.1)
+    key = jax.random.PRNGKey(1)
+
+    def run(remat):
+        ts = TrainState.create(
+            jax.tree_util.tree_map(np.array, params), state, opt
+        )
+        step = make_train_step(model, loss_fn, opt, remat=remat)
+        losses = []
+        for _ in range(3):
+            ts, loss = step(ts, features, labels, key)
+            losses.append(float(loss))
+        return losses, jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, ts.params)
+        )
+
+    base_losses, base_params = run(False)
+    for remat in (True, "dots_saveable"):
+        losses, leaves = run(remat)
+        np.testing.assert_allclose(losses, base_losses, rtol=1e-6)
+        for a, b in zip(leaves, base_params):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        make_train_step(model, loss_fn, opt, remat="not_a_policy")(
+            TrainState.create(params, state, opt), features, labels, key
+        )
+
+    # elastic plane: remat step equals its own non-remat step
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from elasticdl_tpu.parallel.elastic import (
+        broadcast_from_device0,
+        host_copy,
+        make_elastic_train_step,
+    )
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def put(tree, spec):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree
+        )
+
+    g_feat = put(features, P("data"))
+    g_lab = put(labels, P("data"))
+    ones = put(np.ones(8, np.float32), P("data"))
+    outs = []
+    for remat in (False, True):
+        ts = broadcast_from_device0(
+            mesh, host_copy(TrainState.create(params, state, opt))
+        )
+        estep = make_elastic_train_step(
+            model, loss_fn, opt, mesh, remat=remat
+        )
+        with mesh:
+            ts, loss, n = estep(ts, g_feat, g_lab, ones, key)
+        outs.append((float(host_copy(loss)), host_copy(ts.params)))
+    np.testing.assert_allclose(outs[1][0], outs[0][0], rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[1][1]),
+        jax.tree_util.tree_leaves(outs[0][1]),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
