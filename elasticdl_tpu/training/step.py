@@ -175,10 +175,14 @@ def make_remat_forward(module, remat):
     may be True (full ``jax.checkpoint``) or a string naming a
     jax.checkpoint_policies policy (e.g.
     "dots_with_no_batch_dims_saveable" keeps matmul outputs and
-    recomputes the cheap elementwise ops only). ``prevent_cse=False``:
-    the wrapped forward is only ever differentiated under jit (and the
-    grad-accumulation ``lax.scan``), where the CSE workaround barriers
-    are unnecessary and cost step time.
+    recomputes the cheap elementwise ops only).
+
+    ``prevent_cse`` stays at jax's default (True). The docs suggest
+    False under jit/scan to skip the CSE-workaround barriers, but on
+    the v5e toolchain it was MEASURED to crash the TPU compiler on a
+    24-layer rematerialized graph (335M @ L=8192: internal compiler
+    error with False, compiles and trains with True) — correctness over
+    a theoretical barrier saving.
     """
     import jax
 
@@ -190,14 +194,14 @@ def make_remat_forward(module, remat):
     if not remat:
         return forward
     if remat is True:
-        return jax.checkpoint(forward, prevent_cse=False)
+        return jax.checkpoint(forward)
     policy = getattr(jax.checkpoint_policies, str(remat), None)
     if policy is None:
         raise ValueError(
             "unknown remat policy %r (see jax.checkpoint_policies)"
             % (remat,)
         )
-    return jax.checkpoint(forward, prevent_cse=False, policy=policy)
+    return jax.checkpoint(forward, policy=policy)
 
 
 def make_train_step(
