@@ -29,6 +29,7 @@ class LocalInstanceManager:
         max_relaunches=3,
         env=None,
         membership=None,
+        log_dir=None,
     ):
         """``worker_command(worker_id) -> argv``; ``ps_command(ps_id) ->
         argv``. Worker ids grow monotonically across relaunches like the
@@ -48,9 +49,11 @@ class LocalInstanceManager:
         self._restart_policy = restart_policy
         self._max_relaunches = max_relaunches
         self._env = env
+        self._log_dir = log_dir  # per-instance output files (tests/debug)
 
         self._lock = threading.Lock()
         self._procs = {}  # instance key -> Popen
+        self.exit_codes = {}  # instance key -> last observed returncode
         self._next_worker_id = 0
         self._relaunches = 0
         self._stopping = False
@@ -58,7 +61,19 @@ class LocalInstanceManager:
         self.status = InstanceManagerStatus.PENDING
 
     def _spawn(self, key, argv):
-        proc = subprocess.Popen(argv, env=self._env)
+        if self._log_dir:
+            import os
+
+            os.makedirs(self._log_dir, exist_ok=True)
+            out = open(
+                os.path.join(self._log_dir, "%s-%s.log" % key), "ab"
+            )
+            proc = subprocess.Popen(
+                argv, env=self._env, stdout=out, stderr=out
+            )
+            out.close()  # the child holds its own fd
+        else:
+            proc = subprocess.Popen(argv, env=self._env)
         with self._lock:
             self._procs[key] = proc
         watcher = threading.Thread(
@@ -89,6 +104,7 @@ class LocalInstanceManager:
     def _watch(self, key, proc):
         returncode = proc.wait()
         with self._lock:
+            self.exit_codes[key] = returncode
             if self._procs.get(key) is not proc or self._stopping:
                 return
             del self._procs[key]
@@ -101,6 +117,24 @@ class LocalInstanceManager:
                 self._membership.remove(instance_id)
             if returncode == 0:
                 logger.info("Worker %d completed", instance_id)
+                return
+            if returncode == 75:  # EX_TEMPFAIL: graceful preemption drain
+                # benign: does NOT consume the crash-relaunch budget —
+                # a spot fleet drains repeatedly and each drain is fine
+                if self._restart_policy != "Never":
+                    new_id = self._start_worker()
+                    logger.info(
+                        "Worker %d drained under a preemption notice; "
+                        "relaunched replacement as id %d",
+                        instance_id,
+                        new_id,
+                    )
+                else:
+                    logger.info(
+                        "Worker %d drained under a preemption notice "
+                        "(restart policy Never: no replacement)",
+                        instance_id,
+                    )
                 return
             logger.warning(
                 "Worker %d exited with %d; recovering tasks",
@@ -132,6 +166,15 @@ class LocalInstanceManager:
             proc = self._procs.get(("worker", worker_id))
         if proc:
             proc.kill()
+
+    def terminate_worker(self, worker_id):
+        """Deliver a preemption notice (SIGTERM): the elastic worker
+        drains gracefully — checkpoint, clean world leave, exit 75 —
+        and the watch loop relaunches a replacement."""
+        with self._lock:
+            proc = self._procs.get(("worker", worker_id))
+        if proc:
+            proc.terminate()
 
     def live_workers(self):
         with self._lock:

@@ -110,6 +110,7 @@ class MembershipService:
         self._fencer = None
         self._formed = set()  # members seen training in the current epoch
         self._lobby = {}  # joiners parked while a formation is in flight
+        self._departing = set()  # drained members: never re-register
 
     def set_fencer(self, fencer):
         """``fencer(worker_id)`` forcibly terminates a dropped member.
@@ -164,6 +165,12 @@ class MembershipService:
 
     def register(self, worker_id, host="localhost"):
         with self._lock:
+            if worker_id in self._departing:
+                # a draining member keeps polling get_comm_world while it
+                # waits to observe its own departure bump; re-registering
+                # it (or parking it in the lobby) would re-grow the world
+                # it is leaving
+                return
             if (
                 self._live.get(worker_id) == host
                 or self._lobby.get(worker_id) == host
@@ -186,8 +193,15 @@ class MembershipService:
                 self._live[worker_id] = host
                 self._bump_locked()
 
-    def remove(self, worker_id):
+    def remove(self, worker_id, departing=False):
+        """Drop a member and bump. ``departing=True`` is the graceful
+        drain verb (worker-initiated, BEFORE its process exits): the id
+        is additionally blacklisted from re-registration, because the
+        draining worker keeps polling until it observes the bump — the
+        poll-and-register semantics would otherwise re-add it."""
         with self._lock:
+            if departing:
+                self._departing.add(worker_id)
             self._lobby.pop(worker_id, None)
             if worker_id not in self._live:
                 return

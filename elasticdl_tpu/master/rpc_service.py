@@ -123,10 +123,21 @@ class MasterRpcService:
             awaiting=req.get("awaiting", True),
         )
 
+    def leave_comm_world(self, req):
+        """Graceful drain announcement (preemption notice): bump the
+        epoch NOW, before the worker's process exits, so the whole world
+        pauses at the same batch boundary and no collective breaks."""
+        if self._membership is not None:
+            self._membership.remove(
+                req.get("worker_id", -1), departing=True
+            )
+        return {}
+
     def rpc_methods(self):
         return {
             "get_task": self.get_task,
             "get_comm_world": self.get_comm_world,
+            "leave_comm_world": self.leave_comm_world,
             "get_model": self.get_model,
             "report_variable": self.report_variable,
             "report_gradient": self.report_gradient,
@@ -242,6 +253,11 @@ class MasterClient:
             worker_id=int(worker_id),
             host=host,
             awaiting=awaiting,
+        )
+
+    def leave_comm_world(self, worker_id):
+        return self._client.call(
+            "leave_comm_world", worker_id=int(worker_id)
         )
 
     def close(self):

@@ -244,8 +244,12 @@ def make_elastic_train_step(
     state_specs=None,
     remat=False,
 ):
-    """Weighted lockstep step: ``(ts, features, labels, weights, rng) ->
-    (ts', loss, n_active)``.
+    """Weighted lockstep step: ``(ts, features, labels, weights, epochs,
+    rng) -> (ts', loss, n_active, epoch_consensus)``.
+
+    ``epochs`` is a global (n_devices,) int32 of each process's
+    last-polled membership epoch; ``epoch_consensus`` is its in-step
+    pmax — the skew-proof pause signal (see the per_device comment).
 
     ``weights`` is a global (n_devices,) 0/1 array — per-device
     participation. The local loss is scaled by ``w / psum(w)`` INSIDE the
@@ -290,8 +294,17 @@ def make_elastic_train_step(
     def _is_sharded(spec):
         return spec is not None and any(a is not None for a in spec)
 
-    def per_device(ts, features, labels, weights, rng):
+    def per_device(ts, features, labels, weights, epochs, rng):
         w = weights[0].astype(jnp.float32)
+        # membership-epoch consensus rides the step: each process feeds
+        # the epoch it last polled, the pmax tells EVERY member (at the
+        # same step index — it is the same collective) the newest epoch
+        # any member has seen. Pausing on this consensus at aligned sync
+        # indices is skew-proof: polled-epoch observation happens at
+        # different host iterations once deferred sync lets hosts run
+        # ahead, and a member pausing early strands peers' in-flight
+        # dispatched steps on a vanished rank.
+        epoch_seen = jax.lax.pmax(epochs[0], axis)
         # decorrelate stochastic layers (dropout) across the batch shards
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
         # liveness (how many devices carried data) is separate from the
@@ -380,7 +393,7 @@ def make_elastic_train_step(
             opt_state=jax.tree_util.tree_map(select, opt_state, ts.opt_state),
             version=ts.version + live.astype(jnp.int32),
         )
-        return new_ts, loss, n
+        return new_ts, loss, n, epoch_seen
 
     if state_specs is None:
         ts_spec = P()
@@ -389,8 +402,8 @@ def make_elastic_train_step(
     sharded = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(ts_spec, P(axis), P(axis), P(axis), P()),
-        out_specs=(ts_spec, P(), P()),
+        in_specs=(ts_spec, P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(ts_spec, P(), P(), P()),
         check_rep=False,
     )
     # no donation: the pre-step state must survive a failed collective so
@@ -443,6 +456,7 @@ class ElasticDPTrainer:
         self._step_fn = None
         self._host_step = 0
         self._last_local = None  # (features, labels) for weight-0 steps
+        self.epoch_consensus = None  # newest epoch any member has seen
 
     @property
     def mesh(self):
@@ -715,10 +729,17 @@ class ElasticDPTrainer:
         chunk = jax.local_device_count() * self._accum_steps
         return -(-minibatch_size // chunk) * chunk
 
-    def train_step(self, features, labels, minibatch_size, sync=True):
+    def train_step(
+        self, features, labels, minibatch_size, sync=True, epoch_hint=0
+    ):
         """One weighted lockstep step; ``features=None`` participates at
         weight 0 (drain mode). Returns (loss, n_active_devices, count)
         where count is this process's true (unpadded) contribution.
+
+        ``epoch_hint`` is this process's last-polled membership epoch;
+        the step pmax-es it across members and ``epoch_consensus`` (set
+        at sync) exposes the newest epoch ANY member has seen — the
+        skew-proof reform/pause trigger.
 
         ``sync=False`` skips the device->host fetch and returns
         (None, None, count): dispatch stays asynchronous, so the host
@@ -758,13 +779,18 @@ class ElasticDPTrainer:
             w_local,
             (self._mesh.devices.size,),
         )
+        g_epochs = jax.make_array_from_process_local_data(
+            NamedSharding(self._mesh, P("data")),
+            np.full((n_local,), int(epoch_hint), dtype=np.int32),
+            (self._mesh.devices.size,),
+        )
         self._host_step += 1
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self._seed), self._host_step
         )
         with self._mesh:
-            new_ts, loss, n = self._step_fn(
-                self._ts, g_features, g_labels, g_weights, rng
+            new_ts, loss, n, epoch_seen = self._step_fn(
+                self._ts, g_features, g_labels, g_weights, g_epochs, rng
             )
         self._ts = new_ts
         if not sync:
@@ -773,6 +799,7 @@ class ElasticDPTrainer:
         # completed; checkpoint that state as the re-form fallback
         loss_v = float(host_copy(loss))
         n_v = int(host_copy(n))
+        self.epoch_consensus = int(host_copy(epoch_seen))
         self._checked_ts = new_ts
         return loss_v, n_v, count
 

@@ -292,6 +292,51 @@ class ElasticAllReduceWorker:
         self._eval_params = None
         self._eval_scored_version = None  # version params actually carry
         self._overflow_alarmed = 0
+        self._preempted = False
+        self._drain_announced = False
+        self._drain_deadline = 0.0
+
+    # -- graceful preemption ------------------------------------------------
+
+    # distinct from 0 ("done, don't replace me") and from crash codes:
+    # the instance manager relaunches a replacement for this exit
+    PREEMPTED_EXIT_CODE = 75  # EX_TEMPFAIL
+
+    def request_drain(self, *_signal_args):
+        """SIGTERM handler hook: drain gracefully at the next batch
+        boundary instead of dying mid-collective.
+
+        Cloud preemptions deliver SIGTERM with notice (k8s
+        terminationGracePeriod, TPU-VM maintenance events). A drained
+        worker flushes its sync window, checkpoints (sharded plane),
+        reports its records, and LEAVES the world cleanly — so the
+        survivors observe an ordinary membership epoch at a batch
+        boundary rather than a broken collective + failed-step recovery,
+        and no work is lost at all."""
+        self._preempted = True
+        logger.info(
+            "preemption notice received; draining at the next batch "
+            "boundary"
+        )
+
+    def enable_drain_on_sigterm(self):
+        """Install the SIGTERM -> request_drain handler, and keep it
+        installed: ``jax.distributed.initialize`` registers XLA's own
+        C++ preemption notifier for SIGTERM (preemption_notifier.cc),
+        silently REPLACING any Python handler registered before it — so
+        the worker re-installs after every establish (see _run)."""
+        self._drain_signal_enabled = True
+        self._install_drain_handler()
+
+    def _install_drain_handler(self):
+        if not getattr(self, "_drain_signal_enabled", False):
+            return
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return  # in-process test workers: signals stay with the host
+        signal.signal(signal.SIGTERM, self.request_drain)
 
     @staticmethod
     def _zoo_wants_sharded_params(zoo_module, model_params):
@@ -407,6 +452,8 @@ class ElasticAllReduceWorker:
         (every process drained and the master stopped handing out work).
         """
         while True:
+            if self._preempted:
+                return None  # drain notice while between worlds
             w = self._stub.get_comm_world(
                 self._worker_id, self._host, awaiting=True
             )
@@ -506,12 +553,23 @@ class ElasticAllReduceWorker:
                 logger.warning(
                     "world %d broke during formation; re-polling", world.epoch
                 )
+                # the failed initialize may still have displaced the
+                # drain handler — take it back before re-polling
+                self._install_drain_handler()
                 continue
+            # jax.distributed.initialize (inside establish) installs
+            # XLA's own SIGTERM notifier, displacing the drain handler —
+            # take it back so preemption notices reach request_drain
+            self._install_drain_handler()
             from elasticdl_tpu.utils.profiling import maybe_start_trace
 
             maybe_start_trace()  # safe only now: the backend is world-aware
             outcome = self._train_epoch(world, losses)
-            if outcome == "done":
+            if outcome in ("done", "preempted"):
+                break
+            if self._preempted:
+                # the announced drain exits through the ordinary reform
+                # pause ("reform"); a drained worker must not re-join
                 break
         self._finalize()
         return losses
@@ -538,6 +596,8 @@ class ElasticAllReduceWorker:
         """Block until the first local batch is in hand (its shapes gate
         world membership — a shapeless process can't hold a mesh slot)."""
         while True:
+            if self._preempted:
+                return None
             batch = self._next_batch()
             if batch is not None:
                 return batch
@@ -555,40 +615,84 @@ class ElasticAllReduceWorker:
         for count in pending:
             self._task_data_service.report_record_done(count, err_msg)
 
+    def _settle_and_leave(self, verdict, validate=True):
+        """The leave epilogue every pause path shares: settle the sync
+        window (validated steps report done, a failed window
+        fail-reports + requeues), checkpoint the sharded plane, close
+        any open trace, and leave the world."""
+        ok = self.trainer.validate() if validate else False
+        self._flush_unreported(
+            "" if ok else "collective failed before validation"
+        )
+        if ok and self.trainer.is_sharded:
+            # a checkpoint written at the pause point makes the
+            # re-form's restore lossless (all members pause at the same
+            # version, so no rank's manifest is torn)
+            self._save_ckpt_if_newer()
+        from elasticdl_tpu.utils.profiling import maybe_stop_trace
+
+        maybe_stop_trace()  # the trace must not outlive its world
+        self.trainer.leave()
+        return verdict
+
     def _train_epoch(self, world, losses):
         step_i = 0
         while True:
+            if self._preempted and not self._drain_announced:
+                # graceful drain rides the ORDINARY reform protocol:
+                # announce the departure so the master bumps the epoch
+                # now, then KEEP STEPPING — every member (this one
+                # included) observes the bump at the same lockstep
+                # iteration and pauses at the batch boundary, so no
+                # collective is ever left hanging on a vanished rank.
+                # Leaving immediately instead would strand survivors'
+                # in-flight steps and send them down the failed-step
+                # recovery path this drain exists to avoid.
+                self._drain_announced = True
+                self._drain_deadline = (
+                    time.time() + self._epoch_poll_secs
+                )
+                try:
+                    self._stub.leave_comm_world(self._worker_id)
+                    logger.info(
+                        "drain announced; stepping until the world "
+                        "pauses"
+                    )
+                except Exception:
+                    logger.warning(
+                        "drain announcement failed; will hard-leave",
+                        exc_info=True,
+                    )
+            if (
+                self._drain_announced
+                and self._drain_deadline
+                and time.time() > self._drain_deadline
+            ):
+                # the announcement never landed (master unreachable?):
+                # settle what we can and leave anyway — survivors take
+                # the failure-recovery path, same as a hard kill
+                return self._settle_and_leave("preempted")
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                 self._evaluate_only()
             w = self._stub.get_comm_world(
                 self._worker_id, self._host, awaiting=False
             )
-            if w["epoch"] != world.epoch:
-                logger.info(
-                    "epoch bump %d -> %s; pausing at batch boundary",
-                    world.epoch,
-                    w["epoch"],
-                )
-                # settle the sync window before leaving: validated steps
-                # report done, a failed window fail-reports (requeue)
-                ok = self.trainer.validate()
-                self._flush_unreported(
-                    "" if ok else "collective failed before validation"
-                )
-                if ok and self.trainer.is_sharded:
-                    # graceful membership change: a checkpoint written
-                    # NOW usually makes the re-form's restore lossless.
-                    # Best-effort, not guaranteed: a peer that already
-                    # entered the next collective when the epoch bumped
-                    # takes the exception path without saving, leaving
-                    # this version torn — restore then falls back to the
-                    # last complete (cadence) checkpoint.
-                    self._save_ckpt_if_newer()
-                from elasticdl_tpu.utils.profiling import maybe_stop_trace
-
-                maybe_stop_trace()  # the trace must not outlive its world
-                self.trainer.leave()
-                return "reform"
+            if self._drain_announced and w["epoch"] != world.epoch:
+                # the drain bump IS visible: the consensus pause will
+                # land within one sync window — disarm the hard-leave
+                # fallback so a slow eval round or a long first-step
+                # compile cannot turn a clean drain into a broken
+                # collective
+                self._drain_deadline = 0.0
+            # NOTE: a polled epoch bump does NOT pause here. With
+            # deferred sync the hosts run ahead of the device unevenly,
+            # so members OBSERVE a bump at different host iterations; a
+            # member pausing at its observation point strands peers'
+            # already-dispatched steps on a vanished rank. Instead the
+            # polled epoch rides INTO the step (epoch_hint) and the
+            # in-step pmax consensus — read back at aligned sync indices,
+            # which are the same step for every member — triggers the
+            # pause below.
             batch = self._next_batch()
             step_i += 1
             # syncing (a device->host round trip) every step stalls the
@@ -605,12 +709,20 @@ class ElasticAllReduceWorker:
             try:
                 if batch is None:
                     loss, n_active, count = self.trainer.train_step(
-                        None, None, self._minibatch_size, sync=True
+                        None,
+                        None,
+                        self._minibatch_size,
+                        sync=True,
+                        epoch_hint=w["epoch"],
                     )
                 else:
                     features, labels = batch
                     loss, n_active, count = self.trainer.train_step(
-                        features, labels, self._minibatch_size, sync=sync
+                        features,
+                        labels,
+                        self._minibatch_size,
+                        sync=sync,
+                        epoch_hint=w["epoch"],
                     )
                     if loss is not None:
                         losses.append(loss)
@@ -624,13 +736,7 @@ class ElasticAllReduceWorker:
                 if batch is not None:
                     leaf = batch[1]
                     self._unreported.append(int(np.asarray(leaf).shape[0]))
-                self._flush_unreported(
-                    "collective failed before validation"
-                )
-                from elasticdl_tpu.utils.profiling import maybe_stop_trace
-
-                maybe_stop_trace()  # the trace must not outlive its world
-                self.trainer.leave()
+                self._settle_and_leave("reform", validate=False)
                 if not self._await_epoch_bump(world.epoch):
                     raise
                 return "reform"
@@ -639,6 +745,21 @@ class ElasticAllReduceWorker:
             if sync:
                 self._flush_unreported()
                 self._alarm_on_embedding_overflow()
+                consensus = self.trainer.epoch_consensus
+                if (
+                    aligned_sync
+                    and consensus is not None
+                    and consensus > world.epoch
+                ):
+                    # every member reads this SAME consensus value at
+                    # this SAME step index — the whole world pauses in
+                    # unison, no collective left hanging
+                    logger.info(
+                        "epoch bump %d -> %d; pausing at aligned sync",
+                        world.epoch,
+                        consensus,
+                    )
+                    return self._settle_and_leave("reform")
                 if (
                     self._ckpt is not None
                     and (
@@ -1167,6 +1288,16 @@ class ElasticAllReduceWorker:
             )
 
     def _finalize(self):
+        if self._preempted:
+            # drained under a preemption notice: land queued checkpoint
+            # writes and get out — taking MORE work (final eval rounds,
+            # the SAVE_MODEL task) on a dying node would strand it
+            self._drain_ckpt()
+            from elasticdl_tpu.parallel import distributed
+
+            if distributed.current_spec() is not None:
+                distributed.leave_world()
+            return
         if self.trainer.is_sharded and self.trainer._ts is not None:
             # every rank lands a final checkpoint so the export task (one
             # rank) and any resume see the finished state, not the last

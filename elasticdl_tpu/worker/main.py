@@ -54,7 +54,7 @@ def _run(args):
             ElasticAllReduceWorker,
         )
 
-        ElasticAllReduceWorker(
+        worker = ElasticAllReduceWorker(
             worker_id=args.worker_id,
             job_type=args.job_type,
             minibatch_size=args.minibatch_size,
@@ -78,7 +78,17 @@ def _run(args):
             precision=args.precision_policy or None,
             accum_steps=args.grad_accum_steps,
             remat=args.remat,
-        ).run()
+        )
+        # graceful preemption: cloud preemptions / pod evictions send
+        # SIGTERM with notice — drain at the next batch boundary
+        # (checkpoint + clean world leave) instead of dying
+        # mid-collective
+        worker.enable_drain_on_sigterm()
+        worker.run()
+        if worker._preempted:
+            # distinct exit code: the instance manager relaunches a
+            # replacement (exit 0 would read as "job done for me")
+            return ElasticAllReduceWorker.PREEMPTED_EXIT_CODE
         return 0
 
     warn_accum_unsupported(args, "the parameter-server worker")

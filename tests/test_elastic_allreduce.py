@@ -168,10 +168,11 @@ def test_weighted_step_matches_plain_and_drain_is_noop():
     g_lab = put(labels, P("data"))
     ones = put(np.ones(8, np.float32), P("data"))
     zeros = put(np.zeros(8, np.float32), P("data"))
+    ep = put(np.zeros(8, np.int32), P("data"))
     key = jax.random.PRNGKey(7)
 
     with mesh:
-        ts1, loss, n = step(ts, g_feat, g_lab, ones, key)
+        ts1, loss, n, _ = step(ts, g_feat, g_lab, ones, ep, key)
     assert int(n) == 8 and np.isfinite(float(loss))
     assert int(host_copy(ts1.version)) == 1
 
@@ -189,7 +190,7 @@ def test_weighted_step_matches_plain_and_drain_is_noop():
 
     # drain step: weight 0 everywhere is an exact no-op
     with mesh:
-        ts2, _, n0 = step(ts1, g_feat, g_lab, zeros, key)
+        ts2, _, n0, _ = step(ts1, g_feat, g_lab, zeros, ep, key)
     assert int(n0) == 0
     assert int(host_copy(ts2.version)) == 1
     for a, b in zip(
@@ -249,11 +250,12 @@ def test_weighted_step_with_accumulation_matches_plain():
 
     key = jax.random.PRNGKey(7)
     with mesh:
-        ts1, loss, n = step(
+        ts1, loss, n, _ = step(
             ts,
             put(features, P("data")),
             put(labels, P("data")),
             put(np.ones(8, np.float32), P("data")),
+            put(np.zeros(8, np.int32), P("data")),
             key,
         )
     assert int(n) == 8
@@ -540,6 +542,79 @@ def test_elastic_allreduce_survives_worker_kill(tmp_path):
     # 384*2 records / 64 records-per-task = 12 tasks)
     assert len(set(completed)) == 12
     manager.stop_relaunch_and_remove_all_pods()
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_graceful_preemption_drain(tmp_path):
+    """SIGTERM (a cloud preemption notice) must drain gracefully: the
+    worker flushes its window and LEAVES the world cleanly (exit 75,
+    EX_TEMPFAIL), survivors re-form without a broken collective, a
+    replacement launches, and every task completes."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    create_recordio_file(
+        384, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(data_dir)
+    )
+    log_dir = str(tmp_path / "logs")
+    master = _master_for(str(data_dir), num_workers=3, num_epochs=2)
+    completed = _count_successes(master.task_d)
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        3,
+        _worker_command_for(master),
+        env=_worker_env(),
+        membership=master.membership,
+        max_relaunches=10,
+        log_dir=log_dir,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+
+    deadline = time.time() + 240
+    while len(completed) < 2:
+        assert time.time() < deadline, "job made no progress"
+        assert runner.is_alive(), "master exited early"
+        time.sleep(0.5)
+    victims = manager.live_workers()
+    assert victims, "no live workers to terminate"
+    victim = victims[-1]
+    manager.terminate_worker(victim)
+
+    runner.join(timeout=420)
+    assert not runner.is_alive(), "master did not finish after the drain"
+    assert master.task_d.finished()
+    assert len(set(completed)) == 12
+    # the terminated worker exited through the graceful-drain path
+    assert manager.exit_codes.get(("worker", victim)) == 75, (
+        manager.exit_codes
+    )
+    manager.stop_relaunch_and_remove_all_pods()
+    # the drain's whole point: the victim announced and the world paused
+    # at a batch boundary — NO worker ever hit a broken collective (the
+    # SIGKILL rung, by contrast, exercises the failed-step path)
+    import glob as _glob
+
+    logs = {
+        path: open(path, "rb").read().decode("utf-8", "replace")
+        for path in _glob.glob(os.path.join(log_dir, "worker-*.log"))
+    }
+    victim_log = logs.get(os.path.join(log_dir, "worker-%d.log" % victim))
+    assert victim_log and "drain announced" in victim_log, (
+        "victim never announced its drain"
+    )
+    offenders = [
+        path
+        for path, text in logs.items()
+        if "collective step failed" in text
+    ]
+    assert not offenders, (
+        "graceful drain still broke a collective: %s" % offenders
+    )
 
 
 @pytest.mark.slow

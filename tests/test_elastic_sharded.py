@@ -88,12 +88,15 @@ def test_sharded_elastic_step_matches_dense_training():
     ones = jax.device_put(
         np.ones(8, np.float32), NamedSharding(mesh, P("data"))
     )
+    ep = jax.device_put(
+        np.zeros(8, np.int32), NamedSharding(mesh, P("data"))
+    )
     key = jax.random.PRNGKey(5)
     losses = []
     with mesh:
         for features, labels in batches:
-            ts, loss, n = step(
-                ts, put_batch(features), put_batch(labels), ones, key
+            ts, loss, n, _ = step(
+                ts, put_batch(features), put_batch(labels), ones, ep, key
             )
             assert int(n) == 8
             losses.append(float(loss))
@@ -138,10 +141,18 @@ def test_sharded_elastic_drain_is_exact_noop():
     zeros = jax.device_put(
         np.zeros(8, np.float32), NamedSharding(mesh, P("data"))
     )
+    ep = jax.device_put(
+        np.zeros(8, np.int32), NamedSharding(mesh, P("data"))
+    )
     key = jax.random.PRNGKey(3)
     with mesh:
-        ts2, _, n = step(
-            ts, put_batch(batches[0][0]), put_batch(batches[0][1]), zeros, key
+        ts2, _, n, _ = step(
+            ts,
+            put_batch(batches[0][0]),
+            put_batch(batches[0][1]),
+            zeros,
+            ep,
+            key,
         )
     assert int(n) == 0
     assert int(host_copy(ts2.version)) == int(host_copy(ts.version))
@@ -174,11 +185,14 @@ def test_sharded_elastic_partial_weights_downweight_dead_devices():
 
     w = np.array([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
     weights = jax.device_put(w, NamedSharding(mesh, P("data")))
+    ep = jax.device_put(
+        np.zeros(8, np.int32), NamedSharding(mesh, P("data"))
+    )
     key = jax.random.PRNGKey(4)
     with mesh:
         for features, labels in batches:
-            ts, loss, n = step(
-                ts, put_batch(features), put_batch(labels), weights, key
+            ts, loss, n, _ = step(
+                ts, put_batch(features), put_batch(labels), weights, ep, key
             )
             assert int(n) == 4
 

@@ -238,6 +238,7 @@ def test_remat_step_matches_plain():
     g_feat = put(features, P("data"))
     g_lab = put(labels, P("data"))
     ones = put(np.ones(8, np.float32), P("data"))
+    ep = put(np.zeros(8, np.int32), P("data"))
     outs = []
     for remat in (False, True):
         ts = broadcast_from_device0(
@@ -247,7 +248,7 @@ def test_remat_step_matches_plain():
             model, loss_fn, opt, mesh, remat=remat
         )
         with mesh:
-            ts, loss, n = estep(ts, g_feat, g_lab, ones, key)
+            ts, loss, n, _ = estep(ts, g_feat, g_lab, ones, ep, key)
         outs.append((float(host_copy(loss)), host_copy(ts.params)))
     np.testing.assert_allclose(outs[1][0], outs[0][0], rtol=1e-6)
     for a, b in zip(
