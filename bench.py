@@ -546,6 +546,9 @@ def main(argv=None):
             # must not publish under (or ratchet against) the 730M name
             + ("_730m" if large and not quick else "")
             + ("" if use_flash else "_noflash")
+            # toy-config runs must not compare against the production
+            # ratchet either (mirrors the --flash per-L metric naming)
+            + ("_quick" if quick else "")
         )
         _emit(
             metric,

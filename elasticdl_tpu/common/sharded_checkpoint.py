@@ -390,25 +390,33 @@ class ShardedCheckpointManager:
         checkpoint while a straggler rank is still filling the newest
         one, and a kill in that window would leave nothing restorable.
         "Complete" is ``expected_writers`` manifests when the worker told
-        us the world size (set_expected_writers), else — conservatively —
-        at least as many manifests as the eviction victim has (which also
-        bounds the hold after a world shrink, where old versions carry
-        more manifests than any new one ever will)."""
+        us the world size (set_expected_writers — both worker planes
+        call it at every (re-)establish, making it the authoritative
+        bar), else — conservatively — the max of ``jax.process_count()``
+        and the manifest counts across ALL kept versions. The victim's
+        own count would be too weak a bar: after a world GROW, a torn
+        newer version can already carry as many manifests as a complete
+        small-world victim, and evicting that victim would delete the
+        only restorable state. In a multi-process jax world the
+        process_count term closes the remaining tie (torn-new count ==
+        complete-old count == old world size); the max-across-kept term
+        can over-hold after a world SHRINK, but only until the next
+        establish refreshes expected_writers — a bounded disk cost, not
+        a correctness one."""
         kept = sorted(self.versions())
         while len(kept) > self._keep_max:
             victim_dir = self._dir_for(kept[0])
+            counts = {
+                v: self._manifest_count(self._dir_for(v)) for v in kept
+            }
             if self._expected_writers:
-                # the authoritative bar: after a world GROW, a newer
-                # version is only restorable once every CURRENT rank's
-                # manifest landed — the victim's (smaller) count must
-                # not lower it
+                # after a world GROW, a newer version is only restorable
+                # once every CURRENT rank's manifest landed — the
+                # victim's (smaller) count must not lower the bar
                 need = self._expected_writers
             else:
-                need = self._manifest_count(victim_dir)
-            if not any(
-                self._manifest_count(self._dir_for(v)) >= need
-                for v in kept[1:]
-            ):
+                need = max(jax.process_count(), *counts.values())
+            if not any(counts[v] >= need for v in kept[1:]):
                 # every newer version is still torn; deleting the victim
                 # would risk the last restorable state — hold until a
                 # newer one completes (the next save retries)

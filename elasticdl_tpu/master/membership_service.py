@@ -63,7 +63,7 @@ class MembershipService:
         expected_workers,
         base_port=0,
         form_grace_secs=30.0,
-        confirm_timeout_secs=15.0,
+        confirm_timeout_secs=None,
         stale_form_secs=None,
     ):
         """``base_port=0`` picks ephemeral ports (single-host jobs, where
@@ -86,14 +86,22 @@ class MembershipService:
         self._expected = max(1, expected_workers)
         self._base_port = base_port
         self._form_grace_secs = form_grace_secs
+        from elasticdl_tpu.parallel.distributed import (
+            world_init_timeout,
+        )
+
+        if confirm_timeout_secs is None:
+            # derived from the workers' initialize timeout so the
+            # init-timeout < fence-window invariant survives tuning:
+            # raising EDL_WORLD_INIT_TIMEOUT for a real multi-host pod
+            # (cold coordinator/DNS can exceed the 10s single-host
+            # default — see docs/distributed.md) widens the fence window
+            # with it instead of silently inverting the ordering
+            confirm_timeout_secs = world_init_timeout() + 5.0
         self._confirm_timeout = confirm_timeout_secs
         if stale_form_secs is None:
             # long enough for every member to burn a full initialize
             # timeout and re-poll (same knob the workers read)
-            from elasticdl_tpu.parallel.distributed import (
-                world_init_timeout,
-            )
-
             stale_form_secs = confirm_timeout_secs + world_init_timeout()
         self._stale_form_secs = stale_form_secs
         self._lock = threading.Lock()
@@ -188,6 +196,12 @@ class MembershipService:
                 # already took the ready spec in a stale initialize
                 # barrier. The joiner folds in at the next bump (formation
                 # completing, a death, or the staleness valve below).
+                # A member re-registering under a NEW host must not stay
+                # in _live under the old one while parked — that would be
+                # a double membership when the bump merges the lobby
+                # (unreachable today: relaunches get fresh ids; guarded
+                # in case id reuse is ever introduced).
+                self._live.pop(worker_id, None)
                 self._lobby[worker_id] = host
             else:
                 self._live[worker_id] = host

@@ -2,10 +2,11 @@
 
 Parity: reference master/tensorboard_service.py writes eval-metric dicts
 keyed by model version via ``tf.summary`` and spawns a ``tensorboard``
-subprocess (:27-45). Replaced-by: a dependency-free JSONL scalar log
-(``scalars.jsonl`` under ``logdir``) that any dashboard can tail; when the
-``tensorboard`` CLI is installed the same subprocess-spawning behavior is
-available via :meth:`start_tensorboard_service`.
+subprocess (:27-45). This service writes BOTH surfaces: real TensorBoard
+event files (``events.out.tfevents.*`` via common/tb_events.py — same
+on-disk format ``tf.summary`` produces, no TF dependency) so
+``tensorboard --logdir`` renders the eval curves, plus a JSONL scalar
+log (``scalars.jsonl``) any dashboard can tail without a TB parser.
 """
 
 import json
@@ -14,6 +15,7 @@ import subprocess
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.common.tb_events import EventFileWriter
 
 
 class TensorboardService:
@@ -23,6 +25,7 @@ class TensorboardService:
         os.makedirs(self._logdir, exist_ok=True)
         self._scalars_path = os.path.join(self._logdir, "scalars.jsonl")
         self._f = open(self._scalars_path, "a")
+        self._events = EventFileWriter(self._logdir)
         self.tb_process = None
 
     def write_dict_to_summary(self, dictionary, version):
@@ -32,8 +35,10 @@ class TensorboardService:
         matching the reference's summary naming.
         """
         now = time.time()
+        scalars = []
 
         def emit(tag, value):
+            scalars.append((tag, float(value)))
             self._f.write(
                 json.dumps(
                     {
@@ -53,6 +58,7 @@ class TensorboardService:
             else:
                 emit(key, value)
         self._f.flush()
+        self._events.add_scalars(scalars, version, wall_time=now)
 
     def start(self):
         """Spawn the tensorboard CLI if present (reference :34-45)."""
@@ -77,5 +83,6 @@ class TensorboardService:
 
     def close(self):
         self._f.close()
+        self._events.close()
         if self.tb_process is not None:
             self.tb_process.terminate()

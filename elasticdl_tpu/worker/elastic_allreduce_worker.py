@@ -911,18 +911,36 @@ class ElasticAllReduceWorker:
                     ).shape[0]
                 )
                 err_msg = ""
-                try:
-                    outputs = self._serving_forward(features)
-                    if self._prediction_outputs_processor is not None:
-                        self._prediction_outputs_processor.process(
-                            outputs, self._worker_id
+                # bounded retry before giving up (parity with the
+                # eval-only drain's 3 rounds): a transiently missing or
+                # torn checkpoint — e.g. a trainer still flushing async
+                # writes into a shared dir — resolves in seconds and must
+                # not fail the whole predict job
+                for attempt in range(3):
+                    err_msg = ""
+                    try:
+                        outputs = self._serving_forward(features)
+                        if (
+                            self._prediction_outputs_processor
+                            is not None
+                        ):
+                            self._prediction_outputs_processor.process(
+                                outputs, self._worker_id
+                            )
+                        break
+                    except RuntimeError as e:
+                        # e.g. no restorable checkpoint yet: retry, then
+                        # fail-report so the task requeues; the give-up
+                        # below keeps a dead checkpoint source from
+                        # spinning forever
+                        logger.warning(
+                            "prediction batch deferred (attempt %d): %s",
+                            attempt + 1,
+                            e,
                         )
-                except RuntimeError as e:
-                    # e.g. no restorable checkpoint: fail-report so the
-                    # task requeues; the give-up below keeps a dead
-                    # checkpoint source from spinning forever
-                    logger.warning("prediction batch deferred: %s", e)
-                    err_msg = str(e)
+                        err_msg = str(e)
+                        if attempt < 2:
+                            time.sleep(0.5)
                 self._task_data_service.report_record_done(
                     count, err_msg
                 )

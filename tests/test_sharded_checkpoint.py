@@ -163,6 +163,36 @@ def test_ring_eviction_holds_while_newer_version_is_torn(tmp_path):
     assert mgr2.versions() == [20]
 
 
+def test_eviction_fallback_grow_tie(tmp_path, monkeypatch):
+    """Reviewer-found tie: world grows 2->4 and set_expected_writers was
+    never called. A torn 4-world version with as many manifests as the
+    complete 2-world victim must not unlock eviction — in a multi-process
+    jax world the process_count term is the bar."""
+    import json
+
+    from elasticdl_tpu.common import sharded_checkpoint as sc
+
+    mgr = ShardedCheckpointManager(str(tmp_path), 10, keep_max=1)
+
+    def wm(version, pid):
+        d = mgr._dir_for(version)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "manifest-%d.json" % pid), "w") as f:
+            json.dump({"version": version, "leaves": {}}, f)
+
+    wm(10, 0)
+    wm(10, 1)  # complete under the old 2-process world
+    wm(20, 0)
+    wm(20, 1)  # torn: 2 of 4 manifests after the grow
+    monkeypatch.setattr(sc.jax, "process_count", lambda: 4)
+    mgr._evict()
+    assert mgr.versions() == [10, 20], "grow-tie evicted the only complete version"
+    wm(20, 2)
+    wm(20, 3)
+    mgr._evict()
+    assert mgr.versions() == [20]
+
+
 def test_trainer_sharded_checkpoint_roundtrip(tmp_path):
     """AllReduceTrainer with an HBM-sharded deepfm: save, mutate, restore
     — exact state recovery including co-sharded optimizer slots."""

@@ -1,0 +1,105 @@
+"""TensorBoard event-file writer: framing, CRC, and a render check with
+the real ``tensorboard`` reader when installed.
+
+Parity: reference master/tensorboard_service.py:27-45 writes eval
+metrics through tf.summary so ``tensorboard --logdir`` renders them; the
+rebuild writes the identical on-disk format without TF
+(common/tb_events.py)."""
+
+import pytest
+
+from elasticdl_tpu.common import tb_events
+
+
+def test_event_file_round_trip(tmp_path):
+    w = tb_events.EventFileWriter(str(tmp_path))
+    w.add_scalar("loss", 0.5, step=10, wall_time=123.0)
+    w.add_scalars(
+        [("accuracy", 0.75), ("auc", 0.9)], step=20, wall_time=124.0
+    )
+    w.close()
+
+    events = tb_events.read_events(w.path)
+    # first record is the file-version header (no scalars)
+    assert events[0][2] == []
+    assert events[1] == (123.0, 10, [("loss", pytest.approx(0.5))])
+    wall, step, scalars = events[2]
+    assert (wall, step) == (124.0, 20)
+    assert scalars == [
+        ("accuracy", pytest.approx(0.75)),
+        ("auc", pytest.approx(0.9)),
+    ]
+
+
+def test_crc_matches_known_vector():
+    # CRC-32C test vector (RFC 3720 B.4): "123456789" -> 0xE3069283
+    assert tb_events.crc32c(b"123456789") == 0xE3069283
+
+
+def test_torn_tail_tolerated(tmp_path):
+    w = tb_events.EventFileWriter(str(tmp_path))
+    w.add_scalar("loss", 1.0, step=1)
+    w.close()
+    with open(w.path, "ab") as f:
+        f.write(b"\x40\x00\x00")  # truncated next frame
+    events = tb_events.read_events(w.path)
+    assert len(events) == 2  # header + the complete scalar event
+
+
+def test_corrupt_record_detected(tmp_path):
+    w = tb_events.EventFileWriter(str(tmp_path))
+    w.add_scalar("loss", 1.0, step=1)
+    w.close()
+    with open(w.path, "r+b") as f:
+        f.seek(-6, 2)  # inside the last event's payload
+        f.write(b"\xff")
+    with pytest.raises(ValueError):
+        tb_events.read_events(w.path)
+
+
+def test_real_tensorboard_renders_the_file(tmp_path):
+    """The authoritative check: TensorBoard's own event loader (with its
+    CRC validation) reads our hand-framed file."""
+    accumulator = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_accumulator"
+    )
+    w = tb_events.EventFileWriter(str(tmp_path))
+    for step, loss in enumerate([0.9, 0.5, 0.25]):
+        w.add_scalar("eval/loss", loss, step=step)
+    w.close()
+
+    acc = accumulator.EventAccumulator(str(tmp_path))
+    acc.Reload()
+    assert "eval/loss" in acc.Tags()["scalars"]
+    points = acc.Scalars("eval/loss")
+    assert [p.step for p in points] == [0, 1, 2]
+    assert [p.value for p in points] == [
+        pytest.approx(0.9),
+        pytest.approx(0.5),
+        pytest.approx(0.25),
+    ]
+
+
+def test_tensorboard_service_writes_both_surfaces(tmp_path):
+    from elasticdl_tpu.master.tensorboard_service import (
+        TensorboardService,
+    )
+
+    svc = TensorboardService(str(tmp_path))
+    svc.write_dict_to_summary(
+        {"mnist": {"accuracy": 0.9}, "loss": 0.1}, version=7
+    )
+    svc.close()
+
+    jsonl = (tmp_path / "scalars.jsonl").read_text().splitlines()
+    assert len(jsonl) == 2
+
+    event_files = list(tmp_path.glob("events.out.tfevents.*"))
+    assert len(event_files) == 1
+    events = tb_events.read_events(str(event_files[0]))
+    _, step, scalars = events[-1]
+    assert step == 7
+    assert dict(scalars) == {
+        "mnist/accuracy": pytest.approx(0.9),
+        "loss": pytest.approx(0.1),
+    }
