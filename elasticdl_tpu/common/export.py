@@ -1,0 +1,291 @@
+"""Standard serving/export artifact: versioned, externally loadable.
+
+Parity: the reference's SAVE_MODEL task exports a tf SavedModel any
+serving stack can load (reference worker/worker.py:695-715,
+common/model_handler.py:108-141). The TPU-native equivalent is a
+directory artifact built from the two JAX-ecosystem standards:
+
+- ``params/`` — an **Orbax** checkpoint of the parameter pytree
+  (``orbax.checkpoint.StandardCheckpointer``), loadable by any JAX
+  stack without this framework.
+- ``serving_fn.jaxexport`` — optional: the model's inference forward
+  serialized with **jax.export** (StableHLO), batch-polymorphic and
+  multi-platform (cpu+tpu), so a fresh process can serve without the
+  model-zoo source at all: ``deserialize(blob).call(params, features)``.
+- ``model.chkpt`` — the framework's own tensor-frame codec (the file
+  ``--checkpoint_filename_for_init`` already accepts), kept so older
+  loaders keep working.
+- ``MANIFEST.json`` — format version, model version, leaf spec (name,
+  shape, dtype), provenance metadata (model_def/model_params), and the
+  artifact listing. The manifest is the stability contract: loaders
+  should dispatch on ``format``/``format_version``.
+
+Layout is documented in docs/export.md; :func:`load_export` is the
+reference loader and the fresh-process round trip is locked by
+tests/test_export.py.
+"""
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+EXPORT_FORMAT = "elasticdl-tpu-export"
+EXPORT_FORMAT_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+_PARAMS_DIR = "params"
+_SERVING_FILE = "serving_fn.jaxexport"
+_LEGACY_CHKPT = "model.chkpt"
+
+
+def is_export_dir(path):
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f).get("format") == EXPORT_FORMAT
+    except (OSError, ValueError):
+        return False
+
+
+def _leaf_spec(params):
+    import jax
+
+    spec = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        spec[name] = {
+            "shape": list(np.shape(leaf)),
+            "dtype": str(np.asarray(leaf).dtype),
+        }
+    return spec
+
+
+def _export_serving_fn(path, serving_fn, params, example_features):
+    """Serialize ``serving_fn(params, features)`` with a symbolic batch
+    dimension for cpu+tpu. Best-effort: a model whose forward cannot be
+    lowered for both platforms (e.g. a TPU-only Pallas kernel in the
+    auto-attention path) still exports params + manifest, it just ships
+    without the source-free serving plane; the manifest records which."""
+    import jax
+    from jax import export as jexport
+
+    try:
+        (batch,) = jexport.symbolic_shape("batch")
+
+        def feature_spec(leaf):
+            arr = np.asarray(leaf)
+            return jax.ShapeDtypeStruct(
+                (batch,) + arr.shape[1:], arr.dtype
+            )
+
+        features_spec = jax.tree_util.tree_map(
+            feature_spec, example_features
+        )
+        params_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                np.shape(a), np.asarray(a).dtype
+            ),
+            params,
+        )
+        exported = jexport.export(
+            jax.jit(serving_fn), platforms=("cpu", "tpu")
+        )(params_spec, features_spec)
+        blob = exported.serialize()
+    except Exception as e:  # noqa: BLE001 - optional plane, reported
+        logger.warning(
+            "serving-fn export skipped (params-only artifact): %s", e
+        )
+        return False
+    with open(path, "wb") as f:
+        f.write(blob)
+    return True
+
+
+def export_model(
+    export_dir,
+    params,
+    version,
+    metadata=None,
+    serving_fn=None,
+    example_features=None,
+):
+    """Write the full artifact; returns the manifest dict.
+
+    ``params`` is the model parameter pytree (host or device arrays).
+    ``serving_fn(params, features) -> outputs`` plus one
+    ``example_features`` batch enables the source-free serving plane.
+    """
+    import jax
+
+    from elasticdl_tpu.common.model_utils import save_checkpoint_to_file
+    from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+
+    export_dir = os.path.abspath(export_dir)
+    os.makedirs(export_dir, exist_ok=True)
+    params = jax.tree_util.tree_map(np.asarray, params)
+
+    import orbax.checkpoint as ocp
+
+    params_path = os.path.join(export_dir, _PARAMS_DIR)
+    ckptr = ocp.StandardCheckpointer()
+    # orbax refuses to overwrite; an export dir is written once per
+    # timestamped path but a retried SAVE_MODEL task may reuse one
+    ckptr.save(params_path, params, force=True)
+    ckptr.wait_until_finished()
+
+    save_checkpoint_to_file(
+        pytree_to_named_arrays(params),
+        version,
+        os.path.join(export_dir, _LEGACY_CHKPT),
+    )
+
+    has_serving = False
+    if serving_fn is not None and example_features is not None:
+        has_serving = _export_serving_fn(
+            os.path.join(export_dir, _SERVING_FILE),
+            serving_fn,
+            params,
+            example_features,
+        )
+
+    manifest = {
+        "format": EXPORT_FORMAT,
+        "format_version": EXPORT_FORMAT_VERSION,
+        "model_version": int(version),
+        "created_unix": int(time.time()),
+        "jax_version": jax.__version__,
+        "metadata": dict(metadata or {}),
+        "leaves": _leaf_spec(params),
+        "artifacts": {
+            "params": _PARAMS_DIR,
+            "legacy_checkpoint": _LEGACY_CHKPT,
+            "serving_fn": _SERVING_FILE if has_serving else None,
+        },
+    }
+    tmp = os.path.join(export_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    # manifest last + atomic: its presence marks a complete artifact
+    os.replace(tmp, os.path.join(export_dir, MANIFEST_NAME))
+    logger.info(
+        "exported model v%d to %s (serving_fn=%s)",
+        version,
+        export_dir,
+        has_serving,
+    )
+    return manifest
+
+
+def export_provenance(model_zoo, model_def, model_params):
+    """The manifest metadata every worker records: enough for a serving
+    process to rebuild the model without guessing flags."""
+    return {
+        "model_zoo": model_zoo,
+        "model_def": model_def,
+        "model_params": model_params or "",
+    }
+
+
+def example_batch_for_export(
+    dataset, dataset_fn, metadata, minibatch_size, mode
+):
+    """One prediction-mode batch from the SAVE_MODEL task's dataset: the
+    signature source for the serialized serving function (the reference
+    traces its SavedModel signature the same way, reference
+    worker/worker.py:695-715). None (params-only artifact) when the
+    shard is empty or the pipeline errors."""
+    if not dataset:
+        return None
+    try:
+        ds = dataset_fn(dataset, mode, metadata)
+        for features in ds.batch(max(1, minibatch_size)):
+            return features
+    except Exception as e:  # noqa: BLE001 - optional plane
+        logger.warning("no example batch for serving export: %s", e)
+    return None
+
+
+def make_serving_fn(model, state):
+    """Inference forward ``(params, features) -> output`` for export.
+
+    Mutable collections (e.g. batch-norm stats) are closed over and
+    baked into the serialized function as constants — exported models
+    carry no mutable state, matching the loader contract in
+    worker/elastic_allreduce_worker._load_eval_only_params."""
+    from elasticdl_tpu.training.step import apply_model
+
+    def serving_fn(params, features):
+        output, _ = apply_model(
+            model, params, state, features, training=False
+        )
+        return output
+
+    return serving_fn
+
+
+@dataclass
+class ExportedModel:
+    """A loaded export: ``params`` pytree + manifest; ``serve`` works
+    source-free when the artifact carries a serving function."""
+
+    export_dir: str
+    manifest: dict
+    params: object
+    _serving = None
+
+    @property
+    def version(self):
+        return self.manifest["model_version"]
+
+    @property
+    def metadata(self):
+        return self.manifest["metadata"]
+
+    def has_serving_fn(self):
+        return bool(self.manifest["artifacts"].get("serving_fn"))
+
+    def serve(self, features):
+        if not self.has_serving_fn():
+            raise RuntimeError(
+                "export at %s carries no serving function; rebuild the "
+                "model from metadata['model_def'] and apply params"
+                % self.export_dir
+            )
+        if self._serving is None:
+            from jax import export as jexport
+
+            with open(
+                os.path.join(
+                    self.export_dir,
+                    self.manifest["artifacts"]["serving_fn"],
+                ),
+                "rb",
+            ) as f:
+                self._serving = jexport.deserialize(f.read())
+        return self._serving.call(self.params, features)
+
+
+def load_export(export_dir):
+    """Load an export artifact written by :func:`export_model`."""
+    export_dir = os.path.abspath(export_dir)
+    with open(os.path.join(export_dir, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != EXPORT_FORMAT:
+        raise ValueError(
+            "%s is not an %s artifact" % (export_dir, EXPORT_FORMAT)
+        )
+    if manifest.get("format_version", 0) > EXPORT_FORMAT_VERSION:
+        raise ValueError(
+            "export format v%s is newer than this loader (v%d)"
+            % (manifest.get("format_version"), EXPORT_FORMAT_VERSION)
+        )
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(export_dir, _PARAMS_DIR))
+    return ExportedModel(
+        export_dir=export_dir, manifest=manifest, params=params
+    )

@@ -205,7 +205,19 @@ def save_checkpoint_to_file(named_arrays, version, file_path):
 
 
 def load_from_checkpoint_file(file_path):
-    """Returns (version, {name: ndarray})."""
+    """Returns (version, {name: ndarray}).
+
+    Also accepts a standard export-artifact directory (common/export.py):
+    its ``legacy_checkpoint`` member is this same codec, so every
+    init-from-checkpoint surface loads exports with no extra flag."""
+    if os.path.isdir(file_path):
+        candidate = os.path.join(file_path, "model.chkpt")
+        if not os.path.exists(candidate):
+            raise ValueError(
+                "%s is a directory without a model.chkpt (not an "
+                "elasticdl_tpu export artifact)" % file_path
+            )
+        file_path = candidate
     with open(file_path, "rb") as f:
         data = f.read()
     if data[:4] != _CKPT_MAGIC:

@@ -32,11 +32,7 @@ from elasticdl_tpu.common.constants import (
     TaskType,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
-from elasticdl_tpu.common.model_utils import (
-    get_model_spec,
-    save_checkpoint_to_file,
-)
-from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.parallel.trainer import AllReduceTrainer
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -146,6 +142,11 @@ class AllReduceWorker:
         )
         self._forward_fn = None
         self._model = model
+        from elasticdl_tpu.common.export import export_provenance
+
+        self._export_meta = export_provenance(
+            model_zoo, model_def, model_params
+        )
         self._evaluation_result = {}
         self._task_data_service = TaskDataService(
             self,
@@ -322,12 +323,31 @@ class AllReduceWorker:
         saved_model_path = os.path.join(
             saved_model_path, str(int(time.time()))
         )
-        os.makedirs(saved_model_path, exist_ok=True)
         ts = self.trainer.get_host_state()
-        save_checkpoint_to_file(
-            pytree_to_named_arrays(ts.params),
+        from elasticdl_tpu.common.export import (
+            example_batch_for_export,
+            export_model,
+            make_serving_fn,
+        )
+
+        example = example_batch_for_export(
+            dataset,
+            self._dataset_fn,
+            self._task_data_service.data_reader.metadata,
+            self._minibatch_size,
+            Mode.PREDICTION,
+        )
+        export_model(
+            saved_model_path,
+            ts.params,
             self.trainer.version,
-            os.path.join(saved_model_path, "model.chkpt"),
+            metadata=self._export_meta,
+            serving_fn=(
+                make_serving_fn(self._model, ts.state)
+                if example is not None
+                else None
+            ),
+            example_features=example,
         )
         logger.info("Exported model to %s", saved_model_path)
         self.report_task_result(task_id=task.task_id, err_msg="")

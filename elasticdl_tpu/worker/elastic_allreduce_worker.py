@@ -48,11 +48,7 @@ from elasticdl_tpu.common.constants import (
     TaskExecCounterKey,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
-from elasticdl_tpu.common.model_utils import (
-    get_model_spec,
-    save_checkpoint_to_file,
-)
-from elasticdl_tpu.common.tensor import pytree_to_named_arrays
+from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.parallel.distributed import WorldSpec, WorldBroken
 from elasticdl_tpu.parallel.elastic import ElasticDPTrainer
 from elasticdl_tpu.worker.task_data_service import TaskDataService
@@ -115,6 +111,11 @@ class ElasticAllReduceWorker:
         self._dataset_fn = spec.dataset_fn
         self._model = spec.model
         self._eval_metrics_fn = spec.eval_metrics_fn
+        from elasticdl_tpu.common.export import export_provenance
+
+        self._export_meta = export_provenance(
+            model_zoo, model_def, model_params
+        )
         from elasticdl_tpu.common.model_utils import (
             get_module_file_path,
             load_module,
@@ -911,22 +912,19 @@ class ElasticAllReduceWorker:
                     ).shape[0]
                 )
                 err_msg = ""
+                outputs = None
                 # bounded retry before giving up (parity with the
                 # eval-only drain's 3 rounds): a transiently missing or
                 # torn checkpoint — e.g. a trainer still flushing async
                 # writes into a shared dir — resolves in seconds and must
-                # not fail the whole predict job
+                # not fail the whole predict job. Only the FORWARD
+                # retries; the user's outputs processor runs once (a
+                # replay would duplicate records already written to its
+                # sink)
                 for attempt in range(3):
                     err_msg = ""
                     try:
                         outputs = self._serving_forward(features)
-                        if (
-                            self._prediction_outputs_processor
-                            is not None
-                        ):
-                            self._prediction_outputs_processor.process(
-                                outputs, self._worker_id
-                            )
                         break
                     except RuntimeError as e:
                         # e.g. no restorable checkpoint yet: retry, then
@@ -941,6 +939,13 @@ class ElasticAllReduceWorker:
                         err_msg = str(e)
                         if attempt < 2:
                             time.sleep(0.5)
+                if (
+                    not err_msg
+                    and self._prediction_outputs_processor is not None
+                ):
+                    self._prediction_outputs_processor.process(
+                        outputs, self._worker_id
+                    )
                 self._task_data_service.report_record_done(
                     count, err_msg
                 )
@@ -1000,6 +1005,8 @@ class ElasticAllReduceWorker:
                 split_variables,
             )
 
+            # accepts a .chkpt file or an export-artifact directory
+            # (load_from_checkpoint_file resolves both)
             version, named = load_from_checkpoint_file(
                 self._init_ckpt_file
             )
@@ -1207,7 +1214,7 @@ class ElasticAllReduceWorker:
     def _process_save_model_task_if_needed(self):
         (
             task,
-            _dataset,
+            dataset,
         ) = self._task_data_service.get_save_model_task_and_dataset()
         if task is None:
             return
@@ -1215,13 +1222,20 @@ class ElasticAllReduceWorker:
             SaveModelConfig.SAVED_MODEL_PATH, "/tmp/edl_saved_model"
         )
         if self.trainer.is_sharded:
-            named, version = self._assemble_sharded_export()
-            if named is None:
+            params, state, version = self._assemble_sharded_export()
+            if params is None:
                 self.report_task_result(
                     task.task_id,
                     err_msg="no complete sharded checkpoint to export",
                 )
                 return
+            # serving plane traces the host twin (dense lookups, same
+            # param structure the sharded checkpoint assembles to)
+            model = (
+                self._host_model_factory()
+                if self._host_model_factory is not None
+                else None
+            )
         else:
             host_ts = self.trainer.snapshot()
             if host_ts is None:
@@ -1231,16 +1245,37 @@ class ElasticAllReduceWorker:
                     task.task_id, err_msg="no local train state to export"
                 )
                 return
-            named = pytree_to_named_arrays(host_ts.params)
+            params = host_ts.params
+            state = host_ts.state
             version = max(0, int(np.asarray(host_ts.version)))
+            model = self._model
         saved_model_path = os.path.join(
             saved_model_path, str(int(time.time()))
         )
-        os.makedirs(saved_model_path, exist_ok=True)
-        save_checkpoint_to_file(
-            named,
+        from elasticdl_tpu.common.export import (
+            example_batch_for_export,
+            export_model,
+            make_serving_fn,
+        )
+
+        example = example_batch_for_export(
+            dataset,
+            self._dataset_fn,
+            self._task_data_service.data_reader.metadata,
+            self._minibatch_size,
+            Mode.PREDICTION,
+        )
+        export_model(
+            saved_model_path,
+            params,
             version,
-            os.path.join(saved_model_path, "model.chkpt"),
+            metadata=self._export_meta,
+            serving_fn=(
+                make_serving_fn(model, state)
+                if model is not None and example is not None
+                else None
+            ),
+            example_features=example,
         )
         logger.info("Exported model to %s", saved_model_path)
         self.report_task_result(task_id=task.task_id, err_msg="")
@@ -1258,12 +1293,12 @@ class ElasticAllReduceWorker:
 
         directory = self._latest_ckpt_dir()
         if directory is None:
-            return None, 0
+            return None, None, 0
         last_err = None
         for attempt in range(10):
             try:
                 version, tree = load_sharded_to_host(directory)
-                return pytree_to_named_arrays(tree["params"]), version
+                return tree["params"], tree.get("state") or {}, version
             except Exception as e:  # noqa: BLE001 - retried, then logged
                 last_err = e
                 time.sleep(1.0)
@@ -1276,10 +1311,10 @@ class ElasticAllReduceWorker:
         for older in self._ckpt.dirs_newest_first()[1:]:
             try:
                 v, tree = load_sharded_to_host(older)
-                return pytree_to_named_arrays(tree["params"]), v
+                return tree["params"], tree.get("state") or {}, v
             except Exception:
                 continue
-        return None, 0
+        return None, None, 0
 
     def _save_ckpt_if_newer(self):
         """Checkpoint the current state if its version advanced past the

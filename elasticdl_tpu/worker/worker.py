@@ -36,10 +36,7 @@ from elasticdl_tpu.common.constants import (
     TaskType,
 )
 from elasticdl_tpu.common.log_utils import default_logger as logger
-from elasticdl_tpu.common.model_utils import (
-    get_model_spec,
-    save_checkpoint_to_file,
-)
+from elasticdl_tpu.common.model_utils import get_model_spec
 from elasticdl_tpu.common.tensor import (
     Tensor,
     named_arrays_to_pytree,
@@ -108,6 +105,11 @@ class Worker:
         )
         self._model = spec.model
         self._dataset_fn = spec.dataset_fn
+        from elasticdl_tpu.common.export import export_provenance
+
+        self._export_meta = export_provenance(
+            model_zoo, model_def, model_params
+        )
         self._loss = spec.loss
         self._opt_fn = spec.optimizer
         self._eval_metrics_fn = spec.eval_metrics_fn
@@ -556,17 +558,42 @@ class Worker:
             saved_model_path, str(int(time.time()))
         )
         logger.info("The path to export model is %s" % saved_model_path)
-        # Export = latest master parameters + the tensor-codec checkpoint.
-        # (Replaces the reference's tf.saved_model.save, worker.py:695-715;
-        # serving loads the checkpoint into the same flax module.)
+        # Export = latest master parameters as the standard artifact
+        # (common/export.py: orbax params + manifest + legacy codec +,
+        # for dense models, a serialized serving forward). Replaces the
+        # reference's tf.saved_model.save (reference worker.py:695-715).
         self.get_model(
             max(self._model_version, 0), GetModelMethod.MINIMUM
         )
-        os.makedirs(saved_model_path, exist_ok=True)
-        save_checkpoint_to_file(
-            pytree_to_named_arrays(self._params),
+        from elasticdl_tpu.common.export import (
+            example_batch_for_export,
+            export_model,
+            make_serving_fn,
+        )
+
+        example = None
+        if not self._embedding_dims:
+            # elastic-embedding forwards leave the graph for their KV
+            # lookup (host callback) — not serializable; dense models
+            # ship the source-free serving plane
+            example = example_batch_for_export(
+                dataset,
+                self._dataset_fn,
+                self._task_data_service.data_reader.metadata,
+                self._minibatch_size,
+                Mode.PREDICTION,
+            )
+        export_model(
+            saved_model_path,
+            self._params,
             self._model_version,
-            os.path.join(saved_model_path, "model.chkpt"),
+            metadata=self._export_meta,
+            serving_fn=(
+                make_serving_fn(self._model, self._state)
+                if example is not None
+                else None
+            ),
+            example_features=example,
         )
         self.report_task_result(task_id=task.task_id, err_msg="")
 

@@ -13,8 +13,10 @@ from tests.test_utils import MODEL_ZOO_PATH, DatasetName, create_recordio_file
 
 
 def test_cli_train_local_single_process(tmp_path):
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
     create_recordio_file(
-        128, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
+        128, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(data_dir)
     )
     export_dir = str(tmp_path / "export")
     ckpt_dir = str(tmp_path / "ckpt")
@@ -26,7 +28,7 @@ def test_cli_train_local_single_process(tmp_path):
             "--model_def", "mnist_subclass.mnist_subclass.CustomModel",
             "--minibatch_size", "16",
             "--num_epochs", "1",
-            "--training_data", str(tmp_path),
+            "--training_data", str(data_dir),
             "--num_ps_pods", "0",
             "--use_async", "true",
             "--checkpoint_steps", "4",
@@ -38,6 +40,39 @@ def test_cli_train_local_single_process(tmp_path):
     exported = glob.glob(os.path.join(export_dir, "*", "model.chkpt"))
     assert exported, "SAVE_MODEL export missing"
     assert glob.glob(os.path.join(ckpt_dir, "model_v*.chkpt"))
+
+    # the export is the standard artifact (docs/export.md): manifest +
+    # orbax params + serialized serving forward for this dense model
+    from elasticdl_tpu.common.export import is_export_dir, load_export
+
+    artifact_dir = os.path.dirname(exported[0])
+    assert is_export_dir(artifact_dir)
+    loaded = load_export(artifact_dir)
+    assert loaded.has_serving_fn(), "dense model should ship serving fn"
+    assert loaded.metadata["model_def"].endswith("CustomModel")
+    import numpy as np
+
+    # the serving signature is the dataset_fn's PREDICTION feature
+    # structure (here {"image": (b, 28, 28)})
+    out = np.asarray(
+        loaded.serve({"image": np.zeros((3, 28, 28), np.float32)})
+    )
+    assert out.shape == (3, 10) and np.isfinite(out).all()
+
+    # and the artifact DIRECTORY feeds a serving job directly
+    rc = cli_main(
+        [
+            "predict",
+            "--job_name", "cli-pred-export",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", "mnist_subclass.mnist_subclass.CustomModel",
+            "--minibatch_size", "16",
+            "--prediction_data", str(data_dir),
+            "--num_ps_pods", "0",
+            "--checkpoint_filename_for_init", artifact_dir,
+        ]
+    )
+    assert rc == 0
 
 
 def test_cli_allreduce_train_then_evaluate_then_predict(tmp_path):
@@ -62,11 +97,16 @@ def test_cli_allreduce_train_then_evaluate_then_predict(tmp_path):
     rc = cli_main(
         ["train", "--job_name", "ar-train", "--num_epochs", "1",
          "--training_data", str(data_dir),
-         "--checkpoint_dir", ckpt_dir, "--checkpoint_steps", "2"]
+         "--checkpoint_dir", ckpt_dir, "--checkpoint_steps", "2",
+         "--output", str(tmp_path / "export")]
         + common
     )
     assert rc == 0
     assert glob.glob(os.path.join(ckpt_dir, "ckpt_v*")), "no sharded ckpts"
+    from elasticdl_tpu.common.export import is_export_dir
+
+    exports = glob.glob(os.path.join(str(tmp_path / "export"), "*"))
+    assert exports and is_export_dir(exports[0]), "allreduce export missing"
 
     rc = cli_main(
         ["evaluate", "--job_name", "ar-eval",
