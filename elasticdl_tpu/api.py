@@ -164,6 +164,17 @@ def _run_local_job(args):
 
     if getattr(args, "port", None) is None:
         args.port = 0  # local mode: bind an ephemeral port
+    if getattr(args, "num_ps_pods", 0) > 0:
+        # local mode never launches PS processes: every worker talks to
+        # the master, so the master must hold the optimizer. With the
+        # (cluster-oriented) default num_ps_pods=1 left in place the
+        # master would hold none and dense gradients would be rejected.
+        logger.info(
+            "local mode ignores --num_ps_pods=%d (no local PS fleet); "
+            "the master holds the model",
+            args.num_ps_pods,
+        )
+        args.num_ps_pods = 0
     master = Master(args)
     master.prepare()
 

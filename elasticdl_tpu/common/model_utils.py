@@ -15,6 +15,7 @@ Dataset shim (elasticdl_tpu/data/dataset.py).
 """
 
 import importlib.util
+import json
 import os
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
@@ -211,11 +212,29 @@ def load_from_checkpoint_file(file_path):
     its ``legacy_checkpoint`` member is this same codec, so every
     init-from-checkpoint surface loads exports with no extra flag."""
     if os.path.isdir(file_path):
-        candidate = os.path.join(file_path, "model.chkpt")
+        # the member name comes from the artifact's own manifest when
+        # present (the export contract, common/export.py) so this
+        # resolver follows any relocation instead of hardcoding it
+        from elasticdl_tpu.common import export as export_mod
+
+        member = export_mod._LEGACY_CHKPT
+        try:
+            with open(
+                os.path.join(file_path, export_mod.MANIFEST_NAME)
+            ) as f:
+                member = (
+                    json.load(f)["artifacts"].get(
+                        "legacy_checkpoint"
+                    )
+                    or member
+                )
+        except (OSError, ValueError, KeyError):
+            pass
+        candidate = os.path.join(file_path, member)
         if not os.path.exists(candidate):
             raise ValueError(
-                "%s is a directory without a model.chkpt (not an "
-                "elasticdl_tpu export artifact)" % file_path
+                "%s is a directory without a %s member (not an "
+                "elasticdl_tpu export artifact)" % (file_path, member)
             )
         file_path = candidate
     with open(file_path, "rb") as f:

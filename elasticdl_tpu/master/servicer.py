@@ -490,6 +490,22 @@ class MasterServicer:
         if self._use_async:
             self._lock.acquire()
         try:
+            if (
+                (grads or indexed_grads)
+                and self._opt is None
+                and not self._coordinates_only
+            ):
+                # a PS-pods master holds no optimizer because workers
+                # push gradients to the PS fleet — dense gradients
+                # arriving HERE mean the job is miswired (e.g. local
+                # mode with num_ps_pods>0 but no PS launched); dropping
+                # them silently trains nothing while versions advance
+                raise ValueError(
+                    "master received dense gradients but holds no "
+                    "optimizer; in PS-pod jobs workers must push to "
+                    "the PS fleet (is this a local-mode job with "
+                    "num_ps_pods > 0?)"
+                )
             if (grads or indexed_grads) and self._opt is not None:
                 self._ensure_opt_state()
                 dense = self._densify(grads, indexed_grads)

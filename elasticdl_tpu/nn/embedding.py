@@ -30,6 +30,33 @@ ROWS_COLLECTION = "edl_embedding"
 IDX_COLLECTION = "edl_embedding_idx"
 
 
+class _CallSlot(nn.Module):
+    """Per-call position indices for one :class:`Embedding` call site.
+
+    A layer called N times per forward owns N slots, named explicitly in
+    call order (flax's auto-numbering cannot be used: it resets per
+    invocation — that reset IS module sharing, which would alias both
+    calls onto one idx buffer). All slots gather from the SAME rows
+    buffer, so a tied/reused embedding shares one table and its row
+    gradients accumulate across calls (the reference instead degrades
+    such models to eager, reference worker.py:514-524)."""
+
+    @nn.compact
+    def __call__(self, ids, rows):
+        idx = self.variable(
+            IDX_COLLECTION,
+            "idx",
+            lambda: jnp.zeros(ids.shape, jnp.int32),
+        ).value
+        return jnp.take(rows, idx, axis=0)  # ids.shape + (dim,)
+
+
+def call_slot_name(i):
+    """The flax auto-name of the i-th Embedding call's idx slot; the
+    worker keys per-call idx arrays under ``path + (call_slot_name(i),)``."""
+    return "_CallSlot_%d" % i
+
+
 class Embedding(nn.Module):
     """Elastic embedding: rows are per-batch inputs, not parameters.
 
@@ -51,12 +78,29 @@ class Embedding(nn.Module):
             "rows",
             lambda: jnp.zeros((1, self.output_dim), jnp.float32),
         ).value
-        idx = self.variable(
-            IDX_COLLECTION,
-            "idx",
-            lambda: jnp.zeros(ids.shape, jnp.int32),
-        ).value
-        emb = jnp.take(rows, idx, axis=0)  # ids.shape + (dim,)
+        # per-call slot index: a plain counter on the bound instance —
+        # fresh per apply (linen re-binds a new clone each apply), and
+        # monotonic across repeated calls within one forward
+        call_index = getattr(self, "_edl_call_index", 0)
+        object.__setattr__(self, "_edl_call_index", call_index + 1)
+        # a long-lived `module.bind(variables)` handle reuses ONE
+        # instance across forwards, so the counter outlives the slots;
+        # wrap onto the bound slot count (within a single forward the
+        # collection holds exactly one slot per call, so this never
+        # fires there — it only folds bound-handle reuse back to slot
+        # 0). Skipped during init, where slots are still accruing and
+        # self.variables grows one slot per call. Known trade-off: an
+        # UNDER-provisioned collection (fewer slots than calls, e.g. a
+        # hand-built single-slot idx tree for a twice-calling model)
+        # also wraps instead of raising — indistinguishable from bound
+        # reuse; every framework path provisions the full slot count
+        # from the capture pass, so only hand-built collections can
+        # trip this.
+        if self.scope is not None and not self.is_initializing():
+            n_slots = len(self.variables.get(IDX_COLLECTION, {}))
+            if n_slots and call_index >= n_slots:
+                call_index %= n_slots
+        emb = _CallSlot(name=call_slot_name(call_index))(ids, rows)
         if self.mask_zero:
             emb = emb * (ids != 0).astype(emb.dtype)[..., None]
         if self.combiner is not None:
@@ -85,34 +129,33 @@ class _CaptureDone(Exception):
 def capture_embedding_ids(
     module, variables, features, expected_count=None, layer_info=None
 ):
-    """Run one short-circuited host forward; returns {path: ids ndarray}.
+    """Run one short-circuited host forward; returns {path: [ids, ...]}.
 
-    ``path`` is the module path tuple of each elastic Embedding call —
-    the key under which its rows/idx live in the variable collections.
-    The layer body is skipped (returns zeros), so no rows are needed; when
-    ``expected_count`` is given the forward aborts as soon as every layer
-    has reported, so post-embedding layers never execute on host. When a
-    dict is passed as ``layer_info`` it is filled with
-    {path: (output_dim, embedding_initializer)} so callers can register
-    tables with the layer-declared initializer (the reference forwards it
-    in EmbeddingTableInfo, elasticdl.proto:76-80).
+    ``path`` is the module path tuple of each elastic Embedding layer —
+    the key under which its rows live in the variable collections; the
+    list holds one ids ndarray per CALL of that layer, in call order
+    (slot i maps to :func:`call_slot_name`). The layer body is skipped
+    (returns zeros), so no rows are needed; when ``expected_count`` — the
+    TOTAL number of calls, i.e. idx slots — is given the forward aborts
+    as soon as every call has reported, so post-embedding layers never
+    execute on host. When a dict is passed as ``layer_info`` it is
+    filled with {path: (output_dim, embedding_initializer)} so callers
+    can register tables with the layer-declared initializer (the
+    reference forwards it in EmbeddingTableInfo, elasticdl.proto:76-80).
     """
     captured = {}
+    n_calls = 0
 
     def interceptor(next_fun, args, kwargs, context):
+        nonlocal n_calls
         if (
             isinstance(context.module, Embedding)
             and context.method_name == "__call__"
         ):
             ids = np.asarray(args[0])
             path = context.module.path
-            if path in captured:
-                raise NotImplementedError(
-                    "elastic Embedding %r called more than once per forward"
-                    " is not supported (the reference trains such models "
-                    "eagerly, worker.py:514-524)" % (path,)
-                )
-            captured[path] = ids
+            captured.setdefault(path, []).append(ids)
+            n_calls += 1
             if layer_info is not None:
                 layer_info[path] = (
                     context.module.output_dim,
@@ -120,7 +163,7 @@ def capture_embedding_ids(
                 )
             if (
                 expected_count is not None
-                and len(captured) >= expected_count
+                and n_calls >= expected_count
             ):
                 raise _CaptureDone()
             mod = context.module
@@ -145,14 +188,34 @@ def plan_lookup(ids, bucket_min=8):
     Static bucket sizes keep the jitted step's shapes stable across
     batches with different unique-id counts.
     """
-    flat = np.asarray(ids).reshape(-1).astype(np.int64)
+    unique, (idx,), bucket = plan_lookup_multi([ids], bucket_min)
+    return unique, idx, bucket
+
+
+def plan_lookup_multi(ids_list, bucket_min=8):
+    """Union lookup plan over every call of one layer per forward.
+
+    Returns (unique_ids (k,), [idx per call], bucket_size): one shared
+    rows pull covers all calls (a tied embedding reads the same table),
+    each call keeping its own position array into that buffer.
+    """
+    arrays = [np.asarray(ids) for ids in ids_list]
+    flat = np.concatenate(
+        [a.reshape(-1).astype(np.int64) for a in arrays]
+    )
     unique, inverse = np.unique(flat, return_inverse=True)
     k = len(unique)
     bucket = bucket_min
     while bucket < k:
         bucket *= 2
-    idx = inverse.reshape(np.asarray(ids).shape).astype(np.int32)
-    return unique, idx, bucket
+    idxs, off = [], 0
+    for a in arrays:
+        n = a.size
+        idxs.append(
+            inverse[off : off + n].reshape(a.shape).astype(np.int32)
+        )
+        off += n
+    return unique, idxs, bucket
 
 
 def path_name(path):

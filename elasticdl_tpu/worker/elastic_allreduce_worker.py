@@ -943,9 +943,20 @@ class ElasticAllReduceWorker:
                     not err_msg
                     and self._prediction_outputs_processor is not None
                 ):
-                    self._prediction_outputs_processor.process(
-                        outputs, self._worker_id
-                    )
+                    try:
+                        self._prediction_outputs_processor.process(
+                            outputs, self._worker_id
+                        )
+                    except RuntimeError as e:
+                        # processor failures are terminal (no replay —
+                        # it may have partially written its sink) but
+                        # must still fail-report below so the master
+                        # requeues immediately instead of waiting for
+                        # worker-death detection
+                        logger.warning(
+                            "prediction outputs processor failed: %s", e
+                        )
+                        err_msg = str(e)
                 self._task_data_service.report_record_done(
                     count, err_msg
                 )

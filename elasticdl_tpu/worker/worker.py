@@ -46,10 +46,11 @@ from elasticdl_tpu.nn.embedding import (
     IDX_COLLECTION,
     ROWS_COLLECTION,
     build_collection,
+    call_slot_name,
     capture_embedding_ids,
     flatten_collection,
     path_name,
-    plan_lookup,
+    plan_lookup_multi,
 )
 from elasticdl_tpu.nn.model_api import init_variables, split_variables
 from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
@@ -131,6 +132,7 @@ class Worker:
         # elastic embedding layers (populated at variable creation)
         self._embedding_dims = {}  # {path_tuple: dim}
         self._embedding_initializers = {}  # {path_tuple: initializer name}
+        self._embedding_num_calls = 0  # total calls (idx slots) per forward
         self._emb_grad_fn = None
         self._emb_forward_fn = None
 
@@ -256,7 +258,7 @@ class Worker:
             self._params, self._state = split_variables(variables)
             # elastic embedding collections are per-batch inputs, not state
             rows_template = self._state.pop(ROWS_COLLECTION, None)
-            self._state.pop(IDX_COLLECTION, None)
+            idx_template = self._state.pop(IDX_COLLECTION, None)
             if rows_template:
                 self._embedding_dims = {
                     path: int(arr.shape[-1])
@@ -264,6 +266,11 @@ class Worker:
                         rows_template, "rows"
                     ).items()
                 }
+                # total CALLS per forward (>= layer count: a tied layer
+                # owns one idx slot per call) — bounds every capture pass
+                self._embedding_num_calls = len(
+                    flatten_collection(idx_template, "idx")
+                )
                 # one capture pass to learn each layer's declared
                 # initializer (forwarded in EmbeddingTableInfo)
                 layer_info = {}
@@ -271,7 +278,7 @@ class Worker:
                     self._model,
                     {"params": self._params, **self._state},
                     features,
-                    expected_count=len(self._embedding_dims),
+                    expected_count=self._embedding_num_calls,
                     layer_info=layer_info,
                 )
                 self._embedding_initializers = {
@@ -329,11 +336,14 @@ class Worker:
             self._model,
             variables,
             features,
-            expected_count=len(self._embedding_dims),
+            expected_count=self._embedding_num_calls,
         )
         rows_by_path, idx_by_path, plan = {}, {}, {}
-        for path, ids in captured.items():
-            unique, idx, bucket = plan_lookup(ids)
+        for path, ids_list in captured.items():
+            # one union pull per layer, however many times it is called:
+            # every call slot gathers from the same rows buffer, so row
+            # gradients of a tied embedding accumulate across calls
+            unique, idxs, bucket = plan_lookup_multi(ids_list)
             if self._ps_client is not None:
                 rows = self._ps_client.pull_embedding_vectors(
                     path_name(path), unique
@@ -354,7 +364,8 @@ class Worker:
                     ]
                 )
             rows_by_path[path] = rows
-            idx_by_path[path] = idx
+            for i, idx in enumerate(idxs):
+                idx_by_path[path + (call_slot_name(i),)] = idx
             plan[path] = (unique, len(unique))
         return (
             build_collection(rows_by_path, "rows"),

@@ -143,3 +143,46 @@ def test_cli_allreduce_train_then_evaluate_then_predict(tmp_path):
          "--num_ps_pods", "0"]
     )
     assert rc == 2
+
+
+def test_cli_local_default_ps_pods_actually_trains(tmp_path):
+    """Local mode with the cluster-oriented default --num_ps_pods=1 must
+    still train: the master holds the optimizer (a drive caught dense
+    gradients being silently dropped — versions advanced, weights
+    never moved, and sparse jobs crashed on the missing applier)."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    create_recordio_file(
+        128, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(data_dir)
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+    rc = cli_main(
+        [
+            "train",
+            "--job_name", "cli-default-ps",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", "mnist_subclass.mnist_subclass.CustomModel",
+            "--minibatch_size", "16",
+            "--num_epochs", "1",
+            "--training_data", str(data_dir),
+            # note: NO --num_ps_pods (defaults to 1)
+            "--use_async", "true",
+            "--checkpoint_steps", "4",
+            "--checkpoint_dir", ckpt_dir,
+        ]
+    )
+    assert rc == 0
+    from elasticdl_tpu.common.model_utils import (
+        load_from_checkpoint_file,
+    )
+
+    ckpts = sorted(glob.glob(os.path.join(ckpt_dir, "model_v*.chkpt")))
+    assert len(ckpts) >= 2
+    _, first = load_from_checkpoint_file(ckpts[0])
+    _, last = load_from_checkpoint_file(ckpts[-1])
+    import numpy as np
+
+    moved = any(
+        not np.array_equal(first[k], last[k]) for k in first
+    )
+    assert moved, "weights identical across checkpoints: not training"

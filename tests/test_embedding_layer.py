@@ -13,6 +13,7 @@ import numpy as np
 
 from elasticdl_tpu.nn.embedding import (
     IDX_COLLECTION,
+    call_slot_name,
     ROWS_COLLECTION,
     Embedding,
     build_collection,
@@ -54,7 +55,8 @@ def test_capture_embedding_ids():
     params = {"params": variables.get("params", {})}
     captured = capture_embedding_ids(model, params, features)
     assert list(captured.keys()) == [("emb",)]
-    np.testing.assert_array_equal(captured[("emb",)], features["ids"])
+    assert len(captured[("emb",)]) == 1  # one call -> one slot
+    np.testing.assert_array_equal(captured[("emb",)][0], features["ids"])
     assert path_name(("emb",)) == "emb"
 
 
@@ -74,7 +76,9 @@ def test_forward_matches_table_gather():
         {
             "params": variables.get("params", {}),
             ROWS_COLLECTION: build_collection({("emb",): rows}, "rows"),
-            IDX_COLLECTION: build_collection({("emb",): idx}, "idx"),
+            IDX_COLLECTION: build_collection(
+                {("emb", call_slot_name(0)): idx}, "idx"
+            ),
         },
         features,
     )
@@ -97,7 +101,7 @@ def test_mask_zero_and_combiners():
         out = layer.apply(
             {
                 ROWS_COLLECTION: {"rows": rows},
-                IDX_COLLECTION: {"idx": idx},
+                IDX_COLLECTION: {call_slot_name(0): {"idx": idx}},
             },
             ids,
         )
@@ -130,7 +134,7 @@ def test_bet_gradients_flow_through_rows():
         params,
         build_collection({("emb",): rows}, "rows"),
         {},
-        build_collection({("emb",): idx}, "idx"),
+        build_collection({("emb", call_slot_name(0)): idx}, "idx"),
         features,
         labels,
         jax.random.PRNGKey(0),
@@ -148,3 +152,123 @@ def test_bet_gradients_flow_through_rows():
 
     expected = np.asarray(jax.grad(dense_loss)(jnp.asarray(rows)))
     np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+class TiedEmbeddingModel(nn.Module):
+    """One Embedding instance called twice per forward (tied weights) —
+    the case the reference can only train eagerly (worker.py:514-524)."""
+
+    dim: int = 3
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        emb = Embedding(output_dim=self.dim, name="emb")
+        a = emb(features["a"])
+        b = emb(features["b"])
+        return a.sum(axis=(1, 2)) + 2.0 * b.sum(axis=(1, 2))
+
+
+def test_tied_embedding_two_calls_capture_and_plan():
+    from elasticdl_tpu.nn.embedding import plan_lookup_multi
+
+    model = TiedEmbeddingModel()
+    features = {
+        "a": np.array([[1, 2], [3, 4]], dtype=np.int64),
+        "b": np.array([[2, 9], [9, 1]], dtype=np.int64),
+    }
+    variables = _variables_for(model, features)
+    # init created one rows buffer but TWO idx slots
+    idx_tree = flatten_collection(variables[IDX_COLLECTION], "idx")
+    assert set(idx_tree) == {
+        ("emb", call_slot_name(0)),
+        ("emb", call_slot_name(1)),
+    }
+    captured = capture_embedding_ids(
+        model, {"params": variables.get("params", {})}, features
+    )
+    assert [len(v) for v in captured.values()] == [2]
+    np.testing.assert_array_equal(captured[("emb",)][0], features["a"])
+    np.testing.assert_array_equal(captured[("emb",)][1], features["b"])
+
+    unique, idxs, bucket = plan_lookup_multi(captured[("emb",)])
+    np.testing.assert_array_equal(unique, [1, 2, 3, 4, 9])
+    np.testing.assert_array_equal(unique[idxs[0]], features["a"])
+    np.testing.assert_array_equal(unique[idxs[1]], features["b"])
+
+
+def test_tied_embedding_grads_match_dense():
+    """Row gradients of a twice-called layer equal the dense-table
+    gradient of the tied formulation (contributions from both call
+    sites accumulate into one IndexedSlices)."""
+    from elasticdl_tpu.nn.embedding import plan_lookup_multi
+
+    model = TiedEmbeddingModel(dim=3)
+    features = {
+        "a": np.array([[1, 2], [3, 4]], dtype=np.int64),
+        "b": np.array([[2, 9], [9, 1]], dtype=np.int64),
+    }
+    labels = np.zeros((2,), np.float32)
+    unique, idxs, bucket = plan_lookup_multi(
+        [features["a"], features["b"]]
+    )
+    rng = np.random.default_rng(3)
+    rows = np.concatenate(
+        [
+            rng.standard_normal((len(unique), 3)).astype(np.float32),
+            np.zeros((bucket - len(unique), 3), np.float32),
+        ]
+    )
+    variables = _variables_for(model, features)
+
+    def loss_fn(output, labels):
+        return ((output - labels) ** 2).mean()
+
+    grad_fn = make_embedding_grad_fn(model, loss_fn)
+    loss, param_grads, row_grads, new_state, output = grad_fn(
+        variables.get("params", {}),
+        build_collection({("emb",): rows}, "rows"),
+        {},
+        build_collection(
+            {
+                ("emb", call_slot_name(0)): idxs[0],
+                ("emb", call_slot_name(1)): idxs[1],
+            },
+            "idx",
+        ),
+        features,
+        labels,
+        jax.random.PRNGKey(0),
+    )
+    got = flatten_collection(
+        jax.tree_util.tree_map(np.asarray, row_grads), "rows"
+    )[("emb",)]
+    np.testing.assert_array_equal(got[len(unique):], 0.0)
+
+    def dense_loss(rows_):
+        out = rows_[idxs[0]].sum(axis=(1, 2)) + 2.0 * rows_[
+            idxs[1]
+        ].sum(axis=(1, 2))
+        return ((out - labels) ** 2).mean()
+
+    expected = np.asarray(jax.grad(dense_loss)(jnp.asarray(rows)))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_bound_handle_repeated_calls():
+    """A long-lived `module.bind(variables)` handle (interactive/debug
+    pattern) can be called across forwards: the per-call slot counter
+    wraps onto the bound slot count instead of probing missing slots."""
+    ids = np.array([[1, 2]], dtype=np.int64)
+    unique, idx, bucket = plan_lookup(ids)
+    rows = np.zeros((bucket, 2), np.float32)
+    rows[: len(unique)] = [[1.0, 1.0], [2.0, 2.0]]
+    layer = Embedding(output_dim=2)
+    bound = layer.bind(
+        {
+            ROWS_COLLECTION: {"rows": rows},
+            IDX_COLLECTION: {call_slot_name(0): {"idx": idx}},
+        }
+    )
+    first = np.asarray(bound(ids))
+    second = np.asarray(bound(ids))  # crashed before the wrap fix
+    np.testing.assert_array_equal(first, second)

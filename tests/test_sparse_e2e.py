@@ -266,3 +266,133 @@ def test_ps_kill_mid_job_sparse_path(fixed_data, no_shuffle):
     finally:
         for ps in servers:
             ps.stop()
+
+
+TIED_ZOO_MODULE = '''
+"""Tied-embedding test model: one elastic Embedding called twice per
+forward (the case the reference degrades to eager, worker.py:514-524)."""
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.data.example import FixedLenFeature, parse_example
+from elasticdl_tpu.nn.embedding import Embedding
+
+
+class TiedModel(nn.Module):
+    dim: int = 8
+
+    @nn.compact
+    def __call__(self, features, training=False):
+        ids = features["feature"]
+        emb = Embedding(output_dim=self.dim, name="tied")
+        a = emb(ids)
+        b = emb((ids + 1) % 60)
+        bias = self.param("bias", nn.initializers.zeros, (1,))
+        return (a.sum(axis=(1, 2)) + 2.0 * b.sum(axis=(1, 2)))[:, None] + bias
+
+
+def custom_model(dim=8):
+    return TiedModel(dim=int(dim))
+
+
+def loss(output, labels):
+    return ((output - labels.astype(jnp.float32)) ** 2).mean()
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(float(lr))
+
+
+def dataset_fn(dataset, mode, metadata):
+    spec = {
+        "feature": FixedLenFeature([10], np.int64),
+        "label": FixedLenFeature([1], np.int64),
+    }
+
+    def parse(record):
+        r = parse_example(record, spec)
+        return {"feature": r["feature"]}, r["label"]
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {}
+'''
+
+
+def test_tied_embedding_worker_matches_dense(
+    fixed_data, no_shuffle, tmp_path
+):
+    """A model calling one elastic Embedding twice per forward trains
+    through the PS plane and lands the same table as dense training —
+    beyond the reference, which drops to eager for this case."""
+    data_file, records = fixed_data
+    zoo_dir = tmp_path / "zoo" / "tied_model"
+    zoo_dir.mkdir(parents=True)
+    (zoo_dir / "tied_model.py").write_text(TIED_ZOO_MODULE)
+
+    task_d = TaskDispatcher(
+        {data_file: (0, RECORDS)}, {}, {}, RECORDS, EPOCHS
+    )
+    master = MasterServicer(
+        1,
+        BATCH,
+        optax.sgd(LR),
+        task_d,
+        checkpoint_service=CheckpointService("", 0, 0, False),
+        use_async=False,
+    )
+    master.push_embedding_info(
+        [EmbeddingTableInfo("tied", DIM, "uniform")]
+    )
+    all_ids = np.arange(VOCAB)
+    init_rows = master.pull_embedding_vectors("tied", all_ids).copy()
+
+    worker = Worker(
+        worker_id=1,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=BATCH,
+        model_zoo=str(tmp_path / "zoo"),
+        model_def="tied_model.tied_model.custom_model",
+        model_params="dim=%d" % DIM,
+        stub=None,
+    )
+    worker._stub = InProcessMaster(master)
+    worker.run()
+    assert task_d.finished()
+    final_rows = master.pull_embedding_vectors("tied", all_ids)
+
+    # dense twin: identical batches against a (VOCAB, DIM) table + bias
+    import jax.numpy as jnp
+
+    twin = {
+        "table": jnp.asarray(init_rows.astype(np.float32)),
+        "bias": jnp.zeros((1,), jnp.float32),
+    }
+    for _ in range(EPOCHS):
+        for i in range(0, RECORDS, BATCH):
+            chunk = records[i : i + BATCH]
+            ids = np.stack([r[0] for r in chunk])
+            labels = np.stack([r[1] for r in chunk]).astype(np.float32)
+
+            def dense_loss(p):
+                a = p["table"][ids]
+                b = p["table"][(ids + 1) % VOCAB]
+                out = (
+                    a.sum(axis=(1, 2)) + 2.0 * b.sum(axis=(1, 2))
+                )[:, None] + p["bias"]
+                return ((out - labels) ** 2).mean()
+
+            g = jax.grad(dense_loss)(twin)
+            twin = {k: v - LR * g[k] for k, v in twin.items()}
+
+    np.testing.assert_allclose(
+        final_rows, np.asarray(twin["table"]), rtol=2e-4, atol=2e-5
+    )
+    _, final_dense = master.get_model(master.get_model_version())
+    np.testing.assert_allclose(
+        final_dense["bias"], np.asarray(twin["bias"]), rtol=2e-4, atol=2e-5
+    )
