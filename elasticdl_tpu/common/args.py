@@ -215,6 +215,14 @@ def add_train_params(parser):
     parser.add_argument(
         "--keep_checkpoint_max", type=non_neg_int, default=0
     )
+    parser.add_argument(
+        "--replica_refresh_steps",
+        type=non_neg_int,
+        default=8,
+        help="Sharded elastic jobs: refresh the in-HBM replica of each "
+        "rank's table shards every this many versions (bounded-"
+        "staleness no-disk recovery); 0 disables the replica plane",
+    )
     parser.add_argument("--checkpoint_filename_for_init", default="")
     parser.add_argument(
         "--output", default="", help="Trained-model export path"
@@ -386,6 +394,9 @@ def parse_worker_args(worker_args=None):
     # master relays its own values for these via the argv relay
     parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
     parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument(
+        "--replica_refresh_steps", type=non_neg_int, default=8
+    )
     parser.add_argument(
         "--checkpoint_filename_for_init",
         default="",

@@ -63,6 +63,77 @@ def _leaf_spec(params):
     return spec
 
 
+def _write_orbax_params(params_path, params, legacy_path):
+    """Write the orbax params artifact; returns False on failure.
+
+    In a multi-process jax world (the elastic plane), orbax's save runs
+    a GLOBAL process barrier (sync_global_processes) — but only the
+    export-task rank is exporting, so an in-process save deadlocks the
+    job against peers still in their training collectives. There the
+    save runs in a fresh single-process subprocess fed by the
+    already-written legacy member (same arrays, nested by the "/" path
+    convention of pytree_to_named_arrays)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        import orbax.checkpoint as ocp
+
+        # orbax refuses to overwrite; an export dir is written once per
+        # timestamped path but a retried SAVE_MODEL task may reuse one
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(params_path, params, force=True)
+        ckptr.wait_until_finished()
+        return True
+
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys\n"
+        "import orbax.checkpoint as ocp\n"
+        "from elasticdl_tpu.common.model_utils import "
+        "load_from_checkpoint_file\n"
+        "from elasticdl_tpu.common.tensor import "
+        "named_arrays_to_nested\n"
+        "_, named = load_from_checkpoint_file(sys.argv[1])\n"
+        "tree = named_arrays_to_nested(named)\n"
+        "ckptr = ocp.StandardCheckpointer()\n"
+        "ckptr.save(sys.argv[2], tree, force=True)\n"
+        "ckptr.wait_until_finished()\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a child must not inherit the parent's distributed-world wiring
+    for k in ("EDL_DIST_PLATFORM", "EDL_LOCAL_DEVICES"):
+        env.pop(k, None)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code, legacy_path, params_path],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+        )
+    except Exception as e:  # noqa: BLE001 - optional artifact member
+        logger.warning("orbax params subprocess failed: %s", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning(
+            "orbax params subprocess failed (rc=%d): %s",
+            proc.returncode,
+            (proc.stderr or "")[-2000:],
+        )
+        return False
+    return True
+
+
 def _export_serving_fn(path, serving_fn, params, example_features):
     """Serialize ``serving_fn(params, features)`` with a symbolic batch
     dimension for cpu+tpu. Best-effort: a model whose forward cannot be
@@ -127,20 +198,13 @@ def export_model(
     os.makedirs(export_dir, exist_ok=True)
     params = jax.tree_util.tree_map(np.asarray, params)
 
-    import orbax.checkpoint as ocp
+    legacy_path = os.path.join(export_dir, _LEGACY_CHKPT)
+    save_checkpoint_to_file(
+        pytree_to_named_arrays(params), version, legacy_path
+    )
 
     params_path = os.path.join(export_dir, _PARAMS_DIR)
-    ckptr = ocp.StandardCheckpointer()
-    # orbax refuses to overwrite; an export dir is written once per
-    # timestamped path but a retried SAVE_MODEL task may reuse one
-    ckptr.save(params_path, params, force=True)
-    ckptr.wait_until_finished()
-
-    save_checkpoint_to_file(
-        pytree_to_named_arrays(params),
-        version,
-        os.path.join(export_dir, _LEGACY_CHKPT),
-    )
+    has_params = _write_orbax_params(params_path, params, legacy_path)
 
     has_serving = False
     if serving_fn is not None and example_features is not None:
@@ -160,7 +224,7 @@ def export_model(
         "metadata": dict(metadata or {}),
         "leaves": _leaf_spec(params),
         "artifacts": {
-            "params": _PARAMS_DIR,
+            "params": _PARAMS_DIR if has_params else None,
             "legacy_checkpoint": _LEGACY_CHKPT,
             "serving_fn": _SERVING_FILE if has_serving else None,
         },
@@ -282,10 +346,29 @@ def load_export(export_dir):
             "export format v%s is newer than this loader (v%d)"
             % (manifest.get("format_version"), EXPORT_FORMAT_VERSION)
         )
-    import orbax.checkpoint as ocp
+    if manifest["artifacts"].get("params"):
+        import orbax.checkpoint as ocp
 
-    ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(os.path.join(export_dir, _PARAMS_DIR))
+        ckptr = ocp.StandardCheckpointer()
+        params = ckptr.restore(
+            os.path.join(
+                export_dir, manifest["artifacts"]["params"]
+            )
+        )
+    else:
+        # params-member-less artifact (orbax write failed at export):
+        # the legacy codec carries the same arrays, nested back by the
+        # "/" path convention
+        from elasticdl_tpu.common.model_utils import (
+            load_from_checkpoint_file,
+        )
+
+        from elasticdl_tpu.common.tensor import (
+            named_arrays_to_nested,
+        )
+
+        _, named = load_from_checkpoint_file(export_dir)
+        params = named_arrays_to_nested(named)
     return ExportedModel(
         export_dir=export_dir, manifest=manifest, params=params
     )

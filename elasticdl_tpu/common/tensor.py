@@ -184,6 +184,21 @@ def pytree_to_named_arrays(tree):
     return {_join_path(path): np.asarray(leaf) for path, leaf in flat}
 
 
+def named_arrays_to_nested(named):
+    """Nest {path_name: value} back into plain dicts by the "/" path
+    convention of :func:`pytree_to_named_arrays` (the structure-free
+    inverse — use :func:`named_arrays_to_pytree` when a template
+    pytree is available)."""
+    tree = {}
+    for name, value in named.items():
+        node = tree
+        parts = name.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
 def named_arrays_to_pytree(named, like):
     """Unflatten {path_name: ndarray} back into the structure of ``like``."""
     import jax

@@ -161,11 +161,30 @@ class LocalInstanceManager:
     # -- control ------------------------------------------------------------
 
     def kill_worker(self, worker_id):
-        """Fault injection: kill one live worker process."""
+        """Fault injection / fencing: kill one live worker process.
+
+        SIGABRT first (with PYTHONFAULTHANDLER=1 the dying process dumps
+        every thread's stack to its log — the whole point of fencing a
+        wedged member is learning WHERE it wedged), SIGKILL shortly
+        after in case abort is blocked too."""
+        import signal
+        import threading
+
         with self._lock:
             proc = self._procs.get(("worker", worker_id))
         if proc:
-            proc.kill()
+            try:
+                proc.send_signal(signal.SIGABRT)
+            except OSError:
+                pass
+
+            def _finish(p=proc):
+                try:
+                    p.wait(timeout=2)
+                except Exception:
+                    p.kill()
+
+            threading.Thread(target=_finish, daemon=True).start()
 
     def terminate_worker(self, worker_id):
         """Deliver a preemption notice (SIGTERM): the elastic worker

@@ -119,6 +119,11 @@ class MembershipService:
         self._formed = set()  # members seen training in the current epoch
         self._lobby = {}  # joiners parked while a formation is in flight
         self._departing = set()  # drained members: never re-register
+        # ids removed because their PROCESS actually died (watch/fence),
+        # as opposed to graceful drains: the workers' wedge-escape probe
+        # only fires when one of ITS world members is here — a growth
+        # bump or a drain must never abort a healthy (slow) step
+        self._dead = set()
 
     def set_fencer(self, fencer):
         """``fencer(worker_id)`` forcibly terminates a dropped member.
@@ -179,6 +184,7 @@ class MembershipService:
                 # it (or parking it in the lobby) would re-grow the world
                 # it is leaving
                 return
+            self._dead.discard(worker_id)  # evidently alive
             if (
                 self._live.get(worker_id) == host
                 or self._lobby.get(worker_id) == host
@@ -216,6 +222,8 @@ class MembershipService:
         with self._lock:
             if departing:
                 self._departing.add(worker_id)
+            else:
+                self._dead.add(worker_id)
             self._lobby.pop(worker_id, None)
             if worker_id not in self._live:
                 return
@@ -272,7 +280,7 @@ class MembershipService:
                     self._formed_initial = True
                     self._bump_locked()
                 else:
-                    return {"epoch": self._epoch, "ready": False}
+                    return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
             ids = [w for w, _ in self._world]
             if worker_id not in ids:
                 # parked in the lobby, or removed as dead but evidently
@@ -283,7 +291,7 @@ class MembershipService:
                     # going to break anyway — stop holding joiners
                     if now - self._bump_time > self._stale_form_secs:
                         self._bump_locked()
-                return {"epoch": self._epoch, "ready": False}
+                return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
             if self._world_ready and not awaiting:
                 # an awaiting=False poll is the training loop's per-step
                 # epoch check: this member established the current world
@@ -292,7 +300,7 @@ class MembershipService:
                     if not self._formation_in_flight() and self._lobby:
                         # formation done and joiners are waiting: grow now
                         self._bump_locked()
-                        return {"epoch": self._epoch, "ready": False}
+                        return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
             if not self._world_ready:
                 if awaiting:
                     self._confirmed.add(worker_id)
@@ -317,14 +325,16 @@ class MembershipService:
                             self._live.pop(w, None)
                         self._bump_locked()
                         to_fence.extend(lagging)
-                        return {"epoch": self._epoch, "ready": False}
+                        return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
                     self._bump_time = now  # responsive but slow: wait on
                 if not self._world_ready:
-                    return {"epoch": self._epoch, "ready": False}
+                    return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
             return {
                 "epoch": self._epoch,
                 "ready": True,
                 "coordinator": self._coordinator,
                 "num_processes": len(ids),
                 "process_id": ids.index(worker_id),
+                "members": ids,
+                "dead": sorted(self._dead),
             }

@@ -67,18 +67,20 @@ def host_copy(tree):
     return jax.tree_util.tree_map(fetch, tree)
 
 
-def broadcast_from_device0(mesh, host_tree):
+def broadcast_from_device0(mesh, host_tree, source_process=0):
     """Place ``host_tree`` replicated on ``mesh``, all processes adopting
-    device 0's copy.
+    ``source_process``'s copy (default: rank 0).
 
     Each process tiles its own host copy across its local devices into a
-    global (n_devices, ...) array sharded on ``data``; selecting row 0
-    under jit makes XLA broadcast the rank-0 copy to every device. This is
-    both the multi-process placement primitive (plain ``device_put`` can't
-    target non-addressable shardings) and the survivor-state re-broadcast.
+    global (n_devices, ...) array sharded on ``data``; selecting the
+    source process's first device row under jit makes XLA broadcast that
+    copy to every device. This is both the multi-process placement
+    primitive (plain ``device_put`` can't target non-addressable
+    shardings) and the survivor-state re-broadcast.
     """
     n_local = jax.local_device_count()
     n_dev = mesh.devices.size
+    src_dev = source_process * n_local
 
     def place(x):
         x = np.asarray(x)
@@ -89,11 +91,97 @@ def broadcast_from_device0(mesh, host_tree):
         )
 
     stacked = jax.tree_util.tree_map(place, host_tree)
-    pick0 = jax.jit(
-        lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+    pick = jax.jit(
+        lambda t: jax.tree_util.tree_map(lambda a: a[src_dev], t),
         out_shardings=NamedSharding(mesh, P()),
     )
-    return pick0(stacked)
+    return pick(stacked)
+
+
+def _is_sharded_spec(spec):
+    return spec is not None and any(a is not None for a in spec)
+
+
+class ShardMirror:
+    """One rank's in-memory replica of the sharded state plane.
+
+    Captured by :meth:`ElasticDPTrainer.refresh_mirror` (a collective —
+    every rank at the same aligned step): this rank's own shards of
+    every sharded leaf, a ``ppermute``-received copy of the LEFT
+    neighbor process's shards, and a host copy of the replicated leaves
+    — all at one consistent ``version``. Any single process death
+    leaves every old shard present on some survivor (own everywhere +
+    replica on the right neighbor), so a re-form can reassemble the
+    full state device-to-device with no disk in the path; the loss
+    bound is the refresh cadence. This implements (and betters) the
+    replica design the reference specified but never built
+    (/root/reference/docs/designs/parameter_server.md:109-131).
+    """
+
+    __slots__ = (
+        "version",
+        "n_old",
+        "own_block",
+        "own",
+        "replica",
+        "replicated",
+    )
+
+    def __init__(self, version, n_old, own_block, own, replica, replicated):
+        self.version = version
+        self.n_old = n_old  # process count of the world that captured it
+        self.own_block = own_block  # this rank's block index in that world
+        self.own = own  # {path names: np (V/n_old, ...)}
+        self.replica = replica  # left neighbor's block, same keying
+        self.replicated = replicated  # host ts; sharded leaves are placeholders
+
+
+def plan_mirror_assembly(info, floor=0, allow_stale=True):
+    """Pure decision core of the replica-plane assembly.
+
+    ``info``: ``[(has, version, n_old, own_block)]`` indexed by new
+    rank (the all-gathered summary — identical on every rank, so this
+    plan is too). Returns ``(target_v, n_old, alive_blocks)`` when a
+    complete assembly is possible, else None:
+
+    - the target version is the newest mirrored version; mirrors from
+      an older refresh (a rank that somehow missed one) are excluded,
+    - duplicate claims to one old block keep the lowest new rank,
+    - every old block must be covered by its owner or — the replica
+      rule — its right neighbor ``(b+1) % n_old``, who holds its copy.
+    """
+    have = [
+        (rank, v, n, blk)
+        for rank, (has, v, n, blk) in enumerate(info)
+        if has
+    ]
+    if not have:
+        return None
+    target_v = max(v for _, v, _, _ in have)
+    if not allow_stale and floor > target_v:
+        return None
+    n_olds = {n for _, v, n, _ in have if v == target_v}
+    if len(n_olds) != 1:
+        return None
+    n_old = n_olds.pop()
+    alive_blocks = {}
+    for rank, v, n, blk in sorted(have):
+        if v == target_v and n == n_old and blk not in alive_blocks:
+            alive_blocks[blk] = rank
+    for b in range(n_old):
+        if b not in alive_blocks and (b + 1) % n_old not in alive_blocks:
+            return None
+    return target_v, n_old, alive_blocks
+
+
+def _local_block(arr):
+    """(rows ndarray, global row offset) of this process's contiguous
+    slice of a row-sharded global array."""
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    rows = np.concatenate([np.asarray(s.data) for s in shards])
+    return rows, int(shards[0].index[0].start or 0)
 
 
 def _max_checkpoint_version(candidate_dirs):
@@ -457,6 +545,15 @@ class ElasticDPTrainer:
         self._host_step = 0
         self._last_local = None  # (features, labels) for weight-0 steps
         self.epoch_consensus = None  # newest epoch any member has seen
+        # in-memory replica plane (sharded jobs): see ShardMirror
+        self.mirror_steps = 0  # 0 disables; worker sets from its flag
+        self._mirror = None
+        self._mirror_perm_fn = None
+        self._last_mirror_version = -1
+        # escapable-wait hook (see _escapable): worker sets it to a
+        # "has the master already bumped past my epoch?" probe
+        self.abort_check = None
+        self._wedged = False
 
     @property
     def mesh(self):
@@ -464,8 +561,11 @@ class ElasticDPTrainer:
 
     @property
     def version(self):
-        return (
-            int(host_copy(self._ts.version)) if self._ts is not None else -1
+        if self._ts is None:
+            return -1
+        # escapable: a peer loss can wedge any device interaction
+        return int(
+            self._escapable(lambda: host_copy(self._ts.version))
         )
 
     @property
@@ -513,6 +613,8 @@ class ElasticDPTrainer:
         distributed.ensure_world(spec)
         self._spec = spec
         self._mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        self._mirror_perm_fn = None  # mesh changed: rebuild on demand
+        self._wedged = False  # fresh backend: device fetches are safe again
         if self._builder is not None:
             self._module, param_specs = self._builder(self._mesh)
             self._sharded_paths = collect_sharded_paths(param_specs)
@@ -538,6 +640,12 @@ class ElasticDPTrainer:
             state_specs=self._state_specs,
             remat=self._remat,
         )
+        if self.mirror_enabled():
+            # every rank reaches this point during formation, so the
+            # refresh collective is aligned; it also resets
+            # _last_mirror_version identically on every rank (joiners
+            # included), keeping the cadence predicate global
+            self.refresh_mirror()
         logger.info(
             "elastic plane established: epoch=%d rank=%d/%d devices=%d%s",
             spec.epoch,
@@ -583,9 +691,12 @@ class ElasticDPTrainer:
         )
 
     def _establish_sharded(self, example_batch):
-        """Place sharded-parameter state: newest restorable checkpoint
-        (falling back through older complete ones — a killed rank can
-        leave the newest version torn), else deterministic re-init."""
+        """Place sharded-parameter state: the in-memory replica plane
+        first (no disk in the path — see ShardMirror), then the newest
+        restorable checkpoint (falling back through older complete ones
+        — a killed rank can leave the newest version torn), then a
+        second replica attempt (a torn newer checkpoint must not beat a
+        healthy mirror), else deterministic re-init."""
         from elasticdl_tpu.common.sharded_checkpoint import load_sharded
 
         if example_batch is None and self._last_local is None:
@@ -611,6 +722,21 @@ class ElasticDPTrainer:
             lambda s: NamedSharding(self._mesh, s), self._state_specs
         )
         floor = _max_checkpoint_version(candidates)
+        # COLLECTIVE attempts: mirror_enabled() answers from the job
+        # args, so every rank takes the same branch; all further
+        # decisions inside derive from the all-gathered summary
+        if self.mirror_enabled():
+            try:
+                if self._try_assemble_from_mirrors(
+                    abstract, floor, allow_stale=False
+                ):
+                    return
+            except Exception:
+                logger.warning(
+                    "replica-plane assembly failed; falling back to "
+                    "checkpoints",
+                    exc_info=True,
+                )
         for restore_dir in candidates:
             try:
                 version, self._ts = load_sharded(restore_dir, shardings)
@@ -639,6 +765,21 @@ class ElasticDPTrainer:
                     restore_dir,
                     exc_info=True,
                 )
+        if self._ts is None and self.mirror_enabled():
+            # second attempt, stale allowed: every checkpoint candidate
+            # proved unrestorable, so an older-than-floor mirror is
+            # still the best recoverable state (all ranks reach this
+            # point together — the checkpoint loop reads the same
+            # shared directory)
+            try:
+                self._try_assemble_from_mirrors(
+                    abstract, floor, allow_stale=True
+                )
+            except Exception:
+                logger.warning(
+                    "stale replica-plane assembly failed",
+                    exc_info=True,
+                )
         if self._ts is None:
             if was_live:
                 logger.warning(
@@ -659,6 +800,332 @@ class ElasticDPTrainer:
             self._ts = place_from_host_specs(
                 self._mesh, init_ts, self._state_specs
             )
+
+    # -- in-memory replica plane (no-disk recovery) -------------------------
+
+    def mirror_enabled(self):
+        """True when the replica plane is on (sharded job + cadence set).
+        The flag comes from the job args, so it is GLOBAL: every rank
+        answers identically, which the collective call sites rely on."""
+        return bool(self.mirror_steps) and self.is_sharded
+
+    def maybe_refresh_mirror(self, version):
+        """Cadence wrapper; call at rank-aligned sync indices only.
+
+        ``version`` is the aligned step version (identical on every
+        rank), and ``_last_mirror_version`` is set by the collective
+        refresh itself (identical on every rank after establish's
+        refresh), so the predicate is global — no rank can sit out the
+        ppermute."""
+        if not self.mirror_enabled() or self._ts is None:
+            return False
+        if (
+            self._mirror is not None
+            and version - self._last_mirror_version < self.mirror_steps
+        ):
+            return False
+        self.refresh_mirror()
+        return True
+
+    def _split_by_sharding(self):
+        """(sharded {path: global leaf}, {path: spec}, replicated host
+        pytree with int8 placeholders at the sharded leaves)."""
+        from elasticdl_tpu.common.pytree import key_path_names
+
+        sharded, specs = {}, {}
+
+        def pick(key_path, leaf, spec):
+            names = tuple(key_path_names(key_path))
+            if _is_sharded_spec(spec):
+                sharded[names] = leaf
+                specs[names] = spec
+                return np.zeros((), np.int8)
+            if hasattr(leaf, "addressable_shards"):
+                return np.asarray(leaf.addressable_shards[0].data)
+            return np.asarray(leaf)
+
+        replicated = jax.tree_util.tree_map_with_path(
+            pick, self._ts, self._state_specs
+        )
+        return sharded, specs, replicated
+
+    def refresh_mirror(self):
+        """Capture a :class:`ShardMirror` — COLLECTIVE: every rank must
+        call at the same aligned step (periodic cadence, the consensus
+        pause, or establish's tail). One jitted ppermute ships each
+        sharded leaf's process block to the next process over ICI; the
+        host staging afterwards is local-only."""
+        if self._ts is None or not self._sharded_paths:
+            return
+        # replicated-leaf host fetches are device interactions too
+        sharded, specs, replicated = self._escapable(
+            self._split_by_sharding
+        )
+        if not sharded:
+            return
+        n_dev = self._mesh.devices.size
+        n_local = jax.local_device_count()
+        if self._mirror_perm_fn is None:
+            spec_tree = {p: specs[p] for p in sharded}
+            # shift by n_local devices = one PROCESS: the whole process
+            # block lands on the next process (a one-device shift would
+            # leave most of a multi-device process's rows on itself)
+            perm = [(d, (d + n_local) % n_dev) for d in range(n_dev)]
+
+            def body(tree):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.lax.ppermute(x, "data", perm), tree
+                )
+
+            self._mirror_perm_fn = jax.jit(
+                shard_map(
+                    body,
+                    mesh=self._mesh,
+                    in_specs=(spec_tree,),
+                    out_specs=spec_tree,
+                    check_rep=False,
+                )
+            )
+        # the permute dispatch AND the host fetches are escapable: a
+        # peer death racing the refresh must not wedge this rank
+        def _permute_and_stage():
+            with self._mesh:
+                permuted = self._mirror_perm_fn(sharded)
+            version = int(host_copy(self._ts.version))
+            own, replica, own_block = {}, {}, 0
+            n_proc = (
+                self._spec.num_processes if self._spec else 1
+            )
+            for path, leaf in sharded.items():
+                rows, off = _local_block(leaf)
+                own[path] = rows
+                replica[path], _ = _local_block(permuted[path])
+                rows_per_proc = leaf.shape[0] // n_proc
+                own_block = off // rows_per_proc
+            return version, own, replica, own_block
+
+        version, own, replica, own_block = self._escapable(
+            _permute_and_stage
+        )
+        n_proc = self._spec.num_processes if self._spec else 1
+        self._mirror = ShardMirror(
+            version, n_proc, own_block, own, replica, replicated
+        )
+        self._last_mirror_version = version
+        logger.info(
+            "replica plane refreshed at v%d (block %d/%d)",
+            version,
+            own_block,
+            n_proc,
+        )
+
+    def _gather_mirror_info(self):
+        """All-gather every NEW-world process's mirror summary.
+
+        COLLECTIVE (every rank, mirror or not). Returns
+        ``[(has, version, n_old, own_block)] `` indexed by new rank —
+        identical on every rank, so all downstream decisions are
+        global."""
+        n_dev = self._mesh.devices.size
+        n_local = jax.local_device_count()
+        n_proc = self._spec.num_processes
+        info = np.zeros((n_local, 4), np.int32)
+        if self._mirror is not None:
+            info[0] = (
+                1,
+                self._mirror.version,
+                self._mirror.n_old,
+                self._mirror.own_block,
+            )
+        g = jax.make_array_from_process_local_data(
+            NamedSharding(self._mesh, P("data", None)),
+            info,
+            (n_dev, 4),
+        )
+        gather = jax.jit(
+            shard_map(
+                lambda x: jax.lax.all_gather(x, "data", tiled=True),
+                mesh=self._mesh,
+                in_specs=(P("data", None),),
+                out_specs=P(None, None),
+                check_rep=False,
+            )
+        )
+        with self._mesh:
+            out = gather(g)
+        table = np.asarray(out.addressable_shards[0].data)
+        return [
+            tuple(int(v) for v in table[p * n_local])
+            for p in range(n_proc)
+        ]
+
+    def _try_assemble_from_mirrors(self, abstract, floor, allow_stale):
+        """Rebuild the full TrainState from surviving mirrors — no disk.
+
+        COLLECTIVE: every rank of the new world must call with the same
+        arguments; all decisions derive from the all-gathered summary so
+        ranks cannot diverge. Returns True when ``self._ts`` was set.
+        ``allow_stale=False`` refuses when a checkpoint directory is
+        newer than the mirrors (first attempt; the checkpoint loop runs,
+        then a second attempt with True catches torn checkpoints)."""
+        from elasticdl_tpu.common.pytree import key_path_names
+
+        info = self._gather_mirror_info()
+        plan = plan_mirror_assembly(info, floor, allow_stale)
+        if plan is None:
+            if any(has for has, _, _, _ in info):
+                logger.warning(
+                    "replica plane cannot cover the old world (gap or "
+                    "stale mirrors) — falling back to checkpoints"
+                )
+            return False
+        target_v, n_old, alive_blocks = plan
+        seen_blocks = set(alive_blocks)
+
+        # my contributions: own block always; my replica only when its
+        # owner is gone (keeps contributed ranges disjoint)
+        m = self._mirror
+        blocks = []
+        if (
+            m is not None
+            and m.version == target_v
+            and m.n_old == n_old
+            and alive_blocks.get(m.own_block) == self._spec.process_id
+        ):
+            blocks.append((m.own_block, m.own))
+            left = (m.own_block - 1) % n_old
+            if left not in seen_blocks:
+                blocks.append((left, m.replica))
+
+        # sharded leaf metadata from the abstract state (joiners need
+        # shapes/dtypes without holding any data)
+        meta = {}
+
+        def collect(key_path, leaf, spec):
+            if _is_sharded_spec(spec):
+                names = tuple(key_path_names(key_path))
+                meta[names] = (tuple(leaf.shape), leaf.dtype)
+
+        jax.tree_util.tree_map_with_path(
+            collect, abstract, self._state_specs
+        )
+
+        n_proc_new = self._spec.num_processes
+        n_local = jax.local_device_count()
+        n_dev = self._mesh.devices.size
+        me = self._spec.process_id
+
+        psum_specs = {
+            path: P("data", *([None] * len(shape)))
+            for path, (shape, _) in meta.items()
+        }
+        exchange = jax.jit(
+            shard_map(
+                lambda tree: jax.tree_util.tree_map(
+                    lambda x: jax.lax.psum(x, "data"), tree
+                ),
+                mesh=self._mesh,
+                in_specs=(psum_specs,),
+                out_specs={
+                    path: P(*([None] * (len(shape) + 1)))
+                    for path, (shape, _) in meta.items()
+                },
+                check_rep=False,
+            )
+        )
+
+        my_shards = {}
+        for r in range(n_proc_new):
+            bufs = {}
+            for path, (shape, dtype) in meta.items():
+                v_rows = shape[0]
+                rows_new = v_rows // n_proc_new
+                lo = r * rows_new
+                # device slot 0 carries the process contribution; the
+                # other local slots stay zero so the psum over devices
+                # is an exact sum over processes
+                buf = np.zeros(
+                    (n_local, rows_new) + tuple(shape[1:]), dtype
+                )
+                rows_old = v_rows // n_old
+                for blk, arrs in blocks:
+                    blo = blk * rows_old
+                    s = max(lo, blo)
+                    e = min(lo + rows_new, blo + rows_old)
+                    if s < e:
+                        buf[0, s - lo : e - lo] = arrs[path][
+                            s - blo : e - blo
+                        ]
+                bufs[path] = buf
+            placed = {
+                path: jax.make_array_from_process_local_data(
+                    NamedSharding(self._mesh, psum_specs[path]),
+                    buf,
+                    (n_dev,) + buf.shape[1:],
+                )
+                for path, buf in bufs.items()
+            }
+            with self._mesh:
+                out = exchange(placed)
+            if r == me:
+                my_shards = {
+                    path: np.asarray(
+                        arr.addressable_shards[0].data
+                    )[0]
+                    for path, arr in out.items()
+                }
+
+        # replicated leaves: the broadcast SOURCE must be a rank the
+        # plan knows holds a target_v mirror — blindly using rank 0
+        # would adopt its zero stand-ins when rank 0's own refresh
+        # failed or it is a joiner, silently zeroing every dense
+        # parameter and optimizer slot. Any participant works; pick the
+        # lowest rank deterministically (identical plan on every rank).
+        source_rank = min(alive_blocks.values())
+        if m is not None and m.version == target_v:
+            repl_host = m.replicated
+        else:
+
+            def stand_in(key_path, leaf, spec):
+                if _is_sharded_spec(spec):
+                    return np.zeros((), np.int8)
+                return np.zeros(tuple(leaf.shape), leaf.dtype)
+
+            repl_host = jax.tree_util.tree_map_with_path(
+                stand_in, abstract, self._state_specs
+            )
+        repl = broadcast_from_device0(
+            self._mesh, repl_host, source_process=source_rank
+        )
+
+        def combine(key_path, leaf, spec, broadcasted):
+            names = tuple(key_path_names(key_path))
+            if _is_sharded_spec(spec):
+                local = my_shards[names]
+                return jax.make_array_from_process_local_data(
+                    NamedSharding(self._mesh, spec),
+                    local,
+                    tuple(leaf.shape),
+                )
+            return broadcasted
+
+        self._ts = jax.tree_util.tree_map_with_path(
+            combine, abstract, self._state_specs, repl
+        )
+        version = max(target_v, floor)
+        self._ts = self._ts.replace(
+            version=place_from_host_specs(
+                self._mesh, np.int32(version), P()
+            )
+        )
+        logger.info(
+            "sharded state reassembled from the replica plane at v%d "
+            "(no disk; %d/%d old blocks alive)",
+            target_v,
+            len(seen_blocks),
+            n_old,
+        )
+        return True
 
     def _check_shard_divisibility(self, abstract_ts):
         """Every sharded leaf must split evenly over the NEW world's mesh.
@@ -785,23 +1252,112 @@ class ElasticDPTrainer:
             (self._mesh.devices.size,),
         )
         self._host_step += 1
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(self._seed), self._host_step
-        )
-        with self._mesh:
-            new_ts, loss, n, epoch_seen = self._step_fn(
-                self._ts, g_features, g_labels, g_weights, g_epochs, rng
+        host_step = self._host_step
+
+        def _dispatch():
+            # everything device-touching — eager PRNG ops, the jit
+            # call, the sync fetches — runs on the sacrificial thread
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed), host_step
             )
+            with self._mesh:
+                new_ts, loss, n, epoch_seen = self._step_fn(
+                    self._ts,
+                    g_features,
+                    g_labels,
+                    g_weights,
+                    g_epochs,
+                    rng,
+                )
+            if not sync:
+                return new_ts, None, None, None
+            return (
+                new_ts,
+                float(host_copy(loss)),
+                int(host_copy(n)),
+                int(host_copy(epoch_seen)),
+            )
+
+        new_ts, loss_v, n_v, epoch_seen_v = self._escapable(_dispatch)
         self._ts = new_ts
         if not sync:
             return None, None, count
         # the fetch proves every dispatched collective up to here
         # completed; checkpoint that state as the re-form fallback
-        loss_v = float(host_copy(loss))
-        n_v = int(host_copy(n))
-        self.epoch_consensus = int(host_copy(epoch_seen))
+        self.epoch_consensus = epoch_seen_v
         self._checked_ts = new_ts
         return loss_v, n_v, count
+
+    def _escapable(self, fn):
+        """Run a device-touching callable so the host thread can escape
+        a wedged backend.
+
+        A peer death can block ANY backend interaction forever in C++ —
+        not just fetches: observed stacks show eager op dispatch
+        (PRNGKey) and the jit call itself wedging, because the CPU
+        collectives backend executes on the calling thread and the
+        listening side of a dead gloo socket just waits (only the
+        connected side gets a reset error). A blocked host thread
+        cannot poll the master, so the fencer kills a healthy rank and
+        turns one process failure into two — exactly the adjacent
+        double failure the replica plane cannot cover.
+
+        So every device interaction runs on a sacrificial DAEMON
+        thread (daemon, not an executor: concurrent.futures joins its
+        workers at interpreter exit, so one abandoned wedged thread
+        would hang the process forever at shutdown — exactly the
+        zombie state this exists to avoid); the host waits with the
+        worker-provided ``abort_check`` probe (no hard timeout — a
+        first-step compile legitimately takes minutes). When the
+        master has already moved the world on, the host abandons the
+        stuck thread (left parked in the dead gloo op), marks the
+        trainer wedged, and raises WorldBroken — the ordinary
+        failed-step recovery path, with this rank's host state intact
+        for the replica-plane reassembly."""
+        import queue as _queue
+        import threading as _threading
+        import time as _time
+
+        out = _queue.Queue(maxsize=1)
+
+        def runner():
+            try:
+                out.put((True, fn()))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                out.put((False, e))
+
+        t = _threading.Thread(
+            target=runner, name="edl-device", daemon=True
+        )
+        t.start()
+        t0 = _time.monotonic()
+        last_check = t0
+        while True:
+            try:
+                ok, value = out.get(timeout=0.05)
+            except _queue.Empty:
+                pass
+            else:
+                if ok:
+                    return value
+                raise value
+            now = _time.monotonic()
+            if (
+                self.abort_check is not None
+                and now - t0 >= 2.0
+                and now - last_check >= 1.0
+            ):
+                last_check = now
+                try:
+                    moved_on = self.abort_check()
+                except Exception:
+                    moved_on = False
+                if moved_on:
+                    self._wedged = True
+                    raise distributed.WorldBroken(
+                        "world moved on while this rank's device "
+                        "stream was wedged by a peer loss"
+                    )
 
     def validate(self):
         """Force-complete all dispatched work; True if it all succeeded.
@@ -812,8 +1368,12 @@ class ElasticDPTrainer:
         """
         if self._ts is None:
             return True
+        if self._wedged:
+            # a fetch already wedged on this world: touching the device
+            # again would block forever — the state is unvalidatable
+            return False
         try:
-            host_copy(self._ts.version)
+            self._escapable(lambda: host_copy(self._ts.version))
         except Exception:
             logger.warning("validation failed: a dispatched step errored")
             return False
@@ -831,6 +1391,10 @@ class ElasticDPTrainer:
         mechanism."""
         if self._sharded_paths:
             return None
+        if self._wedged:
+            # device fetches block forever on a wedged stream; the last
+            # validated host snapshot is the only safe source
+            return self._host_ts
         if self._ts is not None:
             try:
                 self._host_ts = host_copy(self._ts)
@@ -883,6 +1447,21 @@ class ElasticDPTrainer:
                 "state snapshot failed; re-form will use the previous one",
                 exc_info=True,
             )
+        if (
+            self._spec is not None
+            and self._spec.process_id == 0
+            and self._spec.num_processes > 1
+            and not self._wedged
+        ):
+            # the coordination service lives in THIS process: at a
+            # synchronized pause every member leaves at once, and a
+            # peer whose disconnect RPC races this teardown FATALs in
+            # C++ (uncatchable LOG(FATAL) — a clean drain turns into a
+            # crash exit). Rank 0 lingers briefly so peers disconnect
+            # against a live coordinator first.
+            import time as _time
+
+            _time.sleep(1.5)
         distributed.leave_world()
         self._ts = None
         self._checked_ts = None

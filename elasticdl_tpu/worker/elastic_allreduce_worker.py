@@ -81,6 +81,7 @@ class ElasticAllReduceWorker:
         checkpoint_filename_for_init="",
         prediction_outputs_processor="PredictionOutputsProcessor",
         remat="",
+        replica_refresh_steps=8,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -250,6 +251,17 @@ class ElasticAllReduceWorker:
             distributed_builder=builder,
             remat=parse_remat(remat),
         )
+        # in-memory replica plane: bounded-staleness no-disk recovery
+        # for the sharded leaves (parallel/elastic.py ShardMirror);
+        # 0 disables. The flag reaches every rank identically via the
+        # arg relay, which the collective refresh relies on.
+        self.trainer.mirror_steps = max(0, int(replica_refresh_steps))
+        # escapable sync waits: a peer death can wedge this rank's fetch
+        # forever (gloo listener-side hang); the trainer polls this hook
+        # while waiting so a wedged rank notices the master has moved
+        # the world on and takes the failed-step recovery path instead
+        # of getting fenced (state intact for the replica plane)
+        self.trainer.abort_check = self._world_moved_on
         self._task_data_service = TaskDataService(
             self,
             self._job_type == JobType.TRAINING_WITH_EVALUATION,
@@ -459,6 +471,9 @@ class ElasticAllReduceWorker:
                 self._worker_id, self._host, awaiting=True
             )
             if w.get("ready"):
+                # member ids of this world: the wedge-escape probe needs
+                # them to tell "one of MY peers died" from growth/drain
+                self._world_members = list(w.get("members", ()))
                 return WorldSpec(
                     coordinator=w["coordinator"],
                     num_processes=w["num_processes"],
@@ -606,6 +621,33 @@ class ElasticAllReduceWorker:
                 return None
             time.sleep(0.2)
 
+    def _world_moved_on(self):
+        """The trainer's escapable-wait abort probe: True only when the
+        master's epoch is past this process's world AND one of this
+        world's members actually DIED (watch/fence removal). A growth
+        bump or a graceful drain also advances the epoch, but every
+        member of the current world is still stepping then — aborting a
+        healthy (merely slow, e.g. compiling) dispatch would break the
+        very collective the consensus pause protects."""
+        from elasticdl_tpu.parallel import distributed
+
+        spec = distributed.current_spec()
+        if spec is None:
+            return False
+        try:
+            w = self._stub.get_comm_world(
+                self._worker_id, self._host, awaiting=False
+            )
+        except Exception:
+            return False
+        if int(w.get("epoch", spec.epoch)) <= spec.epoch:
+            return False
+        dead = set(w.get("dead", ()))
+        members = getattr(self, "_world_members", None) or ()
+        return any(
+            m in dead for m in members if m != self._worker_id
+        )
+
     def _flush_unreported(self, err_msg=""):
         """Report record counts held back while their steps were
         unvalidated. With an err_msg the consumed-but-unapplied records
@@ -744,6 +786,12 @@ class ElasticAllReduceWorker:
             if batch is not None:
                 self._unreported.append(count)
             if sync:
+              # a peer death can surface here as WorldBroken from the
+              # escapable waits inside the cadence fetches / the pause
+              # refresh (trainer._await_ready): take the same reform
+              # path as a failed step — the just-synced window already
+              # validated and flushed, so no accounting is lost
+              try:
                 self._flush_unreported()
                 self._alarm_on_embedding_overflow()
                 consensus = self.trainer.epoch_consensus
@@ -760,6 +808,22 @@ class ElasticAllReduceWorker:
                         world.epoch,
                         consensus,
                     )
+                    if self.trainer.mirror_enabled():
+                        # the pause is the one point where EVERY member
+                        # (a draining victim included) sits at the same
+                        # step: a refresh here makes the upcoming
+                        # reform's replica-plane assembly LOSSLESS — the
+                        # victim's shards ride the ppermute to its
+                        # neighbor at the pause version, no disk needed
+                        try:
+                            self.trainer.refresh_mirror()
+                        except Exception:
+                            logger.warning(
+                                "pause-point replica refresh failed; "
+                                "reform falls back to the last refresh "
+                                "or checkpoints",
+                                exc_info=True,
+                            )
                     return self._settle_and_leave("reform")
                 if (
                     self._ckpt is not None
@@ -790,6 +854,36 @@ class ElasticAllReduceWorker:
                     ):
                         self._ckpt.save(self.trainer._ts, version)
                         self._last_ckpt_version = version
+                if aligned_sync and self.trainer.mirror_enabled():
+                    # replica-plane cadence: same aligned-sync trigger
+                    # discipline as the checkpoint cadence (the refresh
+                    # is a collective — every rank must take it at the
+                    # same step, which the version-based predicate
+                    # guarantees)
+                    self.trainer.maybe_refresh_mirror(
+                        self.trainer.version
+                    )
+              except Exception as cadence_err:
+                # the reform path is only for WORLD failures — a peer
+                # loss surfacing as WorldBroken (escaped wedge) or as a
+                # raw collective/runtime error from the cadence
+                # refresh/fetches. Local errors (e.g. a checkpoint-save
+                # disk failure) must propagate untouched: tearing down
+                # a healthy world for them would break peers' in-flight
+                # collectives for nothing.
+                from jax.errors import JaxRuntimeError
+
+                if not isinstance(
+                    cadence_err, (WorldBroken, JaxRuntimeError)
+                ):
+                    raise
+                logger.exception(
+                    "world broke during the sync cadence; re-forming"
+                )
+                self._settle_and_leave("reform", validate=False)
+                if not self._await_epoch_bump(world.epoch):
+                    raise
+                return "reform"
             if n_active == 0:
                 # global quiescence: every rank observes it in the same
                 # collective round with the same (final) version. Sharded

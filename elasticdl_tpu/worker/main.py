@@ -5,6 +5,7 @@ live in rpc.core), optional PS channels from ``--ps_addrs``, then runs the
 task loop to completion.
 """
 
+import os
 import sys
 
 from elasticdl_tpu.common.args import (
@@ -78,6 +79,7 @@ def _run(args):
             precision=args.precision_policy or None,
             accum_steps=args.grad_accum_steps,
             remat=args.remat,
+            replica_refresh_steps=args.replica_refresh_steps,
         )
         # graceful preemption: cloud preemptions / pod evictions send
         # SIGTERM with notice — drain at the next batch boundary
@@ -87,8 +89,18 @@ def _run(args):
         worker.run()
         if worker._preempted:
             # distinct exit code: the instance manager relaunches a
-            # replacement (exit 0 would read as "job done for me")
-            return ElasticAllReduceWorker.PREEMPTED_EXIT_CODE
+            # replacement (exit 0 would read as "job done for me").
+            # Hard exit, skipping atexit teardown: the drained world is
+            # being torn down by every member at once, and a
+            # jax.distributed.shutdown whose coordinator (rank 0's
+            # process) already left FATALs in C++ — turning a clean
+            # drain into a crash exit. Checkpoint writes were drained
+            # in _finalize; there is nothing left worth tearing down.
+            import sys as _sys
+
+            _sys.stderr.flush()
+            _sys.stdout.flush()
+            os._exit(ElasticAllReduceWorker.PREEMPTED_EXIT_CODE)
         return 0
 
     warn_accum_unsupported(args, "the parameter-server worker")

@@ -468,6 +468,8 @@ def _worker_env():
             "EDL_WORLD_INIT_TIMEOUT": "10",
             "EDL_HEARTBEAT_TIMEOUT": "10",
             "EDL_SHUTDOWN_TIMEOUT": "5",
+            # fenced/wedged workers dump all-thread stacks on SIGABRT
+            "PYTHONFAULTHANDLER": "1",
         }
     )
     # the parent test process pins these for its own virtual mesh; they
@@ -556,7 +558,7 @@ def test_elastic_allreduce_graceful_preemption_drain(tmp_path):
         384, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(data_dir)
     )
     log_dir = str(tmp_path / "logs")
-    master = _master_for(str(data_dir), num_workers=3, num_epochs=2)
+    master = _master_for(str(data_dir), num_workers=3, num_epochs=6)
     completed = _count_successes(master.task_d)
 
     manager = LocalInstanceManager(
@@ -576,7 +578,7 @@ def test_elastic_allreduce_graceful_preemption_drain(tmp_path):
     runner.start()
 
     deadline = time.time() + 240
-    while len(completed) < 2:
+    while len(completed) < 1:
         assert time.time() < deadline, "job made no progress"
         assert runner.is_alive(), "master exited early"
         time.sleep(0.5)
@@ -588,7 +590,7 @@ def test_elastic_allreduce_graceful_preemption_drain(tmp_path):
     runner.join(timeout=420)
     assert not runner.is_alive(), "master did not finish after the drain"
     assert master.task_d.finished()
-    assert len(set(completed)) == 12
+    assert len(set(completed)) == 36
     # the terminated worker exited through the graceful-drain path
     assert manager.exit_codes.get(("worker", victim)) == 75, (
         manager.exit_codes
