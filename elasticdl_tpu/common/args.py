@@ -115,6 +115,14 @@ def add_common_params(parser):
         "--num_workers", type=int, default=0, help="Number of workers"
     )
     parser.add_argument(
+        "--num_standby_workers",
+        type=non_neg_int,
+        default=0,
+        help="Pre-warmed spare workers (elastic allreduce): parked "
+        "after paying their cold start, promoted on a death so "
+        "recovery is membership-only",
+    )
+    parser.add_argument(
         "--worker_resource_request",
         default="cpu=1,memory=4096Mi",
         help="Worker resource request (a TPU worker requests tpu=N here)",
@@ -396,6 +404,14 @@ def parse_worker_args(worker_args=None):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument(
         "--replica_refresh_steps", type=non_neg_int, default=8
+    )
+    add_bool_param(
+        parser,
+        "--standby",
+        False,
+        help="Start as a pre-warmed spare: pay the cold start (jax "
+        "import) now, park until the master promotes this process "
+        "with a real worker id (elastic allreduce only)",
     )
     parser.add_argument(
         "--checkpoint_filename_for_init",

@@ -110,6 +110,7 @@ class InstanceManager:
         membership=None,
         max_relaunches=64,
         k8s_client=None,
+        num_standby=0,
         **kwargs,
     ):
         self._task_d = task_d
@@ -144,6 +145,12 @@ class InstanceManager:
         # (a PS that never comes back wedges every worker's pulls)
         self._relaunch_budget = {WORKER: max_relaunches, PS: max_relaunches}
         self._fresh_worker_id = itertools.count().__next__
+        # pre-warmed spare pods (elastic allreduce): spawned with
+        # --standby, parked in the membership StandbyPool; a death
+        # promotes one (membership-only recovery) instead of paying a
+        # pod schedule + image pull + jax import cold start
+        self._num_standby = num_standby if membership is not None else 0
+        self._standby_pods = {}  # token -> pod name
 
         self._client = k8s_client or k8s.Client(
             event_callback=self.handle_pod_event, **kwargs
@@ -159,7 +166,7 @@ class InstanceManager:
 
     # -- launches -----------------------------------------------------------
 
-    def _launch(self, kind, instance_id):
+    def _launch(self, kind, instance_id, extra_args=()):
         spec = self._launch_spec[kind]
         common = dict(
             resource_requests=spec["resource_requests"],
@@ -181,7 +188,8 @@ class InstanceManager:
                     worker_id=instance_id,
                     args=spec["args"]
                     + ["--worker_id", str(instance_id)]
-                    + ["--ps_addrs", self._ps_addrs],
+                    + ["--ps_addrs", self._ps_addrs]
+                    + list(extra_args),
                     **common,
                 )
             else:
@@ -191,12 +199,47 @@ class InstanceManager:
                     **common,
                 )
             self._fleets[kind].track(pod.metadata.name, instance_id)
+            if extra_args and kind == WORKER:
+                self._standby_pods[instance_id] = pod.metadata.name
         if kind == PS:
             self._client.create_ps_service(instance_id)
+        return pod
 
     def start_workers(self):
         for _ in range(self._num_workers):
             self._launch(WORKER, self._fresh_worker_id())
+        for _ in range(self._num_standby):
+            self._launch_standby()
+
+    def _launch_standby(self):
+        # tracked in the worker fleet under its token id: a standby pod
+        # death flows through the ordinary DELETED handling
+        # (recover_tasks of a never-registered id is a no-op)
+        token = self._fresh_worker_id()
+        self._launch(WORKER, token, extra_args=("--standby", "true"))
+        return token
+
+    def _promote_standby(self):
+        """Assign a fresh worker id to a warmed standby pod; returns the
+        new id or None (caller launches a cold pod instead)."""
+        if self._membership is None:
+            return None
+        new_id = self._fresh_worker_id()
+        token = self._membership.standby.activate(new_id)
+        if token is None:
+            return None
+        with self._lock:
+            pod_name = self._standby_pods.pop(token, None)
+            if pod_name is None:
+                # the standby pod vanished between activate and now; a
+                # cold launch must replace the dead worker instead
+                return None
+            # re-track the pod under its REAL id so its eventual death
+            # recovers the right worker's tasks
+            self._fleets[WORKER].drop(pod_name)
+            self._fleets[WORKER].track(pod_name, new_id)
+        self._launch_standby()
+        return new_id
 
     def start_all_ps(self):
         for ps_id in range(self._num_ps):
@@ -242,11 +285,26 @@ class InstanceManager:
             decision.recover,
             decision.relaunch,
         )
+        if kind == WORKER and instance_id in self._standby_pods:
+            # a spare died before promotion: forget it, refill the pool
+            self._standby_pods.pop(instance_id, None)
+            if self._membership is not None:
+                self._membership.standby.forget(instance_id)
+            if decision.relaunch:
+                self._launch_standby()
+            return
         if decision.recover:
             self._task_d.recover_tasks(instance_id)
             if self._membership is not None:
                 self._membership.remove(instance_id)
         if decision.relaunch:
+            if kind == WORKER and decision.new_id:
+                promoted = self._promote_standby()
+                if promoted is not None:
+                    logger.info(
+                        "Promoted a warmed standby as worker %d", promoted
+                    )
+                    return
             self._launch(
                 kind,
                 self._fresh_worker_id() if decision.new_id else instance_id,

@@ -30,6 +30,7 @@ class LocalInstanceManager:
         env=None,
         membership=None,
         log_dir=None,
+        num_standby=0,
     ):
         """``worker_command(worker_id) -> argv``; ``ps_command(ps_id) ->
         argv``. Worker ids grow monotonically across relaunches like the
@@ -50,9 +51,15 @@ class LocalInstanceManager:
         self._max_relaunches = max_relaunches
         self._env = env
         self._log_dir = log_dir  # per-instance output files (tests/debug)
+        # pre-warmed spares (elastic allreduce only): each pays its cold
+        # start at spawn and parks in the membership StandbyPool; a
+        # death promotes one instead of relaunching cold, converting the
+        # ~45-50 s relaunch cost into membership-only recovery
+        self._num_standby = num_standby if membership is not None else 0
 
         self._lock = threading.Lock()
         self._procs = {}  # instance key -> Popen
+        self._rekeyed = {}  # id(proc) -> current key (standby promotions)
         self.exit_codes = {}  # instance key -> last observed returncode
         self._next_worker_id = 0
         self._relaunches = 0
@@ -90,7 +97,43 @@ class LocalInstanceManager:
     def start_workers(self):
         for _ in range(self._num_workers):
             self._start_worker()
+        for _ in range(self._num_standby):
+            self._start_standby()
         self.status = InstanceManagerStatus.RUNNING
+
+    def _start_standby(self):
+        with self._lock:
+            if self._stopping:
+                return None
+            token = self._next_worker_id
+            self._next_worker_id += 1
+        argv = list(self._worker_command(token)) + ["--standby", "true"]
+        self._spawn(("standby", token), argv)
+        return token
+
+    def _promote_standby(self):
+        """Assign the next worker id to a WARMED standby; returns the
+        new worker id, or None (caller falls back to a cold relaunch).
+        The promoted process is re-keyed so fencing/kill/terminate by
+        worker id reach it, and a fresh standby refills the pool."""
+        if self._membership is None:
+            return None
+        with self._lock:
+            new_id = self._next_worker_id
+            self._next_worker_id += 1
+        token = self._membership.standby.activate(new_id)
+        if token is None:
+            return None
+        with self._lock:
+            proc = self._procs.pop(("standby", token), None)
+            if proc is None:
+                # the standby died between activate and now; its watch
+                # thread will forget the token
+                return None
+            self._procs[("worker", new_id)] = proc
+            self._rekeyed[id(proc)] = ("worker", new_id)
+        self._start_standby()
+        return new_id
 
     def _start_worker(self):
         with self._lock:
@@ -104,17 +147,43 @@ class LocalInstanceManager:
     def _watch(self, key, proc):
         returncode = proc.wait()
         with self._lock:
+            key = self._rekeyed.pop(id(proc), key)
             self.exit_codes[key] = returncode
             if self._procs.get(key) is not proc or self._stopping:
                 return
             del self._procs[key]
         kind, instance_id = key
+        if kind == "standby":
+            # a spare died before promotion: forget its token, refill
+            if self._membership is not None:
+                self._membership.standby.forget(instance_id)
+            if not self._stopping:
+                self._start_standby()
+            return
         if kind == "worker":
             # reference k8s_instance_manager.py:207 — a dead worker's
             # in-flight tasks go back on the todo queue
             self._task_d.recover_tasks(instance_id)
             if self._membership is not None:
-                self._membership.remove(instance_id)
+                # with a warmed standby about to be promoted, defer the
+                # bump briefly: one combined formation instead of a
+                # shrink re-form chased by a growth pause
+                will_promote = (
+                    returncode not in (0,)
+                    and self._restart_policy != "Never"
+                    and self._membership.standby.parked_count() > 0
+                    # exit 75 (drain) skips the budget; crashes consume
+                    # it — deferring for a promotion the budget forbids
+                    # would stall survivors 6 s for nothing
+                    and (
+                        returncode == 75
+                        or self._relaunches < self._max_relaunches
+                    )
+                )
+                self._membership.remove(
+                    instance_id,
+                    defer_bump_secs=6.0 if will_promote else 0,
+                )
             if returncode == 0:
                 logger.info("Worker %d completed", instance_id)
                 return
@@ -122,7 +191,9 @@ class LocalInstanceManager:
                 # benign: does NOT consume the crash-relaunch budget —
                 # a spot fleet drains repeatedly and each drain is fine
                 if self._restart_policy != "Never":
-                    new_id = self._start_worker()
+                    new_id = self._promote_standby()
+                    if new_id is None:
+                        new_id = self._start_worker()
                     logger.info(
                         "Worker %d drained under a preemption notice; "
                         "relaunched replacement as id %d",
@@ -146,8 +217,14 @@ class LocalInstanceManager:
                 and self._relaunches < self._max_relaunches
             ):
                 self._relaunches += 1
-                new_id = self._start_worker()
-                logger.info("Relaunched worker as id %d", new_id)
+                new_id = self._promote_standby()
+                if new_id is not None:
+                    logger.info(
+                        "Promoted a warmed standby as worker %d", new_id
+                    )
+                else:
+                    new_id = self._start_worker()
+                    logger.info("Relaunched worker as id %d", new_id)
         else:
             logger.warning(
                 "PS %d exited with %d; relaunching same id",

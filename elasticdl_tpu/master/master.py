@@ -309,6 +309,7 @@ class Master:
             self.task_d,
             membership=self.membership,
             num_workers=args.num_workers,
+            num_standby=getattr(args, "num_standby_workers", 0),
             worker_command=["python"],
             worker_args=worker_args,
             worker_resource_request=args.worker_resource_request,

@@ -57,6 +57,53 @@ def _free_port():
         return s.getsockname()[1]
 
 
+class StandbyPool:
+    """Pre-warmed spare workers, parked until a death needs one.
+
+    A standby process pays its cold start (jax import, module loading)
+    up front and then polls :meth:`poll` with its token; it is invisible
+    to membership until the instance manager :meth:`activate`-s it with
+    a real worker id, at which point the poll returns that id and the
+    standby proceeds into the ordinary worker path. This converts the
+    relaunch cost of a kill — measured at ~45-50 s of the ~65 s total
+    recovery in BASELINE.md r3, almost all of it a fresh process
+    importing jax — into membership-only cost."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._parked = {}  # token -> assigned worker id (None = parked)
+
+    def poll(self, token):
+        """Standby heartbeat; registers the token on first call and
+        returns the assigned worker id once activated (else None)."""
+        with self._lock:
+            if token not in self._parked:
+                self._parked[token] = None
+            return self._parked[token]
+
+    def activate(self, worker_id):
+        """Hand ``worker_id`` to any parked standby; returns its token,
+        or None when no WARMED standby is available (a spawned-but-not-
+        yet-polling process is still paying its cold start and would
+        give no head start)."""
+        with self._lock:
+            for token, assigned in self._parked.items():
+                if assigned is None:
+                    self._parked[token] = worker_id
+                    return token
+            return None
+
+    def forget(self, token):
+        with self._lock:
+            self._parked.pop(token, None)
+
+    def parked_count(self):
+        with self._lock:
+            return sum(
+                1 for v in self._parked.values() if v is None
+            )
+
+
 class MembershipService:
     def __init__(
         self,
@@ -124,6 +171,8 @@ class MembershipService:
         # only fires when one of ITS world members is here — a growth
         # bump or a drain must never abort a healthy (slow) step
         self._dead = set()
+        self.standby = StandbyPool()
+        self._pending_bump_deadline = None  # deferred death bump
 
     def set_fencer(self, fencer):
         """``fencer(worker_id)`` forcibly terminates a dropped member.
@@ -150,6 +199,7 @@ class MembershipService:
         return not self._world_ready or not ids <= self._formed
 
     def _bump_locked(self):
+        self._pending_bump_deadline = None
         # any parked joiners ride along with whatever forced this bump
         self._live.update(self._lobby)
         self._lobby = {}
@@ -213,12 +263,21 @@ class MembershipService:
                 self._live[worker_id] = host
                 self._bump_locked()
 
-    def remove(self, worker_id, departing=False):
+    def remove(self, worker_id, departing=False, defer_bump_secs=0):
         """Drop a member and bump. ``departing=True`` is the graceful
         drain verb (worker-initiated, BEFORE its process exits): the id
         is additionally blacklisted from re-registration, because the
         draining worker keeps polling until it observes the bump — the
-        poll-and-register semantics would otherwise re-add it."""
+        poll-and-register semantics would otherwise re-add it.
+
+        ``defer_bump_secs > 0``: the instance manager is promoting a
+        pre-warmed standby for this death, so the bump waits briefly for
+        the replacement's registration — one N→N formation instead of an
+        N→N-1 re-form (with its throwaway step compile) immediately
+        followed by an N-1→N growth pause. The member is dropped from
+        ``_live`` (and listed ``dead``) NOW, so survivors' wedge-escape
+        probes still fire instantly; a second death, the replacement's
+        register, or the deadline ends the deferral."""
         with self._lock:
             if departing:
                 self._departing.add(worker_id)
@@ -229,9 +288,24 @@ class MembershipService:
                 return
             del self._live[worker_id]
             if self._formed_initial:
-                # push-based: deaths re-form immediately — survivors in the
-                # broken collective fail fast and re-poll, so the job never
-                # waits out a detection window
+                if (
+                    defer_bump_secs > 0
+                    and self._pending_bump_deadline is None
+                ):
+                    self._pending_bump_deadline = (
+                        time.time() + defer_bump_secs
+                    )
+                    logger.info(
+                        "death of %d: bump deferred up to %.1fs for a "
+                        "standby promotion",
+                        worker_id,
+                        defer_bump_secs,
+                    )
+                    return
+                # push-based: deaths re-form immediately — survivors in
+                # the broken collective fail fast and re-poll, so the
+                # job never waits out a detection window
+                self._pending_bump_deadline = None
                 self._bump_locked()
 
     def get_world(self, worker_id, host="localhost", awaiting=True):
@@ -265,6 +339,14 @@ class MembershipService:
     def _get_world_locked(self, worker_id, now, awaiting, to_fence):
         with self._lock:
             self._last_poll[worker_id] = now
+            if (
+                self._pending_bump_deadline is not None
+                and now >= self._pending_bump_deadline
+            ):
+                # the promoted standby never registered in time: stop
+                # holding the survivors and re-form without it (it joins
+                # later as ordinary growth)
+                self._bump_locked()
             if not self._formed_initial:
                 grace_over = (
                     self._first_register_time is not None

@@ -133,11 +133,24 @@ class MasterRpcService:
             )
         return {}
 
+    def standby_poll(self, req):
+        """Pre-warmed spare worker heartbeat (see StandbyPool): returns
+        the assigned worker id once the instance manager promotes this
+        standby, else None."""
+        if self._membership is None:
+            return {"worker_id": None}
+        return {
+            "worker_id": self._membership.standby.poll(
+                int(req.get("token", -1))
+            )
+        }
+
     def rpc_methods(self):
         return {
             "get_task": self.get_task,
             "get_comm_world": self.get_comm_world,
             "leave_comm_world": self.leave_comm_world,
+            "standby_poll": self.standby_poll,
             "get_model": self.get_model,
             "report_variable": self.report_variable,
             "report_gradient": self.report_gradient,
@@ -259,6 +272,11 @@ class MasterClient:
         return self._client.call(
             "leave_comm_world", worker_id=int(worker_id)
         )
+
+    def standby_poll(self, token):
+        return self._client.call("standby_poll", token=int(token))[
+            "worker_id"
+        ]
 
     def close(self):
         self._client.close()

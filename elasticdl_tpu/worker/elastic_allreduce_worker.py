@@ -622,13 +622,15 @@ class ElasticAllReduceWorker:
             time.sleep(0.2)
 
     def _world_moved_on(self):
-        """The trainer's escapable-wait abort probe: True only when the
-        master's epoch is past this process's world AND one of this
-        world's members actually DIED (watch/fence removal). A growth
-        bump or a graceful drain also advances the epoch, but every
-        member of the current world is still stepping then — aborting a
-        healthy (merely slow, e.g. compiling) dispatch would break the
-        very collective the consensus pause protects."""
+        """The trainer's escapable-wait abort probe: True when one of
+        this world's members actually DIED (watch/fence removal) — its
+        collectives are unrecoverable and a bump is coming (possibly
+        deferred for a standby promotion, so the epoch alone is NOT the
+        gate: waiting for it would hold a wedged survivor through the
+        whole deferral). A growth bump or a graceful drain advances the
+        epoch while every member is still stepping — those must never
+        abort a healthy (merely slow, e.g. compiling) dispatch, which
+        is why the probe keys on deaths, not epochs."""
         from elasticdl_tpu.parallel import distributed
 
         spec = distributed.current_spec()
@@ -639,8 +641,6 @@ class ElasticAllReduceWorker:
                 self._worker_id, self._host, awaiting=False
             )
         except Exception:
-            return False
-        if int(w.get("epoch", spec.epoch)) <= spec.epoch:
             return False
         dead = set(w.get("dead", ()))
         members = getattr(self, "_world_members", None) or ()

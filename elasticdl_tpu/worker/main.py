@@ -81,6 +81,30 @@ def _run(args):
             remat=args.remat,
             replica_refresh_steps=args.replica_refresh_steps,
         )
+        if getattr(args, "standby", False):
+            # pre-warmed spare: the cold start (jax/flax import chain
+            # plus worker construction — ~all of a relaunch's 45-50 s,
+            # BASELINE.md r3) was just paid ABOVE; park until the master
+            # promotes this process, then adopt the assigned id. No
+            # device is touched while parked (that would pin the
+            # backend and break the world formation after promotion).
+            import time as _time
+
+            from elasticdl_tpu.common.log_utils import (
+                default_logger as logger,
+            )
+
+            token = args.worker_id
+            logger.info("standby %d warmed; parking", token)
+            while True:
+                wid = stub.standby_poll(token)
+                if wid is not None:
+                    logger.info(
+                        "standby %d promoted to worker %d", token, wid
+                    )
+                    worker._worker_id = int(wid)
+                    break
+                _time.sleep(0.5)
         # graceful preemption: cloud preemptions / pod evictions send
         # SIGTERM with notice — drain at the next batch boundary
         # (checkpoint + clean world leave) instead of dying

@@ -470,6 +470,9 @@ def _worker_env():
             "EDL_SHUTDOWN_TIMEOUT": "5",
             # fenced/wedged workers dump all-thread stacks on SIGABRT
             "PYTHONFAULTHANDLER": "1",
+            # shared persistent XLA cache: relaunches/promotions (and
+            # repeated test runs) skip recompiling identical HLO
+            "JAX_COMPILATION_CACHE_DIR": "/tmp/edl-test-xla-cache",
         }
     )
     # the parent test process pins these for its own virtual mesh; they
@@ -503,8 +506,10 @@ def test_elastic_allreduce_two_process_job(tmp_path):
     manager.stop_relaunch_and_remove_all_pods()
 
 
-@pytest.mark.slow
-def test_elastic_allreduce_survives_worker_kill(tmp_path):
+def run_three_worker_job(tmp_path, kill=True):
+    """The 3-worker/2-epoch elastic job, with or without a mid-job
+    SIGKILL — the shared harness for the kill rung and for bench.py
+    --preemption's same-config clean/killed comparison."""
     create_recordio_file(
         384, DatasetName.IMAGE_DEFAULT, (28, 28), temp_dir=str(tmp_path)
     )
@@ -519,6 +524,9 @@ def test_elastic_allreduce_survives_worker_kill(tmp_path):
         env=_worker_env(),
         membership=master.membership,
         max_relaunches=10,
+        # a pre-warmed spare: the kill's relaunch cost becomes
+        # membership-only (the standby already paid its jax import)
+        num_standby=1,
     )
     master.instance_manager = manager
     manager.start_workers()
@@ -527,23 +535,28 @@ def test_elastic_allreduce_survives_worker_kill(tmp_path):
     )
     runner.start()
 
-    # wait for real collective progress, then kill a worker mid-job
-    deadline = time.time() + 240
-    while len(completed) < 2:
-        assert time.time() < deadline, "job made no progress"
-        assert runner.is_alive(), "master exited early"
-        time.sleep(0.5)
-    victims = manager.live_workers()
-    assert victims, "no live workers to kill"
-    manager.kill_worker(victims[-1])
+    if kill:
+        # wait for real collective progress, then kill a worker mid-job
+        deadline = time.time() + 240
+        while len(completed) < 2:
+            assert time.time() < deadline, "job made no progress"
+            assert runner.is_alive(), "master exited early"
+            time.sleep(0.5)
+        victims = manager.live_workers()
+        assert victims, "no live workers to kill"
+        manager.kill_worker(victims[-1])
 
     runner.join(timeout=420)
-    assert not runner.is_alive(), "master did not finish after the kill"
+    assert not runner.is_alive(), "master did not finish"
     assert master.task_d.finished()
-    # every task eventually completed despite the kill (3 workers,
-    # 384*2 records / 64 records-per-task = 12 tasks)
+    # every task completed (3 workers, 384*2 records / 64 per task)
     assert len(set(completed)) == 12
     manager.stop_relaunch_and_remove_all_pods()
+
+
+@pytest.mark.slow
+def test_elastic_allreduce_survives_worker_kill(tmp_path):
+    run_three_worker_job(tmp_path, kill=True)
 
 
 @pytest.mark.slow

@@ -229,3 +229,92 @@ def test_unresponsive_member_gets_fenced():
         assert time.time() < deadline
         time.sleep(0.05)
     assert (WORKER, 0) in client.deleted
+
+
+def test_standby_pool_semantics():
+    from elasticdl_tpu.master.membership_service import StandbyPool
+
+    pool = StandbyPool()
+    # activation before any standby warmed: nothing to promote
+    assert pool.activate(7) is None
+    # a standby registers by polling; unactivated polls return None
+    assert pool.poll(100) is None
+    assert pool.parked_count() == 1
+    token = pool.activate(7)
+    assert token == 100
+    assert pool.poll(100) == 7  # the parked process picks up its id
+    assert pool.parked_count() == 0
+    # a dead standby is forgotten
+    assert pool.poll(101) is None
+    pool.forget(101)
+    assert pool.activate(8) is None
+
+
+def test_local_manager_promotes_warmed_standby(tmp_path):
+    """A worker death promotes a warmed standby (re-keyed under its new
+    worker id, pool refilled) instead of cold-relaunching."""
+    import sys
+    import time
+
+    from elasticdl_tpu.master.local_instance_manager import (
+        LocalInstanceManager,
+    )
+    from elasticdl_tpu.master.membership_service import StandbyPool
+
+    class FakeMembership:
+        def __init__(self):
+            self.standby = StandbyPool()
+            self.removed = []
+
+        def set_fencer(self, fn):
+            pass
+
+        def remove(self, worker_id, departing=False, defer_bump_secs=0):
+            self.removed.append(worker_id)
+
+    class FakeDispatcher:
+        def __init__(self):
+            self.recovered = []
+
+        def recover_tasks(self, worker_id):
+            self.recovered.append(worker_id)
+
+    membership = FakeMembership()
+    task_d = FakeDispatcher()
+
+    def worker_command(worker_id):
+        # inert stand-in processes; the real --standby loop is exercised
+        # by the slow kill rung
+        return [sys.executable, "-c", "import time; time.sleep(120)"]
+
+    manager = LocalInstanceManager(
+        task_d,
+        1,
+        worker_command,
+        env=None,
+        membership=membership,
+        num_standby=1,
+        restart_policy="Always",
+    )
+    manager.start_workers()
+    try:
+        assert set(manager._procs) == {("worker", 0), ("standby", 1)}
+        # the standby warms up (its first poll registers it)
+        assert membership.standby.poll(1) is None
+
+        manager.kill_worker(0)
+        deadline = time.time() + 20
+        while ("worker", 2) not in manager._procs:
+            assert time.time() < deadline, manager._procs
+            time.sleep(0.1)
+        # the standby process became worker 2 (same pid), the dead
+        # worker's tasks recovered, and a fresh standby refilled
+        assert membership.standby.poll(1) == 2
+        assert task_d.recovered == [0]
+        assert membership.removed == [0]
+        deadline = time.time() + 20
+        while not any(k[0] == "standby" for k in manager._procs):
+            assert time.time() < deadline, manager._procs
+            time.sleep(0.1)
+    finally:
+        manager.stop_relaunch_and_remove_all_pods()
