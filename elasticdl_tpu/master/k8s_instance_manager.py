@@ -151,6 +151,7 @@ class InstanceManager:
         # pod schedule + image pull + jax import cold start
         self._num_standby = num_standby if membership is not None else 0
         self._standby_pods = {}  # token -> pod name
+        self._standby_refill_budget = max_relaunches
 
         self._client = k8s_client or k8s.Client(
             event_callback=self.handle_pod_event, **kwargs
@@ -232,7 +233,11 @@ class InstanceManager:
             pod_name = self._standby_pods.pop(token, None)
             if pod_name is None:
                 # the standby pod vanished between activate and now; a
-                # cold launch must replace the dead worker instead
+                # cold launch must replace the dead worker instead — and
+                # the token must be UNASSIGNED, or a briefly-still-alive
+                # container would adopt new_id and join the world as an
+                # untracked extra worker
+                self._membership.standby.forget(token)
                 return None
             # re-track the pod under its REAL id so its eventual death
             # recovers the right worker's tasks
@@ -269,14 +274,42 @@ class InstanceManager:
                 fleet.observe(name, phase)
                 return
             instance_id = fleet.drop(name)
-            decision = decide_on_exit(
-                kind,
-                phase,
-                self._relaunch_on[kind],
-                self._relaunch_budget[kind],
+            is_standby = (
+                kind == WORKER and instance_id in self._standby_pods
             )
-            if decision.relaunch:
-                self._relaunch_budget[kind] -= 1
+            if is_standby:
+                # a spare died before promotion: its refills have their
+                # own bounded budget — a crash-looping spare must not
+                # burn the REAL workers' relaunch budget (nor refill
+                # forever)
+                self._standby_pods.pop(instance_id, None)
+                refill = (
+                    self._relaunch_on[kind]
+                    and self._standby_refill_budget > 0
+                )
+                if refill:
+                    self._standby_refill_budget -= 1
+            else:
+                decision = decide_on_exit(
+                    kind,
+                    phase,
+                    self._relaunch_on[kind],
+                    self._relaunch_budget[kind],
+                )
+                if decision.relaunch:
+                    self._relaunch_budget[kind] -= 1
+        if is_standby:
+            logger.info(
+                "standby %d left (phase %s): refill=%s",
+                instance_id,
+                phase,
+                refill,
+            )
+            if self._membership is not None:
+                self._membership.standby.forget(instance_id)
+            if refill:
+                self._launch_standby()
+            return
         logger.info(
             "%s %d left (phase %s): recover=%s relaunch=%s",
             kind,
@@ -285,18 +318,29 @@ class InstanceManager:
             decision.recover,
             decision.relaunch,
         )
-        if kind == WORKER and instance_id in self._standby_pods:
-            # a spare died before promotion: forget it, refill the pool
-            self._standby_pods.pop(instance_id, None)
-            if self._membership is not None:
-                self._membership.standby.forget(instance_id)
-            if decision.relaunch:
-                self._launch_standby()
-            return
         if decision.recover:
             self._task_d.recover_tasks(instance_id)
             if self._membership is not None:
-                self._membership.remove(instance_id)
+                # with a warmed standby about to be promoted, defer the
+                # bump briefly: one combined formation instead of a
+                # shrink re-form chased by a growth pause (see
+                # membership_service.DEATH_BUMP_DEFER_SECS)
+                from elasticdl_tpu.master.membership_service import (
+                    DEATH_BUMP_DEFER_SECS,
+                )
+
+                will_promote = (
+                    kind == WORKER
+                    and decision.relaunch
+                    and decision.new_id
+                    and self._membership.standby.parked_count() > 0
+                )
+                self._membership.remove(
+                    instance_id,
+                    defer_bump_secs=(
+                        DEATH_BUMP_DEFER_SECS if will_promote else 0
+                    ),
+                )
         if decision.relaunch:
             if kind == WORKER and decision.new_id:
                 promoted = self._promote_standby()

@@ -56,6 +56,7 @@ class LocalInstanceManager:
         # death promotes one instead of relaunching cold, converting the
         # ~45-50 s relaunch cost into membership-only recovery
         self._num_standby = num_standby if membership is not None else 0
+        self._standby_refill_budget = max_relaunches
 
         self._lock = threading.Lock()
         self._procs = {}  # instance key -> Popen
@@ -127,8 +128,11 @@ class LocalInstanceManager:
         with self._lock:
             proc = self._procs.pop(("standby", token), None)
             if proc is None:
-                # the standby died between activate and now; its watch
-                # thread will forget the token
+                # the standby died between activate and now: unassign
+                # the token explicitly (the watch thread's forget may
+                # not have run yet, and an assigned token must never
+                # outlive its process)
+                self._membership.standby.forget(token)
                 return None
             self._procs[("worker", new_id)] = proc
             self._rekeyed[id(proc)] = ("worker", new_id)
@@ -154,11 +158,27 @@ class LocalInstanceManager:
             del self._procs[key]
         kind, instance_id = key
         if kind == "standby":
-            # a spare died before promotion: forget its token, refill
+            # a spare died before promotion: forget its token, refill —
+            # on a bounded budget of its own (a deterministically-
+            # crashing spare must not fork-loop the host, nor burn the
+            # real workers' relaunch budget)
             if self._membership is not None:
                 self._membership.standby.forget(instance_id)
-            if not self._stopping:
+            with self._lock:
+                refill = (
+                    not self._stopping
+                    and self._standby_refill_budget > 0
+                )
+                if refill:
+                    self._standby_refill_budget -= 1
+            if refill:
                 self._start_standby()
+            else:
+                logger.warning(
+                    "standby %d died; refill budget exhausted or "
+                    "stopping — pool not refilled",
+                    instance_id,
+                )
             return
         if kind == "worker":
             # reference k8s_instance_manager.py:207 — a dead worker's
@@ -180,9 +200,15 @@ class LocalInstanceManager:
                         or self._relaunches < self._max_relaunches
                     )
                 )
+                from elasticdl_tpu.master.membership_service import (
+                    DEATH_BUMP_DEFER_SECS,
+                )
+
                 self._membership.remove(
                     instance_id,
-                    defer_bump_secs=6.0 if will_promote else 0,
+                    defer_bump_secs=(
+                        DEATH_BUMP_DEFER_SECS if will_promote else 0
+                    ),
                 )
             if returncode == 0:
                 logger.info("Worker %d completed", instance_id)

@@ -51,6 +51,15 @@ import time
 from elasticdl_tpu.common.log_utils import default_logger as logger
 
 
+# How long a death bump may wait for a warmed standby's registration
+# (one combined formation instead of shrink-then-grow). MUST stay well
+# below the workers' failure-recovery poll window
+# (ElasticAllReduceWorker epoch_poll_secs, default 10 s): survivors of
+# the broken collective wait at most that long in _await_epoch_bump for
+# the (deferred) bump before giving up and crashing out.
+DEATH_BUMP_DEFER_SECS = 6.0
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("", 0))

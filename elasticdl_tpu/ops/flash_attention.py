@@ -437,13 +437,19 @@ def auto_blocks(lq, lk, block_q=None, block_k=None):
     128x128 tiles ran 10.2 ms — SLOWER than XLA's unfused attention
     (8.8 ms) because tiny tiles re-read Q/dO from HBM once per k-block
     and leave the MXU under-filled. 512x1024 runs 3.71 ms (2.4x the XLA
-    path). Larger q-tiles amortize the streamed K/V; the k-tile caps at
-    1024 to keep the (block_q, block_k) score tile within VMEM alongside
-    the backward's recompute buffers. Explicit sizes always win; None
-    picks the largest measured-good divisor of the sequence length.
+    path). Larger q-tiles amortize the streamed K/V; an r4 re-sweep
+    found 1024-row q-tiles a further win everywhere measured (L=1024
+    b16 h12: 6.92 vs 7.14 ms; L=4096 b4 h8: 14.45 vs 15.68 ms; L=2048
+    tied) — the k-tile caps at 1024 to keep the (block_q, block_k)
+    score tile within VMEM alongside the backward's recompute buffers
+    (2048-wide k-tiles fail to compile). Explicit sizes always win;
+    None picks the largest measured-good divisor of the sequence
+    length.
     """
     if block_q is None:
-        block_q = next((b for b in (512, 256, 128) if lq % b == 0), 128)
+        block_q = next(
+            (b for b in (1024, 512, 256, 128) if lq % b == 0), 128
+        )
     if block_k is None:
         block_k = next(
             (b for b in (1024, 512, 256, 128) if lk % b == 0), 128

@@ -97,7 +97,16 @@ def _run(args):
             token = args.worker_id
             logger.info("standby %d warmed; parking", token)
             while True:
-                wid = stub.standby_poll(token)
+                try:
+                    wid = stub.standby_poll(token)
+                except Exception:
+                    # a transient RPC blip (master busy mid-formation)
+                    # must not kill the spare that just paid its cold
+                    # start — the pool exists to avoid exactly that
+                    logger.warning(
+                        "standby poll failed; retrying", exc_info=True
+                    )
+                    wid = None
                 if wid is not None:
                     logger.info(
                         "standby %d promoted to worker %d", token, wid
