@@ -96,15 +96,28 @@ def _run(args):
 
             token = args.worker_id
             logger.info("standby %d warmed; parking", token)
+            failures = 0
             while True:
                 try:
                     wid = stub.standby_poll(token)
+                    failures = 0
                 except Exception:
                     # a transient RPC blip (master busy mid-formation)
                     # must not kill the spare that just paid its cold
-                    # start — the pool exists to avoid exactly that
+                    # start — but a master that stays unreachable for
+                    # ~2 min is gone, and an orphaned standby must not
+                    # spin (and log) forever
+                    failures += 1
+                    if failures >= 60:
+                        logger.error(
+                            "standby %d: master unreachable for %d "
+                            "consecutive polls; exiting",
+                            token,
+                            failures,
+                        )
+                        return 1
                     logger.warning(
-                        "standby poll failed; retrying", exc_info=True
+                        "standby poll failed (%d); retrying", failures
                     )
                     wid = None
                 if wid is not None:
@@ -113,7 +126,7 @@ def _run(args):
                     )
                     worker._worker_id = int(wid)
                     break
-                _time.sleep(0.5)
+                _time.sleep(0.5 if failures == 0 else 2.0)
         # graceful preemption: cloud preemptions / pod evictions send
         # SIGTERM with notice — drain at the next batch boundary
         # (checkpoint + clean world leave) instead of dying

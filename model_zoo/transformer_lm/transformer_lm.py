@@ -368,6 +368,8 @@ def build_distributed_model(
     :func:`param_shardings` and :func:`mesh_axes`); otherwise the plain
     model over the mesh."""
     stages = int(pipeline_stages)
+    # consumed by param_shardings (placement), not by the model itself
+    params.pop("shard_vocab", None)
     if stages > 1:
         supported = {
             "vocab_size",
@@ -398,18 +400,25 @@ def build_distributed_model(
     return custom_model(mesh=mesh, dtype=dtype, **params)
 
 
-def param_shardings(mesh, pipeline_stages=0, **_params):
-    """Stacked stage parameters shard leaf-dim-0 over ``pipe``.
+def param_shardings(mesh, pipeline_stages=0, shard_vocab=False, **_params):
+    """Stacked stage parameters shard leaf-dim-0 over ``pipe``; with
+    ``shard_vocab`` the token-embedding table additionally row-shards
+    its vocab over ``data`` (the weight-tied LM head then contracts a
+    vocab-sharded table — XLA inserts the collectives from the
+    placement, the HBM-embedding recipe applied to the LM family).
 
     ``mesh=None`` is the capability probe (does this config shard at
     all?) — answered from the params alone."""
     from jax.sharding import PartitionSpec as P
 
+    specs = {}
     if int(pipeline_stages) > 1 and (
         mesh is None or "pipe" in mesh.axis_names
     ):
-        return {"pipe": {"stages": {"**": P("pipe")}}}
-    return None
+        specs["pipe"] = {"stages": {"**": P("pipe")}}
+    if shard_vocab and (mesh is None or "data" in mesh.axis_names):
+        specs["embed"] = {"embedding": P("data", None)}
+    return specs or None
 
 
 def mesh_axes(n_devices, pipeline_stages=0, **_params):

@@ -1,0 +1,20 @@
+"""The driver's multichip dryrun at larger virtual worlds.
+
+The driver itself runs dryrun_multichip(8); these rungs push the same
+five passes (dp, dp x tp x sp, pp x dp + MoE, HBM-sharded embedding,
+and combined pp x dp x vocab-sharded embedding) to 16 and 32 virtual
+CPU devices — the re-exec path provisions the world in a subprocess."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_devices", [16, 32])
+def test_dryrun_multichip_scales(n_devices):
+    import __graft_entry__ as entry
+
+    entry.dryrun_multichip(n_devices)
