@@ -11,7 +11,7 @@ import os
 import numpy as np
 
 from elasticdl_tpu.data.example import encode_example
-from elasticdl_tpu.data.recordio import RecordIOWriter
+from elasticdl_tpu.data.recordio import create_recordio
 
 
 def row_to_example(row, column_names):
@@ -47,7 +47,7 @@ def write_recordio_shards_from_iterator(
                 writer.close()
             path = os.path.join(output_dir, "data-%05d" % len(files))
             files.append(path)
-            writer = RecordIOWriter(path)
+            writer = create_recordio(path)
         writer.write(encode_example(row_to_example(row, column_names)))
         count += 1
     if writer is not None:

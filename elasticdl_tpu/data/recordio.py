@@ -67,7 +67,15 @@ class RecordIOWriter:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None:
+            # error path: do NOT finalize — a tail-less file is rejected
+            # by every reader as truncated, where a finalized partial
+            # shard would silently serve incomplete data (same contract
+            # as the native writer)
+            self._f.close()
+            self._closed = True
+            return
         self.close()
 
 
@@ -137,6 +145,22 @@ def write_recordio(path, payloads):
         for p in payloads:
             w.write(p)
         return w.num_records
+
+
+def create_recordio(path):
+    """Writer factory: the C++ buffered writer when built, else Python.
+
+    Same API (write/num_records/close, context manager) and identical
+    bytes on disk; an exception inside the ``with`` block leaves a
+    tail-less file both readers reject as truncated."""
+    try:
+        from elasticdl_tpu.native import NativeRecordIOWriter, native_lib
+
+        if native_lib() is not None:
+            return NativeRecordIOWriter(path)
+    except Exception:
+        pass
+    return RecordIOWriter(path)
 
 
 def open_recordio(path):

@@ -11,7 +11,7 @@ import os
 import numpy as np
 
 from elasticdl_tpu.data.example import encode_example
-from elasticdl_tpu.data.recordio import RecordIOWriter
+from elasticdl_tpu.data.recordio import create_recordio
 
 
 def parse_line(line, num_features=10):
@@ -46,7 +46,7 @@ def convert(input_file, output_dir, records_per_shard=8192, num_features=10):
                     output_dir, "frappe-%05d" % len(files)
                 )
                 files.append(path)
-                writer = RecordIOWriter(path)
+                writer = create_recordio(path)
             feature, label = parsed
             writer.write(
                 encode_example({"feature": feature, "label": label})

@@ -16,7 +16,7 @@ import os
 import numpy as np
 
 from elasticdl_tpu.data.example import encode_example
-from elasticdl_tpu.data.recordio import RecordIOWriter
+from elasticdl_tpu.data.recordio import create_recordio
 
 
 def convert(iterable, output_dir, records_per_shard=4096, partition=""):
@@ -36,7 +36,7 @@ def convert(iterable, output_dir, records_per_shard=4096, partition=""):
                 )
                 path = os.path.join(output_dir, name)
                 files.append(path)
-                writer = RecordIOWriter(path)
+                writer = create_recordio(path)
             writer.write(
                 encode_example(
                     {

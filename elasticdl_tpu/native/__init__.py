@@ -41,6 +41,20 @@ def native_lib():
             ]
         lib.edlr_close.restype = None
         lib.edlr_close.argtypes = [ctypes.c_void_p]
+        lib.edlw_create.restype = ctypes.c_void_p
+        lib.edlw_create.argtypes = [ctypes.c_char_p]
+        lib.edlw_write.restype = ctypes.c_int
+        lib.edlw_write.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.edlw_num_records.restype = ctypes.c_int64
+        lib.edlw_num_records.argtypes = [ctypes.c_void_p]
+        lib.edlw_close.restype = ctypes.c_int
+        lib.edlw_close.argtypes = [ctypes.c_void_p]
+        lib.edlw_abort.restype = None
+        lib.edlw_abort.argtypes = [ctypes.c_void_p]
         _handle = lib
     except OSError:
         _load_failed = True
@@ -105,5 +119,76 @@ class NativeRecordIOReader:
     def __del__(self):
         try:
             self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordIOWriter:
+    """ctypes wrapper with the RecordIOWriter API (data/recordio.py).
+
+    Errors poison the handle: ``close()`` then refuses to finalize and
+    the tail-less file is rejected by both readers as truncated — a
+    partial index can never masquerade as a complete file."""
+
+    def __init__(self, path):
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError("native library not available")
+        self._lib = lib
+        self._path = path
+        self._h = lib.edlw_create(path.encode())
+        if not self._h:
+            raise OSError("cannot create EDLR file: %s" % path)
+        self._closed = False
+        self._final_count = 0
+
+    def write(self, payload):
+        if self._closed or not self._h:
+            raise ValueError("writer is closed")
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            raise TypeError("record payload must be bytes")
+        payload = bytes(payload)
+        rc = self._lib.edlw_write(self._h, payload, len(payload))
+        if rc != 0:
+            raise OSError(
+                "EDLR write failed (rc=%d) for %s" % (rc, self._path)
+            )
+
+    @property
+    def num_records(self):
+        if self._h:
+            return int(self._lib.edlw_num_records(self._h))
+        return self._final_count
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        h, self._h = self._h, None
+        self._final_count = int(self._lib.edlw_num_records(h))
+        rc = self._lib.edlw_close(h)
+        if rc != 0:
+            raise OSError(
+                "EDLR finalize failed (rc=%d) for %s; the file has no "
+                "tail and readers will reject it" % (rc, self._path)
+            )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None and self._h:
+            # error path: do NOT finalize a half-written file
+            self._closed = True
+            h, self._h = self._h, None
+            self._lib.edlw_abort(h)
+            return
+        self.close()
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.edlw_abort(self._h)
+                self._h = None
         except Exception:
             pass

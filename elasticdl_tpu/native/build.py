@@ -8,7 +8,10 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def build(verbose=True):
-    src = os.path.join(_DIR, "recordio_reader.cc")
+    sources = [
+        os.path.join(_DIR, "recordio_reader.cc"),
+        os.path.join(_DIR, "recordio_writer.cc"),
+    ]
     out = os.path.join(_DIR, "libedl_native.so")
     cmd = [
         "g++",
@@ -16,7 +19,7 @@ def build(verbose=True):
         "-shared",
         "-fPIC",
         "-std=c++17",
-        src,
+        *sources,
         "-lz",
         "-o",
         out,

@@ -1,0 +1,123 @@
+// Native EDLR (indexed record file) writer.
+//
+// Role parity: SURVEY.md §2.4 plans a native reader AND writer for the
+// shard-addressable record format (the reference leans on the
+// third-party RecordIO Go/C library for both sides). The layout is
+// defined in elasticdl_tpu/data/recordio.py and shared with
+// recordio_reader.cc:
+//
+//   file   := "EDLR" u32 version  record*  index  tail
+//   record := u32 payload_len, u32 crc32(payload), payload bytes
+//   index  := u64 count, u64 record_offset[count]
+//   tail   := u64 index_offset, "EDLX"
+//
+// Buffered appends through stdio; close() lands the offset index and
+// tail, so a crash mid-write leaves a file without a tail magic that
+// both readers reject as truncated. Exposed as a C ABI for ctypes (no
+// pybind11 in this toolchain).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'E', 'D', 'L', 'R'};
+constexpr char kTailMagic[4] = {'E', 'D', 'L', 'X'};
+constexpr uint32_t kVersion = 1;
+
+struct Writer {
+  FILE* f = nullptr;
+  uint64_t offset = 0;  // current file position (header included)
+  std::vector<uint64_t> offsets;
+  bool failed = false;
+};
+
+bool write_all(Writer* w, const void* data, size_t len) {
+  if (std::fwrite(data, 1, len, w->f) != len) {
+    w->failed = true;
+    return false;
+  }
+  w->offset += len;
+  return true;
+}
+
+bool write_u32(Writer* w, uint32_t v) { return write_all(w, &v, 4); }
+bool write_u64(Writer* w, uint64_t v) { return write_all(w, &v, 8); }
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or nullptr when the file cannot be created.
+void* edlw_create(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  if (!write_all(w, kMagic, 4) || !write_u32(w, kVersion)) {
+    std::fclose(f);
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+// Appends one record (length + crc32 + payload). Returns 0 on success,
+// negative on error; after any error the writer is poisoned and close()
+// will not finalize (the file stays tail-less = unreadable-as-complete).
+int edlw_write(void* handle, const uint8_t* data, uint32_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w || w->failed) return -1;
+  uint32_t crc =
+      crc32(0L, reinterpret_cast<const Bytef*>(data), len);
+  w->offsets.push_back(w->offset);
+  if (!write_u32(w, len) || !write_u32(w, crc) ||
+      !write_all(w, data, len)) {
+    return -2;
+  }
+  return 0;
+}
+
+int64_t edlw_num_records(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return -1;
+  return static_cast<int64_t>(w->offsets.size());
+}
+
+// Finalizes (index + tail) and closes. Returns 0 on success; on any
+// prior or current IO failure the tail is never written, so readers
+// reject the file as truncated instead of serving a partial index.
+int edlw_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return -1;
+  int rc = 0;
+  if (!w->failed) {
+    uint64_t index_offset = w->offset;
+    bool ok = write_u64(w, w->offsets.size());
+    for (size_t i = 0; ok && i < w->offsets.size(); ++i) {
+      ok = write_u64(w, w->offsets[i]);
+    }
+    ok = ok && write_u64(w, index_offset) &&
+         write_all(w, kTailMagic, 4);
+    if (!ok) rc = -2;
+  } else {
+    rc = -3;
+  }
+  if (std::fclose(w->f) != 0 && rc == 0) rc = -4;
+  delete w;
+  return rc;
+}
+
+// Close without finalizing (error/abort path): the file keeps no tail.
+void edlw_abort(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return;
+  std::fclose(w->f);
+  delete w;
+}
+
+}  // extern "C"

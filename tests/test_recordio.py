@@ -124,3 +124,20 @@ class ExampleCodecTest(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+def test_python_writer_abort_leaves_rejectable_file(tmp_path):
+    """Same crash contract as the native writer: an exception inside the
+    with block must NOT finalize — the tail-less file reads as
+    truncated instead of silently serving a partial shard."""
+    import pytest
+
+    from elasticdl_tpu.data.recordio import RecordIOReader, RecordIOWriter
+
+    path = str(tmp_path / "torn.edlr")
+    with pytest.raises(RuntimeError):
+        with RecordIOWriter(path) as w:
+            w.write(b"only")
+            raise RuntimeError("boom")
+    with pytest.raises(ValueError):
+        RecordIOReader(path)
