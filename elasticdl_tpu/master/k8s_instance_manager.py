@@ -35,6 +35,37 @@ PS = "ps"
 ExitDecision = namedtuple("ExitDecision", ["recover", "relaunch", "new_id"])
 
 
+def container_exit_code(pod):
+    """Terminated WORKER-container exit code from a pod object, or None.
+
+    Pod phase alone can't distinguish a graceful rc-75 drain from a
+    crash (both are "Failed"); the wedge-escape dead-listing needs the
+    code. k8s_client names the single container it creates after the
+    pod, so prefer the status matching that name — an injected sidecar
+    (istio-proxy, vault-agent) exiting 0 must not mask a crashed
+    worker. With no name match, prefer any nonzero code for the same
+    reason. Defensive: fake/partial pod objects in tests may omit
+    status.container_statuses entirely."""
+    try:
+        pod_name = getattr(pod.metadata, "name", None)
+        codes = []  # (container_name, exit_code)
+        for s in pod.status.container_statuses or []:
+            term = getattr(s.state, "terminated", None) if s.state else None
+            if term is not None:
+                codes.append((getattr(s, "name", None), term.exit_code))
+        for name, code in codes:
+            if name == pod_name:
+                return code
+        for _, code in codes:
+            if code != 0:
+                return code
+        if codes:
+            return codes[0][1]
+    except (AttributeError, TypeError):
+        pass
+    return None
+
+
 def decide_on_exit(kind, phase, relaunch_enabled, budget_left):
     """Pure elasticity decision for one instance exit.
 
@@ -335,11 +366,21 @@ class InstanceManager:
                     and decision.new_id
                     and self._membership.standby.parked_count() > 0
                 )
+                exit_code = container_exit_code(obj)
+                if exit_code is None and phase == "Succeeded":
+                    # the API server asserts success even when the
+                    # container statuses are missing/partial
+                    exit_code = 0
                 self._membership.remove(
                     instance_id,
                     defer_bump_secs=(
                         DEATH_BUMP_DEFER_SECS if will_promote else 0
                     ),
+                    # membership exempts rc 0/75 from the survivors'
+                    # wedge-escape dead list only when the worker
+                    # announced the leave itself (_departing) — an
+                    # unannounced exit of any code wedges peers
+                    exit_code=exit_code,
                 )
         if decision.relaunch:
             if kind == WORKER and decision.new_id:

@@ -209,6 +209,11 @@ class LocalInstanceManager:
                     defer_bump_secs=(
                         DEATH_BUMP_DEFER_SECS if will_promote else 0
                     ),
+                    # membership exempts rc 0/75 from the wedge-escape
+                    # dead list only when the worker announced the
+                    # leave itself (_departing) — an unannounced exit
+                    # of any code wedges survivors like a crash
+                    exit_code=returncode,
                 )
             if returncode == 0:
                 logger.info("Worker %d completed", instance_id)

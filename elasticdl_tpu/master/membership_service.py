@@ -174,12 +174,21 @@ class MembershipService:
         self._fencer = None
         self._formed = set()  # members seen training in the current epoch
         self._lobby = {}  # joiners parked while a formation is in flight
-        self._departing = set()  # drained members: never re-register
+        # drained/completed members (id -> epoch of the announce): no
+        # re-registration, and the exits the announce covers are exempt
+        # from the dead list. Pruned with the same epoch window as
+        # _dead: the announcer observes its bump within one poll and
+        # its watch exit-event arrives seconds later, so entries only
+        # need to outlive a couple of epochs.
+        self._departing = {}
         # ids removed because their PROCESS actually died (watch/fence),
-        # as opposed to graceful drains: the workers' wedge-escape probe
-        # only fires when one of ITS world members is here — a growth
-        # bump or a drain must never abort a healthy (slow) step
-        self._dead = set()
+        # as opposed to graceful drains/completions: the workers'
+        # wedge-escape probe only fires when one of ITS world members is
+        # here — a growth bump, a drain, or a clean exit must never
+        # abort a healthy (slow) step. Maps id -> epoch at death so
+        # entries can be pruned once no live member's world can still
+        # reference them (serialized into every get_world reply).
+        self._dead = {}
         self.standby = StandbyPool()
         self._pending_bump_deadline = None  # deferred death bump
 
@@ -209,6 +218,19 @@ class MembershipService:
 
     def _bump_locked(self):
         self._pending_bump_deadline = None
+        # prune deaths no lagging member's world can still reference:
+        # members trail by at most a couple of epochs (their per-step
+        # poll notices a bump within one step), so a 4-epoch window is
+        # comfortably conservative while keeping the get_world payload
+        # bounded over a long spot-fleet job with many deaths
+        self._dead = {
+            w: e for w, e in self._dead.items() if e >= self._epoch - 4
+        }
+        self._departing = {
+            w: e
+            for w, e in self._departing.items()
+            if e >= self._epoch - 4
+        }
         # any parked joiners ride along with whatever forced this bump
         self._live.update(self._lobby)
         self._lobby = {}
@@ -243,7 +265,7 @@ class MembershipService:
                 # it (or parking it in the lobby) would re-grow the world
                 # it is leaving
                 return
-            self._dead.discard(worker_id)  # evidently alive
+            self._dead.pop(worker_id, None)  # evidently alive
             if (
                 self._live.get(worker_id) == host
                 or self._lobby.get(worker_id) == host
@@ -272,12 +294,47 @@ class MembershipService:
                 self._live[worker_id] = host
                 self._bump_locked()
 
-    def remove(self, worker_id, departing=False, defer_bump_secs=0):
+    # process exit codes whose *announced* exits are protocol-clean:
+    # 0 = completion after global quiescence, 75 = graceful drain
+    CLEAN_EXIT_CODES = (0, 75)
+
+    def remove(
+        self,
+        worker_id,
+        departing=False,
+        defer_bump_secs=0,
+        exit_code=None,
+    ):
         """Drop a member and bump. ``departing=True`` is the graceful
-        drain verb (worker-initiated, BEFORE its process exits): the id
-        is additionally blacklisted from re-registration, because the
-        draining worker keeps polling until it observes the bump — the
-        poll-and-register semantics would otherwise re-add it.
+        leave verb (worker-initiated, BEFORE its process exits — the
+        drain announcement mid-job, or the completion announcement
+        after global quiescence): the id is additionally blacklisted
+        from re-registration, because a draining worker keeps polling
+        until it observes the bump — the poll-and-register semantics
+        would otherwise re-add it.
+
+        ``exit_code`` is the process exit the instance manager's watch
+        observed (None when it could not be determined). The ``dead``
+        list feeds the survivors' wedge-escape abort probe, and a
+        missing entry for a peer that really broke the collective is an
+        indefinite formation deadlock (wedged survivors keep polling
+        via the probe, so the confirm-timeout fencer never culls them).
+        So the listing rule errs toward dead — an exit is exempt ONLY
+        when the worker itself announced it beforehand:
+
+        - rc 0/75 *announced* (the worker's ``leave_comm_world`` put
+          the id in ``_departing``): protocol-clean leave — not
+          listed; the victim reached global quiescence or participated
+          in the drain pause, nobody is wedged on it.
+        - rc 0/75 *unannounced*: listed. An unannounced rc 0 is user
+          code calling sys.exit(0) mid-step; an unannounced rc 75 is a
+          hard-leave whose announce RPC never landed (master
+          transiently unreachable). Either way survivors' in-flight
+          collectives hang on the vanished rank.
+        - any other returncode (or None): listed, even after an
+          announcement — a drained member keeps stepping until the
+          consensus pause and a segfault in that window breaks the
+          collective like any crash.
 
         ``defer_bump_secs > 0``: the instance manager is promoting a
         pre-warmed standby for this death, so the bump waits briefly for
@@ -289,9 +346,14 @@ class MembershipService:
         register, or the deadline ends the deferral."""
         with self._lock:
             if departing:
-                self._departing.add(worker_id)
-            else:
-                self._dead.add(worker_id)
+                self._departing[worker_id] = self._epoch
+            elif not (
+                exit_code in self.CLEAN_EXIT_CODES
+                and worker_id in self._departing
+            ):
+                # only ANNOUNCED protocol-clean exits are exempt; see
+                # the listing rule in the docstring
+                self._dead[worker_id] = self._epoch
             self._lobby.pop(worker_id, None)
             if worker_id not in self._live:
                 return

@@ -57,6 +57,9 @@ def call_slot_name(i):
     return "_CallSlot_%d" % i
 
 
+_SLOT_WRAP_WARNED = set()  # layer paths already warned (once per process)
+
+
 class Embedding(nn.Module):
     """Elastic embedding: rows are per-batch inputs, not parameters.
 
@@ -99,6 +102,7 @@ class Embedding(nn.Module):
         if self.scope is not None and not self.is_initializing():
             n_slots = len(self.variables.get(IDX_COLLECTION, {}))
             if n_slots and call_index >= n_slots:
+                self._warn_slot_wrap(call_index, n_slots)
                 call_index %= n_slots
         emb = _CallSlot(name=call_slot_name(call_index))(ids, rows)
         if self.mask_zero:
@@ -120,6 +124,34 @@ class Embedding(nn.Module):
             else:
                 raise ValueError("Unknown combiner %r" % self.combiner)
         return emb
+
+    def _warn_slot_wrap(self, call_index, n_slots):
+        """Once-per-layer notice when the call-slot counter wraps.
+
+        Wrapping is normal for a long-lived ``module.bind`` handle
+        (one instance reused across forwards), but it is also the only
+        symptom of an UNDER-provisioned hand-built idx collection —
+        fewer slots than call sites — where it silently aliases all
+        calls onto slot 0 (wrong output). The two are indistinguishable
+        here, so say it loudly once instead of failing silently."""
+        key = (self.path, n_slots)
+        if key in _SLOT_WRAP_WARNED:
+            return
+        _SLOT_WRAP_WARNED.add(key)
+        from elasticdl_tpu.common.log_utils import default_logger
+
+        default_logger.warning(
+            "Embedding %s: call %d wrapped onto %d bound slot(s). "
+            "Expected for a reused bind() handle; but if this model "
+            "calls the layer more than %d time(s) per forward, the idx "
+            "collection is under-provisioned (capture with "
+            "expected_count or let the framework build it) and lookups "
+            "are aliasing onto the wrong slots.",
+            "/".join(self.path) if self.path else "<root>",
+            call_index,
+            n_slots,
+            n_slots,
+        )
 
 
 class _CaptureDone(Exception):

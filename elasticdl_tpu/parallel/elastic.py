@@ -1138,6 +1138,8 @@ class ElasticDPTrainer:
         the check can never disagree with placement."""
         problems = []
 
+        mirror_problems = []
+
         def check(key_path, leaf, spec):
             from elasticdl_tpu.common.pytree import key_path_names
 
@@ -1155,6 +1157,13 @@ class ElasticDPTrainer:
                             n,
                         )
                     )
+                if dim != 0:
+                    # the replica plane's block math (_local_block,
+                    # shape[0] // n_proc) assumes leading-dim sharding;
+                    # a P(None, 'data') leaf would stage/assemble wrong
+                    mirror_problems.append(
+                        "/".join(key_path_names(key_path))
+                    )
 
         jax.tree_util.tree_map_with_path(
             check, abstract_ts.params, self._state_specs.params
@@ -1166,6 +1175,15 @@ class ElasticDPTrainer:
                 "multiple of every world size the job can shrink/grow "
                 "to — a multiple of num_workers * local_devices is the "
                 "usual choice." % (self._mesh.devices.size, "; ".join(problems))
+            )
+        if mirror_problems and self.mirror_enabled():
+            raise ValueError(
+                "the replica plane (--replica_refresh_steps) supports "
+                "only leading-dim sharded parameters, but these leaves "
+                "shard a later dim: %s. Reshape so the sharded axis is "
+                "dim 0, or disable the mirror (replica_refresh_steps=0) "
+                "to fall back to checkpoint-based recovery."
+                % "; ".join(mirror_problems)
             )
 
     def _place_batch(self, tree):

@@ -133,6 +133,22 @@ def _run(args):
         # mid-collective
         worker.enable_drain_on_sigterm()
         worker.run()
+        if not worker._preempted:
+            # announce the clean completion BEFORE exiting: membership
+            # exempts this process's coming rc-0 exit from the
+            # survivors' wedge-escape dead list only for announced
+            # leaves (an unannounced exit 0 — user code calling
+            # sys.exit(0) mid-step — must still read as a death there).
+            # All device/collective work is done (global quiescence +
+            # _finalize), so nobody can be wedged on this rank.
+            # Best-effort: if the RPC misses, the watch dead-lists the
+            # exit and teardown-window survivors recover via one
+            # (spurious but safe) reform.
+            try:
+                if stub is not None:
+                    stub.leave_comm_world(worker._worker_id)
+            except Exception:
+                pass
         if worker._preempted:
             # distinct exit code: the instance manager relaunches a
             # replacement (exit 0 would read as "job done for me").

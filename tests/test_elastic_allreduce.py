@@ -82,6 +82,70 @@ def test_membership_epochs():
     assert m.epoch > e2
 
 
+def test_membership_dead_list_only_real_crashes():
+    """The ``dead`` list drives the survivors' wedge-escape abort
+    probe: ANNOUNCED protocol-clean exits (rc 0 completion after the
+    worker's own leave_comm_world, rc 75 after the drain announcement)
+    must stay off it, every unannounced exit — whatever the code —
+    must land on it, and entries are pruned once no lagging member's
+    world can reference them."""
+    m = MembershipService(expected_workers=3, form_grace_secs=60)
+    for w in (0, 1, 2):
+        m.get_world(w)
+    assert m.get_world(0)["dead"] == []
+
+    # announced clean completion: worker.main announces after global
+    # quiescence, then the watch sees rc 0 — not listed dead
+    m.remove(0, departing=True)
+    m.remove(0, exit_code=0)
+    assert 0 not in m.get_world(1)["dead"]
+
+    # graceful drain announces departing first; the instance manager's
+    # later rc-75 watch event must not retroactively mark it dead
+    m.remove(1, departing=True)
+    m.remove(1, exit_code=75)  # watch sees rc 75
+    assert 1 not in m.get_world(2)["dead"]
+
+    # a real crash IS listed (the abort probe keys on exactly this)
+    m.remove(2)
+    assert 2 in m.get_world(3)["dead"]
+
+    # an UNANNOUNCED rc 0 — user code calling sys.exit(0) mid-step —
+    # leaves peers' collectives hanging exactly like a kill: listed
+    # (the probe is the ONLY escape; the fencer can't cull pollers)
+    m.get_world(19)
+    m.remove(19, exit_code=0)
+    assert 19 in m.get_world(3)["dead"]
+
+    # an UNANNOUNCED rc-75 hard-leave (the leave RPC never landed)
+    # wedges survivors like any crash: listed
+    m.get_world(20)
+    m.remove(20, exit_code=75)
+    assert 20 in m.get_world(3)["dead"]
+
+    # a drained member that segfaults before the consensus pause broke
+    # the collective: the earlier announcement does not exempt a
+    # non-clean code
+    m.get_world(21)
+    m.remove(21, departing=True)
+    m.remove(21, exit_code=139)  # watch sees a segfault
+    assert 21 in m.get_world(3)["dead"]
+
+    # pruning: once epochs advance past the retention window, the stale
+    # death drops out of the payload
+    for joiner in range(4, 11):
+        m.get_world(joiner)
+        # drive the two-phase formation to completion so the next
+        # registration bumps instead of parking in the lobby
+        for _ in range(5):
+            members = [w for w, _ in m._world]
+            for wid in members:
+                m.get_world(wid)
+            for wid in members:
+                m.get_world(wid, awaiting=False)
+    assert 2 not in m.get_world(3)["dead"]
+
+
 def test_membership_unconfirmed_member_dropped_after_timeout():
     """A member that stops polling (wedged in a stale initialize) must
     not block formation forever: after the confirm timeout the world

@@ -269,7 +269,13 @@ def test_local_manager_promotes_warmed_standby(tmp_path):
         def set_fencer(self, fn):
             pass
 
-        def remove(self, worker_id, departing=False, defer_bump_secs=0):
+        def remove(
+            self,
+            worker_id,
+            departing=False,
+            defer_bump_secs=0,
+            exit_code=None,
+        ):
             self.removed.append(worker_id)
 
     class FakeDispatcher:
@@ -318,3 +324,42 @@ def test_local_manager_promotes_warmed_standby(tmp_path):
             time.sleep(0.1)
     finally:
         manager.stop_relaunch_and_remove_all_pods()
+
+
+def test_container_exit_code_prefers_worker_over_sidecar():
+    """An injected sidecar (istio-proxy) exiting 0 must not mask a
+    crashed worker container: the status matching the pod name wins,
+    and with no name match any nonzero code wins."""
+    from elasticdl_tpu.master.k8s_instance_manager import (
+        container_exit_code,
+    )
+
+    def status(name, code):
+        return SimpleNamespace(
+            name=name,
+            state=SimpleNamespace(
+                terminated=SimpleNamespace(exit_code=code)
+            ),
+        )
+
+    def pod(name, statuses):
+        return SimpleNamespace(
+            metadata=SimpleNamespace(name=name),
+            status=SimpleNamespace(container_statuses=statuses),
+        )
+
+    # sidecar listed first with rc 0, worker (named after pod) rc 139
+    p = pod(
+        "worker-1", [status("istio-proxy", 0), status("worker-1", 139)]
+    )
+    assert container_exit_code(p) == 139
+    # no name match at all: prefer the nonzero code
+    p = pod("worker-2", [status("sidecar-a", 0), status("sidecar-b", 1)])
+    assert container_exit_code(p) == 1
+    # all rc 0, no name match: 0 (clean)
+    p = pod("worker-3", [status("sidecar-a", 0)])
+    assert container_exit_code(p) == 0
+    # still-running containers / missing statuses: None
+    p = pod("worker-4", [SimpleNamespace(name="w", state=None)])
+    assert container_exit_code(p) is None
+    assert container_exit_code(SimpleNamespace(metadata=None)) is None
