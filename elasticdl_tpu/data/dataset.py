@@ -123,29 +123,50 @@ class Dataset:
         return Dataset(gen)
 
     def prefetch(self, buffer_size=1):
-        """Run the upstream pipeline in a background thread."""
+        """Run the upstream pipeline in a background thread.
+
+        The producer is COOPERATIVELY CANCELLED when the consumer
+        generator is closed/garbage-collected (an elastic spare park
+        abandons its round mid-stream): without the cancel, a producer
+        blocked on a full queue would leak forever, and one mid-
+        ``get_task`` could keep pulling new work for a consumer that is
+        gone."""
 
         def gen():
             q = queue.Queue(maxsize=max(1, buffer_size))
             _END = object()
+            cancel = threading.Event()
 
             def produce():
                 try:
                     for x in self._gen_factory():
-                        q.put(x)
+                        while not cancel.is_set():
+                            try:
+                                q.put(x, timeout=0.5)
+                                break
+                            except queue.Full:
+                                continue
+                        if cancel.is_set():
+                            return
                     q.put(_END)
                 except BaseException as e:  # propagate into consumer
                     q.put(e)
 
             t = threading.Thread(target=produce, daemon=True)
             t.start()
-            while True:
-                item = q.get()
-                if item is _END:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
+            try:
+                while True:
+                    item = q.get()
+                    if item is _END:
+                        return
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+            finally:
+                # runs on normal exhaustion, close(), and GC of an
+                # abandoned consumer — the producer exits at its next
+                # queue-put or cancellation check
+                cancel.set()
 
         return Dataset(gen)
 

@@ -179,8 +179,51 @@ class Master:
 
             import os
 
+            # pipelined models need worlds whose DEVICE count divides
+            # the stage count: round every formed world down to the
+            # stage multiple and keep the overflow as hot spares
+            # (membership_service world_size_multiple). Derived from
+            # the model_params the job relays to every worker, assuming
+            # one device per worker process (the k8s pod shape). On
+            # multi-device hosts a smaller multiple suffices
+            # (stages/gcd(stages, local_devices)) — set
+            # EDL_WORLD_SIZE_MULTIPLE explicitly there.
+            from elasticdl_tpu.common.model_utils import (
+                get_dict_from_params_str,
+            )
+
+            stages = 0
+            try:
+                stages = int(
+                    (
+                        get_dict_from_params_str(
+                            getattr(args, "model_params", "") or ""
+                        )
+                        or {}
+                    ).get("pipeline_stages", 0)
+                    or 0
+                )
+            except (TypeError, ValueError):
+                pass
+            multiple = stages if stages > 1 else 1
+            env_multiple = os.environ.get("EDL_WORLD_SIZE_MULTIPLE")
+            if env_multiple:
+                multiple = max(1, int(env_multiple))
+            num_workers = max(1, getattr(args, "num_workers", 0))
+            if multiple > num_workers:
+                # every bump would round the world down to ZERO members
+                # — a silent never-trains stall, not elasticity
+                raise ValueError(
+                    "num_workers=%d cannot hold a world-size multiple "
+                    "of %d (pipeline_stages=%d would round every world "
+                    "down to 0 processes). Raise num_workers, lower "
+                    "pipeline_stages, or — on multi-device hosts where "
+                    "stages divide each worker's devices — set "
+                    "EDL_WORLD_SIZE_MULTIPLE to the true process "
+                    "multiple." % (num_workers, multiple, stages)
+                )
             self.membership = MembershipService(
-                expected_workers=max(1, getattr(args, "num_workers", 0)),
+                expected_workers=num_workers,
                 base_port=getattr(args, "comm_base_port", 0),
                 # cold worker start (jax import + reader priming) can
                 # exceed the default grace on loaded CI hosts; a partial
@@ -188,6 +231,7 @@ class Master:
                 form_grace_secs=float(
                     os.environ.get("EDL_FORM_GRACE_SECS", "30")
                 ),
+                world_size_multiple=multiple,
             )
         self._server = None
         self.instance_manager = self._create_instance_manager(args)

@@ -121,6 +121,7 @@ class MembershipService:
         form_grace_secs=30.0,
         confirm_timeout_secs=None,
         stale_form_secs=None,
+        world_size_multiple=1,
     ):
         """``base_port=0`` picks ephemeral ports (single-host jobs, where
         the master and rank 0 share the host); on a cluster pass a fixed
@@ -138,8 +139,17 @@ class MembershipService:
         laggard re-joins through its next poll. Without this, one stuck
         member makes the coordination service time out the formation
         barrier and *fatally terminate* every process that did register.
+
+        ``world_size_multiple > 1``: every formed world's process count
+        is rounded DOWN to a multiple (a pipelined model's stage count
+        must divide the device mesh — a 3-process world cannot hold a
+        2-stage pipe axis). The overflow members stay registered as hot
+        SPARES: their polls return ``{"spare": True}``, they idle
+        without holding a mesh slot (requeueing any pulled tasks), and
+        the next bump that reaches the multiple folds them in.
         """
         self._expected = max(1, expected_workers)
+        self._world_multiple = max(1, int(world_size_multiple))
         self._base_port = base_port
         self._form_grace_secs = form_grace_secs
         from elasticdl_tpu.parallel.distributed import (
@@ -236,6 +246,26 @@ class MembershipService:
         self._lobby = {}
         self._epoch += 1
         self._world = sorted(self._live.items())
+        if self._world_multiple > 1:
+            # round DOWN to the multiple; overflow members stay live as
+            # hot spares (their polls see {"spare": True})
+            usable = (
+                len(self._world)
+                // self._world_multiple
+                * self._world_multiple
+            )
+            if usable == 0 and self._world:
+                # survivors < multiple: nothing can train until
+                # relaunches/joiners refill the pool — say so, loudly,
+                # each time it happens (this is a stall, not a crash)
+                logger.warning(
+                    "world rounds down to 0 of %d live members "
+                    "(world_size_multiple=%d): training is PAUSED "
+                    "until the pool refills",
+                    len(self._world),
+                    self._world_multiple,
+                )
+            self._world = self._world[:usable]
         self._confirmed = set()
         self._formed = set()
         self._world_ready = not self._world  # empty world: nothing to form
@@ -436,15 +466,23 @@ class MembershipService:
                     return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
             ids = [w for w, _ in self._world]
             if worker_id not in ids:
-                # parked in the lobby, or removed as dead but evidently
-                # alive (register above re-adds / parks it)
+                # parked in the lobby, removed as dead but evidently
+                # alive (register above re-adds / parks it), or a hot
+                # SPARE a world_size_multiple round-down left out —
+                # spares idle without a mesh slot and must requeue any
+                # pulled tasks (the flag tells them)
                 if self._lobby and self._world_ready:
                     # staleness valve: a formation that still hasn't
                     # completed this long after ready specs went out is
                     # going to break anyway — stop holding joiners
                     if now - self._bump_time > self._stale_form_secs:
                         self._bump_locked()
-                return {"epoch": self._epoch, "ready": False, "dead": sorted(self._dead)}
+                return {
+                    "epoch": self._epoch,
+                    "ready": False,
+                    "spare": worker_id in self._live,
+                    "dead": sorted(self._dead),
+                }
             if self._world_ready and not awaiting:
                 # an awaiting=False poll is the training loop's per-step
                 # epoch check: this member established the current world

@@ -56,6 +56,35 @@ from elasticdl_tpu.training.step import (
 )
 
 
+def build_world_mesh(mesh_axes_fn=None):
+    """The elastic world's device mesh.
+
+    Default: every device on one flat ``("data",)`` axis. With a zoo
+    ``mesh_axes`` hook, the hook's ``{axis: size}`` layout (insertion
+    order = axis order), e.g. ``{"data": n // S, "pipe": S}`` — the
+    row-major reshape makes consecutive processes fill the trailing
+    axis first, so the first ``S`` processes form one complete pipe
+    group (and a world shrink keeps whole groups)."""
+    devices = np.asarray(jax.devices())
+    axes = mesh_axes_fn(devices.size) if mesh_axes_fn else None
+    if not axes:
+        return Mesh(devices, ("data",))
+    names = tuple(axes)
+    sizes = tuple(int(axes[n]) for n in names)
+    if int(np.prod(sizes)) != devices.size:
+        raise ValueError(
+            "mesh_axes %r does not cover the %d-device world"
+            % (axes, devices.size)
+        )
+    return Mesh(devices.reshape(sizes), names)
+
+
+def row_partition_spec(mesh):
+    """Dim-0-over-all-axes PartitionSpec (flattened device order)."""
+    names = tuple(mesh.axis_names)
+    return P(names if len(names) > 1 else names[0])
+
+
 def host_copy(tree):
     """Fetch each leaf's process-addressable replica to host numpy."""
 
@@ -81,11 +110,12 @@ def broadcast_from_device0(mesh, host_tree, source_process=0):
     n_local = jax.local_device_count()
     n_dev = mesh.devices.size
     src_dev = source_process * n_local
+    row_axes = row_partition_spec(mesh)[0]
 
     def place(x):
         x = np.asarray(x)
         tiled = np.broadcast_to(x[None], (n_local,) + x.shape)
-        spec = P(*(("data",) + (None,) * x.ndim))
+        spec = P(*((row_axes,) + (None,) * x.ndim))
         return jax.make_array_from_process_local_data(
             NamedSharding(mesh, spec), tiled, (n_dev,) + x.shape
         )
@@ -100,6 +130,19 @@ def broadcast_from_device0(mesh, host_tree, source_process=0):
 
 def _is_sharded_spec(spec):
     return spec is not None and any(a is not None for a in spec)
+
+
+def _spec_axes(spec):
+    """Flat set of mesh axis names a PartitionSpec shards over."""
+    used = set()
+    for entry in spec or ():
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
 
 
 class ShardMirror:
@@ -326,7 +369,7 @@ def make_elastic_train_step(
     loss_fn,
     optimizer,
     mesh,
-    axis="data",
+    axis=None,
     precision=None,
     accum_steps=1,
     state_specs=None,
@@ -334,6 +377,15 @@ def make_elastic_train_step(
 ):
     """Weighted lockstep step: ``(ts, features, labels, weights, epochs,
     rng) -> (ts', loss, n_active, epoch_consensus)``.
+
+    Works over ANY mesh axis layout: ``axis`` defaults to the mesh's
+    full axis-name tuple, the batch/weights/epochs shard over the
+    flattened device order, and reductions run over exactly the axes a
+    leaf is NOT sharded over — so a ``("data", "pipe")`` mesh reduces a
+    replicated leaf over both axes, a stage-sharded ``P("pipe")`` leaf
+    over ``data`` only, and a vocab-sharded ``P("data", None)`` leaf
+    over ``pipe`` only (its data-axis row gradients were already routed
+    by the collective lookup's a2a backward).
 
     ``epochs`` is a global (n_devices,) int32 of each process's
     last-polled membership epoch; ``epoch_consensus`` is its in-step
@@ -350,12 +402,13 @@ def make_elastic_train_step(
 
     ``state_specs``: optional pytree with the SAME treedef as the
     TrainState, each leaf a PartitionSpec — ``P()`` for replicated
-    leaves, e.g. ``P(axis, None)`` for HBM-sharded embedding tables (and
-    their co-sharded optimizer slots). Sharded leaves enter the step as
-    their local shard, their gradients stay local (no psum — the a2a
-    backward already routed and weighted them), and the module must use
-    collective lookups (nn/hbm_embedding.py ``collective=True``) since a
-    nested shard_map is impossible here. Constraint: the optimizer must
+    leaves, e.g. ``P("data", None)`` for HBM-sharded embedding tables
+    (and their co-sharded optimizer slots), ``P("pipe")`` for stacked
+    pipeline-stage subtrees. Sharded leaves enter the step as
+    their local shard, and the module must use raw in-step collectives
+    (nn/hbm_embedding.py ``collective=True``, pipeline.PipelinedStack
+    ``collective=True``) since a nested shard_map is impossible here.
+    Constraint: the optimizer must
     be per-leaf elementwise (sgd/momentum/adam/adagrad/... all are) —
     a transform that couples across leaves, e.g.
     ``optax.clip_by_global_norm``, would fold each device's DIFFERENT
@@ -378,9 +431,17 @@ def make_elastic_train_step(
 
     pol = get_policy(precision)
     forward = make_remat_forward(module, remat)
+    if axis is None:
+        axis = tuple(mesh.axis_names)
+    axes = axis if isinstance(axis, tuple) else (axis,)
 
     def _is_sharded(spec):
         return spec is not None and any(a is not None for a in spec)
+
+    def _unsharded_axes(spec):
+        """Mesh axes a leaf is replicated over (its reduction axes)."""
+        used = _spec_axes(spec)
+        return tuple(a for a in axes if a not in used)
 
     def per_device(ts, features, labels, weights, epochs, rng):
         w = weights[0].astype(jnp.float32)
@@ -392,13 +453,13 @@ def make_elastic_train_step(
         # different host iterations once deferred sync lets hosts run
         # ahead, and a member pausing early strands peers' in-flight
         # dispatched steps on a vanished rank.
-        epoch_seen = jax.lax.pmax(epochs[0], axis)
+        epoch_seen = jax.lax.pmax(epochs[0], axes)
         # decorrelate stochastic layers (dropout) across the batch shards
-        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axes))
         # liveness (how many devices carried data) is separate from the
         # weighted denominator: tail batches contribute fractional weight
-        n = jax.lax.psum((w > 0).astype(jnp.float32), axis)
-        denom = jnp.maximum(jax.lax.psum(w, axis), 1e-6)
+        n = jax.lax.psum((w > 0).astype(jnp.float32), axes)
+        denom = jnp.maximum(jax.lax.psum(w, axes), 1e-6)
         scale = w / denom
 
         def grads_of(state, features_mb, labels_mb, rng_mb):
@@ -450,18 +511,23 @@ def make_elastic_train_step(
             state_spec_tree = state_specs.state
 
         def reduce_grad(g, spec):
-            if _is_sharded(spec):
-                return g  # local shard; weighting rode the loss
-            return jax.lax.psum(g, axis)  # = sum_d (w_d/denom) g_d
+            # reduce over exactly the axes the leaf replicates over:
+            # all of them for dense leaves, none for a fully-sharded
+            # table on a 1-axis mesh (weighting rode the loss, the a2a
+            # backward already routed row gradients), the data axes for
+            # a P("pipe") stage subtree (stage replicas across data
+            # groups must agree)
+            red = _unsharded_axes(spec)
+            return jax.lax.psum(g, red) if red else g
 
         grads = jax.tree_util.tree_map(reduce_grad, grads, grad_specs)
-        loss = jax.lax.psum(loss * scale, axis)
+        loss = jax.lax.psum(loss * scale, axes)
 
         def wavg(x, spec):
             if _is_sharded(spec):
                 return x  # per-shard state stays local
             if jnp.issubdtype(x.dtype, jnp.floating):
-                return jax.lax.psum(x * w, axis) / denom
+                return jax.lax.psum(x * w, axes) / denom
             return x  # int leaves (counters) advance identically everywhere
 
         new_state = jax.tree_util.tree_map(
@@ -487,10 +553,14 @@ def make_elastic_train_step(
         ts_spec = P()
     else:
         ts_spec = state_specs
+    # batch/weights/epochs shard dim 0 over the FLATTENED device order,
+    # so each process's rows land on its own devices whatever the mesh
+    # shape (same layout the trainer places them with)
+    row_spec = row_partition_spec(mesh)
     sharded = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(ts_spec, P(axis), P(axis), P(axis), P(axis), P()),
+        in_specs=(ts_spec, row_spec, row_spec, row_spec, row_spec, P()),
         out_specs=(ts_spec, P(), P(), P()),
         check_rep=False,
     )
@@ -513,6 +583,7 @@ class ElasticDPTrainer:
         distributed_builder=None,
         restore_provider=None,
         remat=False,
+        mesh_axes_fn=None,
     ):
         """``distributed_builder``: optional ``mesh -> (module,
         param_specs)`` hook for HBM-sharded parameters (the zoo's
@@ -523,7 +594,15 @@ class ElasticDPTrainer:
         or None) — recovery granularity is the checkpoint cadence; with
         no checkpoint the state re-initializes (the reference lost its
         Redis-resident tables entirely on the same failure,
-        reference master/embedding_service.py)."""
+        reference master/embedding_service.py).
+
+        ``mesh_axes_fn``: optional ``n_devices -> {axis: size} | None``
+        (the zoo's ``mesh_axes`` hook) — the elastic world's mesh
+        layout, e.g. ``{"data": n // S, "pipe": S}`` for a pipelined
+        model. None/absent means the flat 1-axis ``("data",)`` mesh.
+        Raises at establish if the world size doesn't fit (the
+        membership layer's world_size_multiple exists to prevent such
+        worlds from forming)."""
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -533,6 +612,7 @@ class ElasticDPTrainer:
         self._remat = remat
         self._accum_steps = max(1, accum_steps)
         self._builder = distributed_builder
+        self._mesh_axes_fn = mesh_axes_fn
         self.restore_provider = restore_provider
         self._sharded_paths = {}
         self._state_specs = None
@@ -612,7 +692,7 @@ class ElasticDPTrainer:
         """
         distributed.ensure_world(spec)
         self._spec = spec
-        self._mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        self._mesh = build_world_mesh(self._mesh_axes_fn)
         self._mirror_perm_fn = None  # mesh changed: rebuild on demand
         self._wedged = False  # fresh backend: device fetches are safe again
         if self._builder is not None:
@@ -806,7 +886,12 @@ class ElasticDPTrainer:
     def mirror_enabled(self):
         """True when the replica plane is on (sharded job + cadence set).
         The flag comes from the job args, so it is GLOBAL: every rank
-        answers identically, which the collective call sites rely on."""
+        answers identically, which the collective call sites rely on.
+        Multi-axis meshes (pp x dp) gate it off until the range-based
+        capture/assembly lands — the 1-axis block math would stage
+        garbage; recovery falls back to sharded checkpoints."""
+        if self._mesh is not None and len(self._mesh.axis_names) > 1:
+            return False
         return bool(self.mirror_steps) and self.is_sharded
 
     def maybe_refresh_mirror(self, version):
@@ -1188,12 +1273,13 @@ class ElasticDPTrainer:
 
     def _place_batch(self, tree):
         n_proc = self._spec.num_processes
+        spec = row_partition_spec(self._mesh)
 
         def place(x):
             x = np.asarray(x)
             global_shape = (x.shape[0] * n_proc,) + x.shape[1:]
             return jax.make_array_from_process_local_data(
-                NamedSharding(self._mesh, P("data")), x, global_shape
+                NamedSharding(self._mesh, spec), x, global_shape
             )
 
         return jax.tree_util.tree_map(place, tree)
@@ -1257,15 +1343,16 @@ class ElasticDPTrainer:
         # from contributing a full step's worth of gradient
         w_value = min(1.0, count / rows) if has_data else 0.0
         w_local = np.full((n_local,), w_value, dtype=np.float32)
+        row_spec = row_partition_spec(self._mesh)
         g_features = self._place_batch(local[0])
         g_labels = self._place_batch(local[1])
         g_weights = jax.make_array_from_process_local_data(
-            NamedSharding(self._mesh, P("data")),
+            NamedSharding(self._mesh, row_spec),
             w_local,
             (self._mesh.devices.size,),
         )
         g_epochs = jax.make_array_from_process_local_data(
-            NamedSharding(self._mesh, P("data")),
+            NamedSharding(self._mesh, row_spec),
             np.full((n_local,), int(epoch_hint), dtype=np.int32),
             (self._mesh.devices.size,),
         )

@@ -135,6 +135,58 @@ def stage_param_sharding(mesh, pipe_axis="pipe"):
     return NamedSharding(mesh, P(pipe_axis))
 
 
+def collective_pipeline_apply(
+    stage_fn, local_stage_params, x_local, pipe_axis, microbatches=0
+):
+    """Pipeline ring over per-device batch rows INSIDE an enclosing
+    shard_map — the elastic weighted step's form of pipeline
+    parallelism, the same raw-collective recipe as
+    nn/hbm_embedding.py's ``collective=True`` lookups (a nested
+    shard_map is impossible there).
+
+    - ``local_stage_params``: this device's slice of the stacked stage
+      params — leading dim 1 (the pipe axis size must equal the stage
+      count).
+    - ``x_local``: (b_loc, ...) THIS device's activation rows (each
+      device of a data group holds different rows).
+    - Returns (b_loc, ...): the ring outputs for exactly this device's
+      rows.
+
+    Data flow: all_gather the data group's rows over ``pipe_axis`` (so
+    stage 0 can ingest the whole group's stream), microbatch, run the
+    ring, psum-broadcast the last stage's outputs back over the pipe
+    axis, slice this device's rows back out. Gradient flow is exact:
+    the all_gather's transpose routes activation gradients back to each
+    row's source device; the ppermute transposes inside the ring's
+    backward deliver each stage's parameter gradients to that stage's
+    devices (the step then psums them over the remaining axes).
+    """
+    n_stages = jax.lax.psum(1, pipe_axis)
+    stage = jax.lax.axis_index(pipe_axis)
+    b_loc = x_local.shape[0]
+    group = jax.lax.all_gather(x_local, pipe_axis, tiled=True)
+    rows = group.shape[0]
+    m = microbatches or n_stages
+    padded = -(-rows // m) * m
+    if padded != rows:
+        group = jnp.concatenate(
+            [
+                group,
+                jnp.broadcast_to(
+                    group[-1:], (padded - rows,) + group.shape[1:]
+                ),
+            ]
+        )
+    micro = jnp.reshape(group, (m, padded // m) + group.shape[1:])
+    out = pipeline_apply(stage_fn, local_stage_params, micro, pipe_axis)
+    # only the last stage's outputs are the ring's result; broadcast
+    # them to every pipe rank so each can slice its own rows
+    mask = (stage == n_stages - 1).astype(out.dtype)
+    out = jax.lax.psum(out * mask, pipe_axis)
+    flat = jnp.reshape(out, (padded,) + out.shape[2:])[:rows]
+    return jax.lax.dynamic_slice_in_dim(flat, stage * b_loc, b_loc, 0)
+
+
 class PipelinedStack(nn.Module):
     """Flax module running a stage template through the pipe ring.
 
@@ -153,6 +205,13 @@ class PipelinedStack(nn.Module):
       S/(S+M-1) of ticks are ramp).
     - ``mesh=None``: degenerate single-device form — runs the stages
       sequentially (used for init shape-tracing and CPU smoke tests).
+    - ``collective=True``: the module is being applied INSIDE an
+      enclosing shard_map whose mesh has a ``pipe`` axis (the elastic
+      weighted step). The stacked param arrives as this device's local
+      (1, ...) stage slice, and the ring runs via raw collectives
+      (:func:`collective_pipeline_apply`) — ``mesh`` stays None. Init
+      still traces the sequential form and creates the full (S, ...)
+      stacked parameters.
 
     Parameters are created by initializing the template once per stage
     and stacking each leaf on a leading (S,) dim — a single flax param
@@ -165,6 +224,7 @@ class PipelinedStack(nn.Module):
     mesh: object = None
     pipe_axis: str = "pipe"
     microbatches: int = 0
+    collective: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -178,11 +238,31 @@ class PipelinedStack(nn.Module):
             ]
             return stack_stage_params(per)
 
-        stacked = self.param("stages", init_fn)
+        if self.collective:
+            # self.variable, not self.param: flax shape-validates params
+            # against their initializer at apply time, but in collective
+            # mode the apply-time value is this device's (1, ...) LOCAL
+            # stage slice of the declared (S, ...) stacked subtree (the
+            # same recipe as nn/hbm_embedding.py's collective table)
+            stacked = self.variable(
+                "params",
+                "stages",
+                lambda: init_fn(self.make_rng("params")),
+            ).value
+        else:
+            stacked = self.param("stages", init_fn)
 
         def stage_fn(params, act):
             return self.stage_template.apply({"params": params}, act)
 
+        if self.collective and not self.is_initializing():
+            return collective_pipeline_apply(
+                stage_fn,
+                stacked,
+                x,
+                self.pipe_axis,
+                microbatches=self.microbatches,
+            )
         if (
             self.is_initializing()
             or self.mesh is None

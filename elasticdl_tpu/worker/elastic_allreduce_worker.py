@@ -139,6 +139,22 @@ class ElasticAllReduceWorker:
             JobType.EVALUATION_ONLY,
             JobType.PREDICTION_ONLY,
         )
+        from elasticdl_tpu.common.model_utils import (
+            get_dict_from_params_str,
+        )
+
+        extra = get_dict_from_params_str(model_params) or {}
+        wants_sharded = self._zoo_wants_sharded_params(
+            zoo_module, model_params
+        )
+        # host-twin zoos (build_host_model) serve sharded tables by
+        # scoring a dense same-structure twin against checkpoint
+        # shards; zoos without the twin serve with the degenerate
+        # (mesh=None) distributed form, which consumes checkpoints AND
+        # exported model files
+        host_twin_serving = (
+            self._serving_only and "build_host_model" in zoo_module
+        )
         if self._serving_only:
             if not (checkpoint_dir or checkpoint_filename_for_init):
                 raise ValueError(
@@ -148,10 +164,7 @@ class ElasticAllReduceWorker:
                     "--checkpoint_filename_for_init (an exported model "
                     "file)" % self._job_type
                 )
-            if (
-                "build_collective_model" in zoo_module
-                and not checkpoint_dir
-            ):
+            if host_twin_serving and not checkpoint_dir:
                 # the sharded host-twin path only reads checkpoint dirs
                 raise ValueError(
                     "%s for sharded-parameter model %s needs "
@@ -160,29 +173,26 @@ class ElasticAllReduceWorker:
                     "forward" % (self._job_type, model_def)
                 )
         builder = None
+        mesh_axes_fn = None
         self._host_model_factory = None
         if (
             self._serving_only
+            and not host_twin_serving
             and "build_distributed_model" in zoo_module
-            and "build_collective_model" not in zoo_module
         ):
             # score with the degenerate (mesh=None) distributed form: it
             # has the same parameter STRUCTURE the distributed training
             # job checkpointed (e.g. the pipelined transformer's stacked
             # stage subtree) and runs sequentially on local devices —
             # pass the same --model_params the training job used
-            from elasticdl_tpu.common.model_utils import (
-                get_dict_from_params_str,
-            )
-
             self._model = zoo_module["build_distributed_model"](
-                mesh=None, **(get_dict_from_params_str(model_params) or {})
+                mesh=None, **extra
             )
         if (
             "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
             and not self._serving_only
-            and self._zoo_wants_sharded_params(zoo_module, model_params)
+            and wants_sharded
         ):
             # training the plain replicated model instead would either
             # OOM (the table was sharded because it doesn't fit) or
@@ -193,24 +203,42 @@ class ElasticAllReduceWorker:
                 "build_collective_model hook; the multi-process elastic "
                 "plane needs the collective-lookup form — add "
                 "build_collective_model (see "
-                "model_zoo/deepfm_edl_embedding) or run the "
+                "model_zoo/deepfm_edl_embedding or "
+                "model_zoo/transformer_lm) or run the "
                 "single-process ALLREDUCE strategy" % model_def
             )
-        if "build_collective_model" in zoo_module:
-            # HBM-sharded tables on the elastic plane: the model looks
-            # rows up with raw collectives inside the weighted step's
-            # shard_map, tables shard per param_shardings, and re-forms
-            # restore from the sharded checkpoint plane
-            from elasticdl_tpu.common.model_utils import (
-                get_dict_from_params_str,
+        if "build_collective_model" in zoo_module and (
+            host_twin_serving
+            or (not self._serving_only and wants_sharded)
+        ):
+            # sharded parameters on the elastic plane (HBM vocab tables,
+            # stacked pipeline stages): the model uses raw collectives
+            # inside the weighted step's shard_map, parameters shard per
+            # param_shardings, and re-forms restore from the replica
+            # plane / sharded checkpoints. The module is built EAGERLY
+            # (a flax dataclass — no device work) so unsupported
+            # configs fail here, at worker construction, not after
+            # world formation
+            collective_module = zoo_module["build_collective_model"](
+                **extra
             )
 
-            extra = get_dict_from_params_str(model_params) or {}
-
-            def builder(mesh, _zoo=zoo_module, _extra=extra):
+            def builder(
+                mesh, _module=collective_module, _zoo=zoo_module, _extra=extra
+            ):
                 return (
-                    _zoo["build_collective_model"](**_extra),
-                    _zoo["param_shardings"](mesh),
+                    _module,
+                    _zoo["param_shardings"](mesh, **_extra),
+                )
+
+            if "mesh_axes" in zoo_module:
+                # the elastic world's mesh layout (e.g. data x pipe for
+                # pipelined models); evaluated per world size at each
+                # establish
+                mesh_axes_fn = (
+                    lambda n, _zoo=zoo_module, _extra=extra: _zoo[
+                        "mesh_axes"
+                    ](n, **_extra)
                 )
 
             if "build_host_model" in zoo_module:
@@ -250,6 +278,7 @@ class ElasticAllReduceWorker:
             accum_steps=accum_steps,
             distributed_builder=builder,
             remat=parse_remat(remat),
+            mesh_axes_fn=mesh_axes_fn,
         )
         # in-memory replica plane: bounded-staleness no-disk recovery
         # for the sharded leaves (parallel/elastic.py ShardMirror);
@@ -463,7 +492,15 @@ class ElasticAllReduceWorker:
 
         Returns a WorldSpec, or None if the job finished while waiting
         (every process drained and the master stopped handing out work).
+
+        A ``spare`` reply means a ``world_size_multiple`` round-down
+        left this live worker out of the current world (e.g. 3
+        survivors of a 2-stage pipelined job form a world of 2): it
+        idles here WITHOUT a mesh slot, so any pulled-but-untrained
+        work goes back to the master immediately — a spare holding
+        tasks would stall job completion for everyone.
         """
+        spare_flushed = False
         while True:
             if self._preempted:
                 return None  # drain notice while between worlds
@@ -480,9 +517,39 @@ class ElasticAllReduceWorker:
                     process_id=w["process_id"],
                     epoch=w["epoch"],
                 )
+            if w.get("spare") and not spare_flushed:
+                spare_flushed = True
+                self._requeue_as_spare()
             if self._drained and self._retry_batch is None:
                 return None
             time.sleep(0.2)
+
+    def _requeue_as_spare(self):
+        """Hand every in-flight task back to the master (fail-report +
+        requeue), drop the primed batch, and abandon the current data
+        round: a spare trains nothing, world members can finish the
+        work it was holding, and the round's buffered stream cannot be
+        rewound past the requeued tasks (TaskDataService
+        ``requeue_inflight``). On rejoin the run loop re-primes from a
+        fresh round."""
+        tds = self._task_data_service
+        # no early-out on an "empty" ledger: the round may still be OPEN
+        # with a producer thread about to pull a fresh task — the round
+        # bump below is what tells it to step aside
+        logger.info(
+            "parked as spare (world-size rounding); requeueing "
+            "in-flight work and abandoning the open round"
+        )
+        msg = "parked as spare (world size rounding)"
+        self._retry_batch = None
+        # settle any stepped-but-unreported window first (normally empty
+        # — the reform pause flushed it); its cursor advance must land
+        # before the ledger is requeued wholesale
+        self._flush_unreported(msg)
+        tds.requeue_inflight(msg)
+        # restart the batch stream: the abandoned round's generator and
+        # its prefetch buffer die with the old handle
+        self._batch_gen = self._batches()
 
     def _await_epoch_bump(self, stale_epoch):
         """After a collective failure: wait for the master to re-form.
@@ -538,6 +605,13 @@ class ElasticAllReduceWorker:
                 break
             try:
                 example = self._retry_batch or self.trainer._last_local
+                if example is None:
+                    # rejoining after a spare park requeued everything:
+                    # prime a fresh batch (shapes gate the mesh slot)
+                    first = self._prime()
+                    if first is None:
+                        break  # drained/preempted while parked
+                    self._retry_batch = example = first
                 self.trainer.establish(world, example_batch=example)
                 if self._ckpt is not None:
                     # ring eviction must know what "complete" means in

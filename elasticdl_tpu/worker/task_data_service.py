@@ -58,6 +58,9 @@ class TaskDataService:
         # into the stream so no records are lost.
         self._primed_task = None
         self._metadata_primed = False
+        # bumped (under the ledger lock) whenever an open round is
+        # abandoned wholesale; stale producers notice and step aside
+        self._round_id = 0
 
     # ------------------------------------------------------------------
     # in-flight ledger
@@ -125,6 +128,36 @@ class TaskDataService:
                 self._bad_records += count
             self._drain_acknowledged(err_msg)
 
+    def requeue_inflight(self, err_msg):
+        """Fail-report every in-flight (and primed) task — the master
+        requeues them for other workers — and abandon the open record
+        stream so the next ``get_dataset`` starts a clean round.
+
+        A worker parked as an elastic SPARE cannot rewind its stream:
+        the round's generator is mid-``read_records`` and ``prefetch``
+        still buffers records of the tasks being handed back, so
+        advancing the old stream after a requeue would charge leftover
+        records against the NEXT ledger task (acknowledging work that
+        never trained, double-training the requeued task elsewhere).
+        Dropping the whole round is the only consistent cut. Bumping
+        ``_round_id`` under the lock tells a producer thread mid-
+        ``get_task`` to hand its fresh task straight back instead of
+        appending to the cleared ledger (see ``_record_stream``); the
+        abandoned producer itself is cancelled by prefetch when the
+        consumer generator is dropped."""
+        with self._ledger_lock:
+            self._round_id += 1
+            inflight = list(self._inflight)
+            self._clear_ledger()
+            if self._primed_task is not None:
+                # pulled for metadata priming, never consumed: it is in
+                # the master's "doing" set and must go back too
+                inflight.append(self._primed_task)
+                self._primed_task = None
+        for task in inflight:
+            self._worker.report_task_result(task.task_id, err_msg)
+        self._stream_open = True
+
     # ------------------------------------------------------------------
     # dataset construction
     # ------------------------------------------------------------------
@@ -156,7 +189,8 @@ class TaskDataService:
             return
         task = self._worker.get_task()
         if task.shard_name:
-            self._primed_task = task
+            with self._ledger_lock:
+                self._primed_task = task
             for _ in self.data_reader.read_records(task):
                 break
         self._metadata_primed = True
@@ -180,11 +214,22 @@ class TaskDataService:
 
     def _record_stream(self):
         """Generator: pull tasks until the master says stop, yield records."""
+        gen_id = self._round_id
         while True:
-            if self._primed_task is not None:
+            with self._ledger_lock:
                 task, self._primed_task = self._primed_task, None
-            else:
+            if task is None:
                 task = self._worker.get_task()
+            if self._round_id != gen_id:
+                # the round was abandoned (spare park) while this
+                # producer was fetching: hand the task straight back —
+                # appending it to the cleared ledger would leak it in
+                # the master's doing-set forever
+                if task.shard_name:
+                    self._worker.report_task_result(
+                        task.task_id, "round abandoned (spare park)"
+                    )
+                return
             if not task.shard_name:
                 if task.type == TaskType.WAIT:
                     # More data may show up (e.g. a lazy next epoch); let

@@ -320,6 +320,9 @@ class PipelinedTransformerLM(nn.Module):
     pipeline_stages: int = 2
     microbatches: int = 0
     use_flash: bool = True
+    # in-step raw-collective ring for the multi-process elastic plane
+    # (applied inside the weighted step's shard_map; mesh stays None)
+    collective: bool = False
 
     @nn.compact
     def __call__(self, features, training=False):
@@ -353,11 +356,40 @@ class PipelinedTransformerLM(nn.Module):
             n_stages=self.pipeline_stages,
             mesh=self.mesh,
             microbatches=self.microbatches,
+            collective=self.collective,
             name="pipe",
         )(x)
         x = nn.RMSNorm(dtype=self.dtype)(x)
         logits = embed_layer.attend(x.astype(jnp.float32))
         return logits
+
+
+_PIPELINE_SUPPORTED_PARAMS = frozenset(
+    {
+        "vocab_size",
+        "num_layers",
+        "num_heads",
+        "head_dim",
+        "embed_dim",
+        "mlp_dim",
+        "use_flash",
+    }
+)
+
+
+def _check_pipeline_params(params):
+    """Reject model params the pipelined form would silently drop —
+    training a DIFFERENT model than asked for (e.g. dense instead of
+    MoE). Shared by both pipelined entry points so their supported
+    sets cannot drift."""
+    unsupported = set(params) - _PIPELINE_SUPPORTED_PARAMS
+    if unsupported:
+        raise ValueError(
+            "pipeline_stages > 1 does not support model params %s "
+            "(pipeline composes with data parallelism only for "
+            "now; MoE/seq-parallel pipelined configs are not "
+            "implemented)" % sorted(unsupported)
+        )
 
 
 def build_distributed_model(
@@ -371,25 +403,7 @@ def build_distributed_model(
     # consumed by param_shardings (placement), not by the model itself
     params.pop("shard_vocab", None)
     if stages > 1:
-        supported = {
-            "vocab_size",
-            "num_layers",
-            "num_heads",
-            "head_dim",
-            "embed_dim",
-            "mlp_dim",
-            "use_flash",
-        }
-        unsupported = set(params) - supported
-        if unsupported:
-            # dropping them silently would train a DIFFERENT model than
-            # the user asked for (e.g. dense instead of MoE)
-            raise ValueError(
-                "pipeline_stages > 1 does not support model params %s "
-                "(pipeline composes with data parallelism only for "
-                "now; MoE/seq-parallel pipelined configs are not "
-                "implemented)" % sorted(unsupported)
-            )
+        _check_pipeline_params(params)
         return PipelinedTransformerLM(
             mesh=mesh,
             pipeline_stages=stages,
@@ -398,6 +412,46 @@ def build_distributed_model(
             **params,
         )
     return custom_model(mesh=mesh, dtype=dtype, **params)
+
+
+def build_collective_model(
+    pipeline_stages=0, microbatches=0, dtype="float32", **params
+):
+    """Zoo hook for the MULTI-PROCESS elastic plane: the pipelined
+    transformer in its raw-collective form, applied inside the weighted
+    step's shard_map over a ("data", "pipe") mesh (see
+    parallel/pipeline.collective_pipeline_apply). The mesh axis layout
+    comes from :func:`mesh_axes`; stage parameters shard per
+    :func:`param_shardings`. Requires ``pipeline_stages > 1`` — plain
+    (non-sharding) configs train replicated via ``custom_model`` and
+    never route here (the worker gates on param_shardings' probe)."""
+    stages = int(pipeline_stages)
+    if params.pop("shard_vocab", None):
+        # param_shardings would declare the embed table P("data", None)
+        # while this model builds a full-vocab nn.Embed — the step would
+        # feed the local shard to a full-table module. Fail fast with
+        # the boundary instead of crash-looping at establish.
+        raise ValueError(
+            "shard_vocab is not supported on the multi-process elastic "
+            "plane yet (the pipelined collective form keeps the embed "
+            "table replicated); drop shard_vocab, or use the "
+            "single-process ALLREDUCE strategy for vocab-sharded "
+            "training"
+        )
+    if stages <= 1:
+        raise ValueError(
+            "build_collective_model needs pipeline_stages > 1; "
+            "non-pipelined configs train on the replicated plane"
+        )
+    _check_pipeline_params(params)
+    return PipelinedTransformerLM(
+        mesh=None,
+        collective=True,
+        pipeline_stages=stages,
+        microbatches=int(microbatches),
+        dtype=jnp.dtype(dtype),
+        **params,
+    )
 
 
 def param_shardings(mesh, pipeline_stages=0, shard_vocab=False, **_params):

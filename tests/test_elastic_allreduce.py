@@ -12,6 +12,7 @@ import os
 import sys
 import threading
 import time
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -389,11 +390,11 @@ def test_replicated_eval_pins_version_snapshot():
     assert worker._eval_scored_version == 9
 
 
-def test_elastic_worker_accepts_transformer_without_pipeline():
-    """transformer_lm now declares build_distributed_model (the
-    single-process pipeline path); the multi-process elastic worker must
-    keep training it REPLICATED when no pipeline is requested, and only
-    reject configs that actually shard parameters."""
+def test_elastic_worker_routes_transformer_configs():
+    """The multi-process elastic worker trains transformer_lm REPLICATED
+    when no pipeline is requested, and routes pipelined configs to the
+    collective (in-step ring) form — the r4 NotImplementedError boundary
+    is gone (VERDICT r4 item 1)."""
     from elasticdl_tpu.common.constants import JobType
     from elasticdl_tpu.worker.elastic_allreduce_worker import (
         ElasticAllReduceWorker,
@@ -414,12 +415,15 @@ def test_elastic_worker_accepts_transformer_without_pipeline():
     )
     assert not worker.trainer.is_sharded
 
-    # pipelined config shards stage params -> needs the collective form
-    with pytest.raises(NotImplementedError, match="collective"):
-        ElasticAllReduceWorker(
-            model_params="vocab_size=64,num_layers=2,pipeline_stages=2",
-            **kwargs,
-        )
+    # pipelined config: stage params shard over "pipe"; the trainer gets
+    # the collective builder + the zoo's mesh-axes layout
+    worker = ElasticAllReduceWorker(
+        model_params="vocab_size=64,num_layers=2,pipeline_stages=2",
+        **kwargs,
+    )
+    assert worker.trainer.is_sharded
+    assert worker.trainer._mesh_axes_fn is not None
+    assert worker.trainer._mesh_axes_fn(8) == {"data": 4, "pipe": 2}
 
 
 def test_evaluation_round_records_scored_versions():
@@ -908,3 +912,93 @@ def test_elastic_allreduce_evaluation_interleave(tmp_path, monkeypatch):
     for version, metrics in published:
         assert version > 0
         assert metrics, "empty evaluation summary"
+
+
+def test_membership_world_size_multiple_rounds_down():
+    """Pipelined jobs need worlds whose size divides the stage count:
+    formation rounds DOWN to the multiple, overflow members poll as
+    spares ({"spare": True}), and reaching the multiple folds them in."""
+    m = MembershipService(
+        expected_workers=4, form_grace_secs=0.01, world_size_multiple=2
+    )
+
+    def drive_formation(members):
+        # confirm (awaiting=True) then mark trained (awaiting=False) so
+        # the two-phase formation completes and lobby joiners fold in
+        for _ in range(6):
+            for wid in members:
+                m.get_world(wid)
+            for wid in members:
+                m.get_world(wid, awaiting=False)
+
+    m.get_world(0)
+    time.sleep(0.05)
+    for w in (0, 1, 2):
+        m.get_world(w)
+    drive_formation([0, 1])
+    # 3 live -> world of 2, lowest ids win; 2 polls as a spare
+    w2 = m.get_world(2)
+    assert not w2["ready"] and w2.get("spare")
+    world = _poll_ready(m, 0)
+    assert world["num_processes"] == 2
+    assert world["members"] == [0, 1]
+    # the 4th member arrives -> the next bump forms a full world of 4
+    m.get_world(3)
+    drive_formation([0, 1, 2, 3])
+    world = _poll_ready(m, 2)
+    assert world["num_processes"] == 4
+    # a death drops 4 -> world of 2 again (3 survivors round down)
+    m.remove(1)
+    drive_formation([0, 2])
+    world = _poll_ready(m, 0)
+    assert world["num_processes"] == 2
+    assert world["members"] == [0, 2]
+    spare = m.get_world(3)
+    assert not spare["ready"] and spare.get("spare")
+
+
+def test_spare_worker_requeues_inflight_tasks():
+    """A worker parked as a spare must hand its pulled tasks back (the
+    members finish them; a spare holding tasks stalls the job)."""
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.worker.elastic_allreduce_worker import (
+        ElasticAllReduceWorker,
+    )
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    class SpareStub:
+        """Master stub: always answers 'you are a spare'."""
+
+        def __init__(self):
+            self.reported = []
+
+        def get_comm_world(self, worker_id, host=None, awaiting=True):
+            return {"epoch": 3, "ready": False, "spare": True, "dead": []}
+
+        def report_task_result(self, task_id, err_msg, exec_counters=None):
+            self.reported.append((task_id, err_msg))
+            return {}
+
+    stub = SpareStub()
+    worker = ElasticAllReduceWorker(
+        worker_id=5,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=4,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def="transformer_lm.transformer_lm.custom_model",
+        model_params="vocab_size=64,num_layers=2,pipeline_stages=2",
+        stub=stub,
+    )
+    # simulate a primed worker holding one in-flight task
+    tds = worker._task_data_service
+    task = SimpleNamespace(task_id=9, start=0, end=8, type=None)
+    tds._inflight.append(task)
+    tds._record_cursor = 4  # half consumed (the primed batch)
+    worker._retry_batch = ({"tokens": np.zeros((4, 8), np.int32)},
+                           np.zeros((4, 8), np.int32))
+
+    worker._requeue_as_spare()
+    assert worker._retry_batch is None
+    assert tds.get_current_task() is None
+    assert stub.reported and stub.reported[0][0] == 9
+    assert "spare" in stub.reported[0][1]
