@@ -164,38 +164,118 @@ class ShardMirror:
     __slots__ = (
         "version",
         "n_old",
-        "own_block",
+        "old_pid",
         "own",
         "replica",
         "replicated",
     )
 
-    def __init__(self, version, n_old, own_block, own, replica, replicated):
+    def __init__(self, version, n_old, old_pid, own, replica, replicated):
         self.version = version
         self.n_old = n_old  # process count of the world that captured it
-        self.own_block = own_block  # this rank's block index in that world
-        self.own = own  # {path names: np (V/n_old, ...)}
-        self.replica = replica  # left neighbor's block, same keying
+        self.old_pid = old_pid  # this rank's process id in that world
+        self.own = own  # {path names: np rows of this process's block}
+        self.replica = replica  # left neighbor process's block, same keying
         self.replicated = replicated  # host ts; sharded leaves are placeholders
 
 
-def plan_mirror_assembly(info, floor=0, allow_stale=True):
-    """Pure decision core of the replica-plane assembly.
+def process_dim0_block(axes, spec, shape0, n_local, pid):
+    """(lo, hi) of the contiguous dim-0 rows process ``pid`` holds for a
+    leaf whose dim 0 is sharded per ``spec`` on a mesh laid out as
+    ``axes`` ({name: size}, insertion order = axis order).
 
-    ``info``: ``[(has, version, n_old, own_block)]`` indexed by new
-    rank (the all-gathered summary — identical on every rank, so this
-    plan is too). Returns ``(target_v, n_old, alive_blocks)`` when a
-    complete assembly is possible, else None:
+    Derived analytically from the mesh layout — the replica plane needs
+    any OLD process's block without that process being alive (its
+    mirror holder reconstructs the range from the old world's shape
+    alone). Handles any dim-0 sharding: single axis, axis tuples, and
+    leaves replicated over part of the mesh (a P("pipe") stage subtree
+    on a data x pipe mesh repeats the same range across data groups).
+    """
+    entry = spec[0] if spec is not None and len(spec) else None
+    if entry is None:
+        return (0, shape0)
+    axs = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+    names = tuple(axes)
+    sizes = tuple(int(axes[n]) for n in names)
+    shard_count = 1
+    for a in axs:
+        shard_count *= int(axes[a])
+    rows = shape0 // shard_count
+    starts = set()
+    for d in range(pid * n_local, (pid + 1) * n_local):
+        coord = dict(zip(names, np.unravel_index(d, sizes)))
+        idx = 0
+        for a in axs:
+            idx = idx * int(axes[a]) + int(coord[a])
+        starts.add(idx * rows)
+    lo, hi = min(starts), max(starts) + rows
+    if hi - lo != len(starts) * rows:
+        raise ValueError(
+            "process %d holds a non-contiguous dim-0 block for spec %r "
+            "on mesh %r" % (pid, spec, axes)
+        )
+    return (lo, hi)
+
+
+def _subtract_intervals(lo, hi, covered):
+    """Pieces of [lo, hi) not covered by the sorted disjoint list."""
+    out = []
+    cur = lo
+    for s, e in covered:
+        if e <= cur:
+            continue
+        if s >= hi:
+            break
+        if s > cur:
+            out.append((cur, min(s, hi)))
+        cur = max(cur, e)
+        if cur >= hi:
+            break
+    if cur < hi:
+        out.append((cur, hi))
+    return out
+
+
+def _insert_interval(covered, lo, hi):
+    covered.append((lo, hi))
+    covered.sort()
+    merged = []
+    for s, e in covered:
+        if merged and s <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+        else:
+            merged.append((s, e))
+    covered[:] = merged
+
+
+def plan_mirror_ranges(
+    info, leaf_blocks, leaf_spans, floor=0, allow_stale=True
+):
+    """Pure decision core of the replica-plane assembly (range-based).
+
+    ``info``: ``[(has, version, n_old, old_pid)]`` indexed by NEW rank
+    (the all-gathered summary — identical on every rank, so this plan
+    is too). ``leaf_blocks``: ``{path: fn(old_pid) -> (lo, hi)}`` — the
+    dim-0 interval each OLD process owned (its ppermute replica covers
+    its LEFT neighbor ``(pid - 1) % n_old``). ``leaf_spans``:
+    ``{path: total_rows}``.
+
+    Returns ``(target_v, n_old, {path: [(lo, hi, src_rank, kind)]})``
+    with disjoint pieces covering ``[0, total)`` per path (kind 0 =
+    the source rank's own block, 1 = its replica), or None:
 
     - the target version is the newest mirrored version; mirrors from
       an older refresh (a rank that somehow missed one) are excluded,
-    - duplicate claims to one old block keep the lowest new rank,
-    - every old block must be covered by its owner or — the replica
-      rule — its right neighbor ``(b+1) % n_old``, who holds its copy.
+    - duplicate claims to one old pid keep the lowest new rank,
+    - own blocks are preferred over replicas; within a kind the lowest
+      rank wins — every rank computes the identical assignment,
+    - replication across the old mesh (stage shards repeated over data
+      groups) means ANY holder of a row range covers it, which is how
+      a pp x dp job survives losing a whole pipe column.
     """
     have = [
-        (rank, v, n, blk)
-        for rank, (has, v, n, blk) in enumerate(info)
+        (rank, v, n, pid)
+        for rank, (has, v, n, pid) in enumerate(info)
         if has
     ]
     if not have:
@@ -207,24 +287,52 @@ def plan_mirror_assembly(info, floor=0, allow_stale=True):
     if len(n_olds) != 1:
         return None
     n_old = n_olds.pop()
-    alive_blocks = {}
-    for rank, v, n, blk in sorted(have):
-        if v == target_v and n == n_old and blk not in alive_blocks:
-            alive_blocks[blk] = rank
-    for b in range(n_old):
-        if b not in alive_blocks and (b + 1) % n_old not in alive_blocks:
+    seen_pids = set()
+    holders = []  # (new_rank, old_pid), lowest rank keeps a dup pid
+    for rank, v, n, pid in sorted(have):
+        if v == target_v and n == n_old and pid not in seen_pids:
+            seen_pids.add(pid)
+            holders.append((rank, pid))
+    plan = {}
+    for path, block_of in leaf_blocks.items():
+        total = leaf_spans[path]
+        candidates = [
+            (0, rank, block_of(pid)) for rank, pid in holders
+        ] + [
+            (1, rank, block_of((pid - 1) % n_old))
+            for rank, pid in holders
+        ]
+        covered = []
+        pieces = []
+        for kind, rank, (lo, hi) in sorted(
+            candidates, key=lambda c: (c[0], c[1])
+        ):
+            for s, e in _subtract_intervals(lo, hi, covered):
+                pieces.append((s, e, rank, kind))
+                _insert_interval(covered, s, e)
+        if covered != [(0, total)]:
             return None
-    return target_v, n_old, alive_blocks
+        plan[path] = sorted(pieces)
+    return target_v, n_old, plan
 
 
 def _local_block(arr):
     """(rows ndarray, global row offset) of this process's contiguous
-    slice of a row-sharded global array."""
-    shards = sorted(
-        arr.addressable_shards, key=lambda s: s.index[0].start or 0
-    )
-    rows = np.concatenate([np.asarray(s.data) for s in shards])
-    return rows, int(shards[0].index[0].start or 0)
+    slice of a row-sharded global array. Deduplicates shards by offset:
+    a leaf replicated over part of the mesh (a P("pipe") stage subtree
+    on a data x pipe mesh) presents the same rows on several local
+    devices, which must not be concatenated twice."""
+    by_start = {}
+    for s in arr.addressable_shards:
+        start = int(s.index[0].start or 0)
+        if start not in by_start:
+            by_start[start] = np.asarray(s.data)
+    starts = sorted(by_start)
+    rows = np.concatenate([by_start[s] for s in starts])
+    span = sum(by_start[s].shape[0] for s in starts)
+    if starts[-1] + by_start[starts[-1]].shape[0] - starts[0] != span:
+        raise ValueError("non-contiguous local block")
+    return rows, starts[0]
 
 
 def _max_checkpoint_version(candidate_dirs):
@@ -883,15 +991,20 @@ class ElasticDPTrainer:
 
     # -- in-memory replica plane (no-disk recovery) -------------------------
 
+    def _world_axes(self, n_devices):
+        """Mesh layout for an arbitrary world size: the zoo hook's
+        answer, else the flat 1-axis data layout. Deterministic, so
+        every rank (and every FUTURE world reasoning about a PAST
+        world's blocks) computes the same layout."""
+        axes = (
+            self._mesh_axes_fn(n_devices) if self._mesh_axes_fn else None
+        )
+        return dict(axes) if axes else {"data": int(n_devices)}
+
     def mirror_enabled(self):
         """True when the replica plane is on (sharded job + cadence set).
         The flag comes from the job args, so it is GLOBAL: every rank
-        answers identically, which the collective call sites rely on.
-        Multi-axis meshes (pp x dp) gate it off until the range-based
-        capture/assembly lands — the 1-axis block math would stage
-        garbage; recovery falls back to sharded checkpoints."""
-        if self._mesh is not None and len(self._mesh.axis_names) > 1:
-            return False
+        answers identically, which the collective call sites rely on."""
         return bool(self.mirror_steps) and self.is_sharded
 
     def maybe_refresh_mirror(self, version):
@@ -950,6 +1063,7 @@ class ElasticDPTrainer:
             return
         n_dev = self._mesh.devices.size
         n_local = jax.local_device_count()
+        flat_axes = row_partition_spec(self._mesh)[0]
         if self._mirror_perm_fn is None:
             spec_tree = {p: specs[p] for p in sharded}
             # shift by n_local devices = one PROCESS: the whole process
@@ -959,7 +1073,7 @@ class ElasticDPTrainer:
 
             def body(tree):
                 return jax.tree_util.tree_map(
-                    lambda x: jax.lax.ppermute(x, "data", perm), tree
+                    lambda x: jax.lax.ppermute(x, flat_axes, perm), tree
                 )
 
             self._mirror_perm_fn = jax.jit(
@@ -977,30 +1091,23 @@ class ElasticDPTrainer:
             with self._mesh:
                 permuted = self._mirror_perm_fn(sharded)
             version = int(host_copy(self._ts.version))
-            own, replica, own_block = {}, {}, 0
-            n_proc = (
-                self._spec.num_processes if self._spec else 1
-            )
+            own, replica = {}, {}
             for path, leaf in sharded.items():
-                rows, off = _local_block(leaf)
-                own[path] = rows
+                own[path], _ = _local_block(leaf)
                 replica[path], _ = _local_block(permuted[path])
-                rows_per_proc = leaf.shape[0] // n_proc
-                own_block = off // rows_per_proc
-            return version, own, replica, own_block
+            return version, own, replica
 
-        version, own, replica, own_block = self._escapable(
-            _permute_and_stage
-        )
+        version, own, replica = self._escapable(_permute_and_stage)
         n_proc = self._spec.num_processes if self._spec else 1
+        old_pid = self._spec.process_id if self._spec else 0
         self._mirror = ShardMirror(
-            version, n_proc, own_block, own, replica, replicated
+            version, n_proc, old_pid, own, replica, replicated
         )
         self._last_mirror_version = version
         logger.info(
-            "replica plane refreshed at v%d (block %d/%d)",
+            "replica plane refreshed at v%d (pid %d/%d)",
             version,
-            own_block,
+            old_pid,
             n_proc,
         )
 
@@ -1008,30 +1115,31 @@ class ElasticDPTrainer:
         """All-gather every NEW-world process's mirror summary.
 
         COLLECTIVE (every rank, mirror or not). Returns
-        ``[(has, version, n_old, own_block)] `` indexed by new rank —
+        ``[(has, version, n_old, old_pid)]`` indexed by new rank —
         identical on every rank, so all downstream decisions are
         global."""
         n_dev = self._mesh.devices.size
         n_local = jax.local_device_count()
         n_proc = self._spec.num_processes
+        flat_axes = row_partition_spec(self._mesh)[0]
         info = np.zeros((n_local, 4), np.int32)
         if self._mirror is not None:
             info[0] = (
                 1,
                 self._mirror.version,
                 self._mirror.n_old,
-                self._mirror.own_block,
+                self._mirror.old_pid,
             )
         g = jax.make_array_from_process_local_data(
-            NamedSharding(self._mesh, P("data", None)),
+            NamedSharding(self._mesh, P(flat_axes, None)),
             info,
             (n_dev, 4),
         )
         gather = jax.jit(
             shard_map(
-                lambda x: jax.lax.all_gather(x, "data", tiled=True),
+                lambda x: jax.lax.all_gather(x, flat_axes, tiled=True),
                 mesh=self._mesh,
-                in_specs=(P("data", None),),
+                in_specs=(P(flat_axes, None),),
                 out_specs=P(None, None),
                 check_rep=False,
             )
@@ -1056,7 +1164,65 @@ class ElasticDPTrainer:
         from elasticdl_tpu.common.pytree import key_path_names
 
         info = self._gather_mirror_info()
-        plan = plan_mirror_assembly(info, floor, allow_stale)
+        n_local = jax.local_device_count()
+
+        # sharded leaf metadata from the abstract state (joiners need
+        # shapes/dtypes/specs without holding any data)
+        meta = {}  # path -> (shape, dtype, spec)
+
+        def collect(key_path, leaf, spec):
+            if _is_sharded_spec(spec):
+                names = tuple(key_path_names(key_path))
+                meta[names] = (tuple(leaf.shape), leaf.dtype, spec)
+
+        jax.tree_util.tree_map_with_path(
+            collect, abstract, self._state_specs
+        )
+
+        # the OLD world's mesh layout is reconstructible from its
+        # process count alone (the zoo hook is deterministic), so every
+        # new rank — joiners included — computes identical old blocks
+        n_olds = {n for has, v, n, _ in info if has}
+        old_blocks_by_n = {}
+        for n in n_olds:
+            try:
+                old_axes = self._world_axes(n * n_local)
+            except Exception:
+                logger.warning(
+                    "old world of %d processes does not fit the mesh "
+                    "layout hook; its mirrors are unusable", n,
+                    exc_info=True,
+                )
+                continue
+            old_blocks_by_n[n] = {
+                path: (
+                    lambda pid, _axes=old_axes, _spec=spec, _s0=shape[0]:
+                    process_dim0_block(_axes, _spec, _s0, n_local, pid)
+                )
+                for path, (shape, _, spec) in meta.items()
+            }
+        leaf_spans = {
+            path: shape[0] for path, (shape, _, _) in meta.items()
+        }
+        plan = None
+        # plan against the newest version whose old layout resolved
+        # (rows whose n_old failed to resolve never equal a dict key,
+        # so the per-n filter alone excludes them)
+        for n, leaf_blocks in old_blocks_by_n.items():
+            cand = plan_mirror_ranges(
+                [
+                    row if row[2] == n else (0, 0, 0, 0)
+                    for row in info
+                ],
+                leaf_blocks,
+                leaf_spans,
+                floor,
+                allow_stale,
+            )
+            if cand is not None and (
+                plan is None or cand[0] > plan[0]
+            ):
+                plan = cand
         if plan is None:
             if any(has for has, _, _, _ in info):
                 logger.warning(
@@ -1064,56 +1230,44 @@ class ElasticDPTrainer:
                     "stale mirrors) — falling back to checkpoints"
                 )
             return False
-        target_v, n_old, alive_blocks = plan
-        seen_blocks = set(alive_blocks)
-
-        # my contributions: own block always; my replica only when its
-        # owner is gone (keeps contributed ranges disjoint)
-        m = self._mirror
-        blocks = []
-        if (
-            m is not None
-            and m.version == target_v
-            and m.n_old == n_old
-            and alive_blocks.get(m.own_block) == self._spec.process_id
-        ):
-            blocks.append((m.own_block, m.own))
-            left = (m.own_block - 1) % n_old
-            if left not in seen_blocks:
-                blocks.append((left, m.replica))
-
-        # sharded leaf metadata from the abstract state (joiners need
-        # shapes/dtypes without holding any data)
-        meta = {}
-
-        def collect(key_path, leaf, spec):
-            if _is_sharded_spec(spec):
-                names = tuple(key_path_names(key_path))
-                meta[names] = (tuple(leaf.shape), leaf.dtype)
-
-        jax.tree_util.tree_map_with_path(
-            collect, abstract, self._state_specs
-        )
+        target_v, n_old, assignments = plan
+        old_blocks = old_blocks_by_n[n_old]
 
         n_proc_new = self._spec.num_processes
-        n_local = jax.local_device_count()
         n_dev = self._mesh.devices.size
         me = self._spec.process_id
+        new_axes = {
+            name: int(self._mesh.shape[name])
+            for name in self._mesh.axis_names
+        }
+        flat_axes = row_partition_spec(self._mesh)[0]
+
+        # my contributions: the plan's pieces assigned to my new rank,
+        # sliced out of my mirror's own/replica arrays
+        m = self._mirror
+        my_old_pid = m.old_pid if m is not None else -1
+
+        def my_piece(path, lo, hi, kind):
+            if kind == 0:
+                base, _ = old_blocks[path](my_old_pid)
+                return m.own[path][lo - base : hi - base]
+            base, _ = old_blocks[path]((my_old_pid - 1) % n_old)
+            return m.replica[path][lo - base : hi - base]
 
         psum_specs = {
-            path: P("data", *([None] * len(shape)))
-            for path, (shape, _) in meta.items()
+            path: P(flat_axes, *([None] * len(shape)))
+            for path, (shape, _, _) in meta.items()
         }
         exchange = jax.jit(
             shard_map(
                 lambda tree: jax.tree_util.tree_map(
-                    lambda x: jax.lax.psum(x, "data"), tree
+                    lambda x: jax.lax.psum(x, flat_axes), tree
                 ),
                 mesh=self._mesh,
                 in_specs=(psum_specs,),
                 out_specs={
                     path: P(*([None] * (len(shape) + 1)))
-                    for path, (shape, _) in meta.items()
+                    for path, (shape, _, _) in meta.items()
                 },
                 check_rep=False,
             )
@@ -1122,24 +1276,22 @@ class ElasticDPTrainer:
         my_shards = {}
         for r in range(n_proc_new):
             bufs = {}
-            for path, (shape, dtype) in meta.items():
-                v_rows = shape[0]
-                rows_new = v_rows // n_proc_new
-                lo = r * rows_new
+            for path, (shape, dtype, spec) in meta.items():
+                r_lo, r_hi = process_dim0_block(
+                    new_axes, spec, shape[0], n_local, r
+                )
                 # device slot 0 carries the process contribution; the
                 # other local slots stay zero so the psum over devices
                 # is an exact sum over processes
                 buf = np.zeros(
-                    (n_local, rows_new) + tuple(shape[1:]), dtype
+                    (n_local, r_hi - r_lo) + tuple(shape[1:]), dtype
                 )
-                rows_old = v_rows // n_old
-                for blk, arrs in blocks:
-                    blo = blk * rows_old
-                    s = max(lo, blo)
-                    e = min(lo + rows_new, blo + rows_old)
-                    if s < e:
-                        buf[0, s - lo : e - lo] = arrs[path][
-                            s - blo : e - blo
+                for lo, hi, src, kind in assignments[path]:
+                    s, e = max(lo, r_lo), min(hi, r_hi)
+                    if s < e and src == me:
+                        piece = my_piece(path, lo, hi, kind)
+                        buf[0, s - r_lo : e - r_lo] = piece[
+                            s - lo : e - lo
                         ]
                 bufs[path] = buf
             placed = {
@@ -1166,7 +1318,11 @@ class ElasticDPTrainer:
         # failed or it is a joiner, silently zeroing every dense
         # parameter and optimizer slot. Any participant works; pick the
         # lowest rank deterministically (identical plan on every rank).
-        source_rank = min(alive_blocks.values())
+        source_rank = min(
+            src
+            for pieces in assignments.values()
+            for _, _, src, _ in pieces
+        )
         if m is not None and m.version == target_v:
             repl_host = m.replicated
         else:
@@ -1205,9 +1361,9 @@ class ElasticDPTrainer:
         )
         logger.info(
             "sharded state reassembled from the replica plane at v%d "
-            "(no disk; %d/%d old blocks alive)",
+            "(no disk; %d source ranks, old world of %d)",
             target_v,
-            len(seen_blocks),
+            len({s for p in assignments.values() for _, _, s, _ in p}),
             n_old,
         )
         return True
