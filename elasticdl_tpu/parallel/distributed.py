@@ -153,6 +153,9 @@ def ensure_world(spec, init_timeout=None):
     # 100 s heartbeat + 300 s shutdown windows
     heartbeat = int(os.environ.get("EDL_HEARTBEAT_TIMEOUT", "30"))
     shutdown_timeout = int(os.environ.get("EDL_SHUTDOWN_TIMEOUT", "30"))
+    import time as _time
+
+    t0 = _time.time()
     try:
         jax.distributed.initialize(
             spec.coordinator,
@@ -161,6 +164,11 @@ def ensure_world(spec, init_timeout=None):
             initialization_timeout=init_timeout,
             heartbeat_timeout_seconds=heartbeat,
             shutdown_timeout_seconds=shutdown_timeout,
+        )
+        logger.info(
+            "world epoch=%d formed in %.1fs",
+            spec.epoch,
+            _time.time() - t0,
         )
     except Exception as e:
         # failed mid-handshake (peer missing, stale epoch): leave cleanly

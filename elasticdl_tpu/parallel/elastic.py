@@ -798,7 +798,11 @@ class ElasticDPTrainer:
         as the source of truth. Sharded-parameter jobs instead restore
         from the latest checkpoint on EVERY establish (see __init__).
         """
+        import time as _time
+
+        t0 = _time.time()
         distributed.ensure_world(spec)
+        t_world = _time.time()
         self._spec = spec
         self._mesh = build_world_mesh(self._mesh_axes_fn)
         self._mirror_perm_fn = None  # mesh changed: rebuild on demand
@@ -807,16 +811,47 @@ class ElasticDPTrainer:
             self._module, param_specs = self._builder(self._mesh)
             self._sharded_paths = collect_sharded_paths(param_specs)
         self._check_optimizer_coupling()
+        t_init = t_world
         if self._sharded_paths:
             self._establish_sharded(example_batch)
+            t_init = _time.time()  # restore/assembly/init, all of it
         else:
-            if self._host_ts is None:
-                if example_batch is None:
-                    raise ValueError(
-                        "first establish() needs an example batch"
-                    )
-                self._host_ts = self._host_init_ts(example_batch)
-            self._ts = broadcast_from_device0(self._mesh, self._host_ts)
+            if example_batch is None and self._host_ts is None:
+                raise ValueError(
+                    "first establish() needs an example batch"
+                )
+            # who actually holds replicated state? The broadcast adopts
+            # the LOWEST such rank's copy; a fresh joiner then offers a
+            # zeros stand-in built from eval_shape (milliseconds)
+            # instead of paying a full real host init (~11 s measured
+            # for the promoted-standby establish, BASELINE.md r5) that
+            # the broadcast would overwrite anyway. Only when NOBODY
+            # has state (first formation, or every process died) does
+            # each member real-init — deterministically identical, so
+            # rank 0's copy is the same init everywhere.
+            source = self._replicated_source_rank()
+            if source < 0:
+                if self._host_ts is None:
+                    self._host_ts = self._host_init_ts(example_batch)
+                offer, source = self._host_ts, 0
+            elif self._host_ts is not None:
+                offer = self._host_ts
+            else:
+                abstract = self._abstract_ts(example_batch)
+                offer = jax.tree_util.tree_map(
+                    lambda leaf: np.zeros(leaf.shape, leaf.dtype),
+                    abstract,
+                )
+            t_init = _time.time()
+            self._ts = broadcast_from_device0(
+                self._mesh, offer, source_process=source
+            )
+        logger.info(
+            "establish timing: world %.1fs, init %.1fs, place %.1fs",
+            t_world - t0,
+            t_init - t_world,
+            _time.time() - t_init,
+        )
         self._checked_ts = self._ts
         self._step_fn = make_elastic_train_step(
             self._module,
@@ -1111,29 +1146,22 @@ class ElasticDPTrainer:
             n_proc,
         )
 
-    def _gather_mirror_info(self):
-        """All-gather every NEW-world process's mirror summary.
-
-        COLLECTIVE (every rank, mirror or not). Returns
-        ``[(has, version, n_old, old_pid)]`` indexed by new rank —
-        identical on every rank, so all downstream decisions are
-        global."""
+    def _all_gather_process_row(self, row):
+        """All-gather one small int32 row per process (device slot 0
+        carries it). COLLECTIVE: every rank must call with the same row
+        width. Returns [tuple(ints)] indexed by process — identical on
+        every rank, so decisions derived from it are global."""
         n_dev = self._mesh.devices.size
         n_local = jax.local_device_count()
         n_proc = self._spec.num_processes
         flat_axes = row_partition_spec(self._mesh)[0]
-        info = np.zeros((n_local, 4), np.int32)
-        if self._mirror is not None:
-            info[0] = (
-                1,
-                self._mirror.version,
-                self._mirror.n_old,
-                self._mirror.old_pid,
-            )
+        row = np.asarray(row, np.int32)
+        local = np.zeros((n_local, row.shape[0]), np.int32)
+        local[0] = row
         g = jax.make_array_from_process_local_data(
             NamedSharding(self._mesh, P(flat_axes, None)),
-            info,
-            (n_dev, 4),
+            local,
+            (n_dev, row.shape[0]),
         )
         gather = jax.jit(
             shard_map(
@@ -1151,6 +1179,30 @@ class ElasticDPTrainer:
             tuple(int(v) for v in table[p * n_local])
             for p in range(n_proc)
         ]
+
+    def _replicated_source_rank(self):
+        """Lowest rank holding live replicated state (the broadcast
+        source), or -1 when nobody does. Collective when the world has
+        more than one process; local (trivial) otherwise."""
+        mine = 1 if self._host_ts is not None else 0
+        if self._spec is None or self._spec.num_processes <= 1:
+            return 0 if mine else -1
+        table = self._all_gather_process_row([mine])
+        ranks = [p for p, (has,) in enumerate(table) if has]
+        return min(ranks) if ranks else -1
+
+    def _gather_mirror_info(self):
+        """All-gather every NEW-world process's mirror summary:
+        ``[(has, version, n_old, old_pid)]`` indexed by new rank."""
+        row = [0, 0, 0, 0]
+        if self._mirror is not None:
+            row = [
+                1,
+                self._mirror.version,
+                self._mirror.n_old,
+                self._mirror.old_pid,
+            ]
+        return self._all_gather_process_row(row)
 
     def _try_assemble_from_mirrors(self, abstract, floor, allow_stale):
         """Rebuild the full TrainState from surviving mirrors — no disk.

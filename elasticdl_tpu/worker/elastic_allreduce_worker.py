@@ -592,9 +592,29 @@ class ElasticAllReduceWorker:
             return self._run_predict_only()
         losses = []
         self._batch_gen = self._batches()
+        # register with the membership BEFORE priming: a promoted
+        # standby's death-bump is DEFERRED waiting for exactly this
+        # registration, so announcing first lets the survivors pause
+        # and settle in parallel with our dataset/reader priming
+        # (measured ~5.7 s serial before this, BASELINE.md r5). The
+        # awaiting=False poll registers without confirming a formation
+        # we are not yet ready to join.
+        try:
+            self._stub.get_comm_world(
+                self._worker_id, self._host, awaiting=False
+            )
+        except Exception:
+            pass  # registration happens via the await loop anyway
         first = self._prime()
         if first is None:
-            # no training data ever assigned; still serve eval/save tasks
+            # no training data ever assigned; still serve eval/save
+            # tasks. We pre-registered above, so announce the leave —
+            # an unconfirmed member would hold every peer's formation
+            # for the confirm window and then get fenced mid-eval
+            try:
+                self._stub.leave_comm_world(self._worker_id)
+            except Exception:
+                pass
             self._finalize()
             return losses
         self._retry_batch = first
@@ -610,7 +630,14 @@ class ElasticAllReduceWorker:
                     # prime a fresh batch (shapes gate the mesh slot)
                     first = self._prime()
                     if first is None:
-                        break  # drained/preempted while parked
+                        # drained/preempted while parked: leave so the
+                        # members' formation doesn't wait out the
+                        # confirm window on us
+                        try:
+                            self._stub.leave_comm_world(self._worker_id)
+                        except Exception:
+                            pass
+                        break
                     self._retry_batch = example = first
                 self.trainer.establish(world, example_batch=example)
                 if self._ckpt is not None:
@@ -684,16 +711,58 @@ class ElasticAllReduceWorker:
 
     def _prime(self):
         """Block until the first local batch is in hand (its shapes gate
-        world membership — a shapeless process can't hold a mesh slot)."""
-        while True:
-            if self._preempted:
-                return None
-            batch = self._next_batch()
-            if batch is not None:
-                return batch
-            if self._drained:
-                return None
-            time.sleep(0.2)
+        world membership — a shapeless process can't hold a mesh slot).
+
+        Heartbeats the membership (from a side thread — the slow part
+        is INSIDE the batch generator: reader setup, shuffle-buffer
+        fill) while blocked: this worker may already be REGISTERED
+        (register-before-prime), and a registered member whose last
+        poll goes stale looks dead to the confirm-timeout fencer — a
+        cold reader that primes slowly would get the fresh process
+        killed mid-prime. The awaiting=False poll refreshes liveness
+        without confirming a formation we can't join yet (the master
+        waits on a responsive-but-slow member instead of fencing it)."""
+        import threading
+
+        done = threading.Event()
+        # bounded: a beat that never stops would keep a truly WEDGED
+        # primer (reader stuck on a dead filesystem) looking alive
+        # forever, holding every peer's formation — past the deadline
+        # the beats stop and the confirm-timeout fencer regains
+        # authority over this process
+        deadline = time.time() + 120.0
+
+        def beat():
+            while time.time() < deadline and not done.wait(1.0):
+                try:
+                    self._stub.get_comm_world(
+                        self._worker_id, self._host, awaiting=False
+                    )
+                except Exception:
+                    pass
+
+        beater = None
+        if self._stub is not None:
+            beater = threading.Thread(target=beat, daemon=True)
+            beater.start()
+        try:
+            while True:
+                if self._preempted:
+                    return None
+                batch = self._next_batch()
+                if batch is not None:
+                    return batch
+                if self._drained:
+                    return None
+                time.sleep(0.2)
+        finally:
+            done.set()
+            if beater is not None:
+                # a beat mid-RPC must land before the caller announces a
+                # leave (register-after-leave is additionally blocked by
+                # the membership's departing blacklist; joining removes
+                # the race entirely)
+                beater.join(timeout=5.0)
 
     def _world_moved_on(self):
         """The trainer's escapable-wait abort probe: True when one of
