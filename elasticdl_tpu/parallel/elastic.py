@@ -730,6 +730,8 @@ class ElasticDPTrainer:
         self._checked_ts = None  # last fetch-validated device state
         self._host_ts = None  # latest host snapshot (re-form source)
         self._step_fn = None
+        self._eval_fn = None  # in-plane eval forward (built on demand)
+        self._gather_fns = {}  # cached per-width info gathers
         self._host_step = 0
         self._last_local = None  # (features, labels) for weight-0 steps
         self.epoch_consensus = None  # newest epoch any member has seen
@@ -853,6 +855,9 @@ class ElasticDPTrainer:
             _time.time() - t_init,
         )
         self._checked_ts = self._ts
+        # mesh/world changed: rebuild the cached callables on demand
+        self._eval_fn = None
+        self._gather_fns = {}
         self._step_fn = make_elastic_train_step(
             self._module,
             self._loss_fn,
@@ -1163,15 +1168,23 @@ class ElasticDPTrainer:
             local,
             (n_dev, row.shape[0]),
         )
-        gather = jax.jit(
-            shard_map(
-                lambda x: jax.lax.all_gather(x, flat_axes, tiled=True),
-                mesh=self._mesh,
-                in_specs=(P(flat_axes, None),),
-                out_specs=P(None, None),
-                check_rep=False,
+        gather = self._gather_fns.get(row.shape[0])
+        if gather is None:
+            # cached per (mesh, row width): the in-plane eval consensus
+            # calls this once per aligned sync — a fresh lambda each
+            # call would retrace/recompile every time
+            gather = jax.jit(
+                shard_map(
+                    lambda x: jax.lax.all_gather(
+                        x, flat_axes, tiled=True
+                    ),
+                    mesh=self._mesh,
+                    in_specs=(P(flat_axes, None),),
+                    out_specs=P(None, None),
+                    check_rep=False,
+                )
             )
-        )
+            self._gather_fns[row.shape[0]] = gather
         with self._mesh:
             out = gather(g)
         table = np.asarray(out.addressable_shards[0].data)
@@ -1179,6 +1192,86 @@ class ElasticDPTrainer:
             tuple(int(v) for v in table[p * n_local])
             for p in range(n_proc)
         ]
+
+    def eval_have_consensus(self, have):
+        """COLLECTIVE: total count of ranks reporting pending eval work.
+
+        The in-plane eval protocol's loop condition — every rank calls
+        at the same aligned point, ranks with no work participate in
+        the forwards with dummy rows until this reaches zero."""
+        table = self._escapable(
+            lambda: self._all_gather_process_row([1 if have else 0])
+        )
+        return sum(h for (h,) in table)
+
+    def eval_step(self, features, minibatch_size):
+        """COLLECTIVE forward for in-plane evaluation: every rank of
+        the mesh participates (the sharded model's lookups/ring are
+        collectives), each feeding its own eval rows — ``features=None``
+        participates with dummy rows (the previous batch) and discards
+        the outputs. Returns this process's output rows as host numpy
+        (caller slices to its true row count). Scores the CURRENT
+        parameters — no checkpoint, no host twin, no aggregate-table
+        materialization anywhere (the table stays sharded in HBM,
+        which is the point: reference worker/worker.py:659-693
+        evaluates on the training plane the same way)."""
+        rows = self.local_rows(minibatch_size)
+        if features is None:
+            if self._last_local is None:
+                raise RuntimeError(
+                    "cannot run a dummy eval step before the first data "
+                    "step"
+                )
+            features = self._last_local[0]
+        local = self._pad_local(features, rows)
+        g = self._place_batch(local)
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_fn()
+
+        def _dispatch():
+            with self._mesh:
+                out = self._eval_fn(self._ts, g)
+            return jax.tree_util.tree_map(
+                lambda a: _local_block(a)[0], out
+            )
+
+        return self._escapable(_dispatch)
+
+    def _build_eval_fn(self):
+        """Jitted shard_map INFERENCE forward over the established mesh
+        (training=False: no dropout, no mutable-state updates — the
+        same mode every other eval path scores in)."""
+        from elasticdl_tpu.nn.model_api import apply_model
+        from elasticdl_tpu.training.precision import get_policy
+
+        pol = get_policy(self._precision)
+        module = self._module
+        ts_spec = (
+            self._state_specs if self._state_specs is not None else P()
+        )
+        row_spec = row_partition_spec(self._mesh)
+
+        def per_device(ts, features):
+            params, state = ts.params, ts.state
+            if pol is not None:
+                params = pol.cast_to_compute(params)
+                features = pol.cast_to_compute(features)
+            output, _ = apply_model(
+                module, params, state, features, training=False
+            )
+            if pol is not None:
+                output = pol.cast_output(output)
+            return output
+
+        return jax.jit(
+            shard_map(
+                per_device,
+                mesh=self._mesh,
+                in_specs=(ts_spec, row_spec),
+                out_specs=row_spec,
+                check_rep=False,
+            )
+        )
 
     def _replicated_source_rank(self):
         """Lowest rank holding live replicated state (the broadcast

@@ -242,30 +242,15 @@ class ElasticAllReduceWorker:
                 )
 
             if "build_host_model" in zoo_module:
+                # optional since r5: TRAINING_WITH_EVALUATION scores
+                # IN-PLANE (collective lockstep eval at aligned sync
+                # points — no checkpoint, no host twin, tables never
+                # materialize in one host's RAM); the twin remains the
+                # serving-only scoring path and the export trace
                 self._host_model_factory = (
                     lambda _zoo=zoo_module, _extra=extra: _zoo[
                         "build_host_model"
                     ](**_extra)
-                )
-            needs_host_twin = self._job_type in (
-                JobType.TRAINING_WITH_EVALUATION,
-                JobType.EVALUATION_ONLY,
-                JobType.PREDICTION_ONLY,
-            )
-            if needs_host_twin and self._host_model_factory is None:
-                raise NotImplementedError(
-                    "%s for sharded-parameter elastic jobs needs the "
-                    "zoo's build_host_model hook (same param structure, "
-                    "dense lookups) — see model_zoo/deepfm_edl_embedding"
-                    % self._job_type
-                )
-            if self._job_type == JobType.TRAINING_WITH_EVALUATION and not (
-                checkpoint_dir and checkpoint_steps
-            ):
-                raise ValueError(
-                    "evaluation for sharded-parameter elastic jobs "
-                    "assembles eval params from sharded checkpoints; "
-                    "set --checkpoint_dir and --checkpoint_steps"
                 )
         from elasticdl_tpu.training.step import parse_remat
 
@@ -858,7 +843,14 @@ class ElasticAllReduceWorker:
                 # settle what we can and leave anyway — survivors take
                 # the failure-recovery path, same as a hard kill
                 return self._settle_and_leave("preempted")
-            if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+            if (
+                self._job_type == JobType.TRAINING_WITH_EVALUATION
+                and not self.trainer.is_sharded
+            ):
+                # sharded jobs evaluate IN-PLANE at aligned sync points
+                # below (the collective rounds must line up across
+                # ranks); the replicated plane scores a local snapshot
+                # and can drain whenever
                 self._evaluate_only()
             w = self._stub.get_comm_world(
                 self._worker_id, self._host, awaiting=False
@@ -997,6 +989,18 @@ class ElasticAllReduceWorker:
                     ):
                         self._ckpt.save(self.trainer._ts, version)
                         self._last_ckpt_version = version
+                if (
+                    aligned_sync
+                    and self.trainer.is_sharded
+                    and self._job_type
+                    == JobType.TRAINING_WITH_EVALUATION
+                ):
+                    # in-plane eval: a lockstep protocol (consensus
+                    # gather + collective forwards), so it must run at
+                    # the same aligned index on every rank — exactly
+                    # here, after the pause check agreed nobody is
+                    # re-forming this round
+                    self._collective_evaluate()
                 if aligned_sync and self.trainer.mirror_enabled():
                     # replica-plane cadence: same aligned-sync trigger
                     # discipline as the checkpoint cadence (the refresh
@@ -1405,6 +1409,127 @@ class ElasticAllReduceWorker:
             executed = True
         return executed
 
+    def _collective_evaluate(self, final=False):
+        """In-plane lockstep eval for sharded-parameter jobs: every
+        rank participates in every collective forward (the model's
+        lookups/ring ARE collectives), ranks without eval work feed
+        dummy rows until the all-gathered pending count reaches zero.
+
+        Called at ALIGNED points only — the same step index on every
+        rank (the aligned-sync block mid-training; the quiescence-
+        aligned _finalize) — so the consensus gathers and forwards
+        line up. Scores CURRENT parameters on the training plane
+        itself: no checkpoint in the path, no host twin, and the
+        sharded tables never materialize in one host's RAM (the
+        reference's evaluate-on-the-training-plane semantics,
+        reference worker/worker.py:659-693).
+
+        ``final=True`` (the _finalize call, no later iteration will
+        retry): waits out transient empty consensus rounds — a task
+        fail-requeued by one rank can land back on the master just
+        after every rank polled empty, and abandoning it would hang
+        the job. The re-check loop is itself consensus-driven, so all
+        ranks count the same empty rounds and exit together."""
+        from elasticdl_tpu.common.constants import TaskType
+
+        pending = None  # (task_id, model_version, batches, outs, labels)
+        empty_rounds = 0
+        while True:
+            if pending is None:
+                task = self.get_task(TaskType.EVALUATION)
+                if task.shard_name:
+                    pending = self._start_eval_task(task)
+            have = pending is not None
+            if self.trainer.eval_have_consensus(have) == 0:
+                empty_rounds += 1
+                if not final or empty_rounds >= 3:
+                    break
+                time.sleep(0.5)
+                continue
+            empty_rounds = 0
+            feats, labels, count = (None, None, 0)
+            if pending is not None:
+                feats, labels, count = pending[2].pop(0)
+            outputs = self.trainer.eval_step(
+                feats, self._minibatch_size
+            )
+            if pending is None:
+                continue  # dummy participation for a busy peer
+            if not isinstance(outputs, dict):
+                outputs = {MetricsDictKey.MODEL_OUTPUT: outputs}
+            for k, v in outputs.items():
+                pending[3].setdefault(k, []).append(
+                    np.asarray(v)[:count]
+                )
+            pending[4].append(np.asarray(labels))
+            if not pending[2]:
+                self._eval_scored_version = self.trainer.version
+                self._report_eval_outputs(
+                    pending[0], pending[1], pending[3], pending[4]
+                )
+                pending = None
+
+    def _report_eval_outputs(
+        self, task_id, model_version, out_chunks, label_chunks
+    ):
+        """Publish one eval task's accumulated outputs and complete it;
+        a reporting failure fail-reports the task for retry instead of
+        propagating (shared by the local and in-plane eval paths)."""
+        try:
+            if out_chunks:
+                self._stub.report_evaluation_metrics(
+                    model_version,
+                    {
+                        k: np.concatenate(v)
+                        for k, v in out_chunks.items()
+                    },
+                    np.concatenate(label_chunks),
+                    scored_version=self._eval_scored_version,
+                )
+            self.report_task_result(task_id)
+        except Exception as e:
+            logger.warning(
+                "eval task %d report failed: %s", task_id, e
+            )
+            try:
+                self.report_task_result(task_id, err_msg=str(e))
+            except Exception:
+                pass  # master unreachable: its death detection requeues
+
+    def _start_eval_task(self, task):
+        """Materialize one eval task's batches for the lockstep rounds.
+        Returns [task_id, model_version, [(features, labels, count)],
+        out_chunks, label_chunks] or None (task fail-reported)."""
+        eval_info = self._task_data_service.get_validation_dataset(task)
+        if not eval_info:
+            return None
+        dataset, model_version, task_id = eval_info
+        dataset = self._dataset_fn(
+            dataset,
+            Mode.EVALUATION,
+            self._task_data_service.data_reader.metadata,
+        )
+        dataset = dataset.batch(self._minibatch_size)
+        import jax
+
+        batches = []
+        try:
+            for features, labels in dataset:
+                count = int(
+                    np.asarray(
+                        jax.tree_util.tree_leaves(features)[0]
+                    ).shape[0]
+                )
+                batches.append((features, labels, count))
+        except Exception as e:
+            logger.warning("eval task %d unreadable: %s", task_id, e)
+            self.report_task_result(task_id, err_msg=str(e))
+            return None
+        if not batches:
+            self.report_task_result(task_id)
+            return None
+        return [task_id, model_version, batches, {}, []]
+
     def _process_eval_task(self, task):
         """Returns True when the task completed (success or reported
         failure another worker should retry); False when deferred — the
@@ -1447,14 +1572,9 @@ class ElasticAllReduceWorker:
             logger.warning("eval task %d deferred: %s", task_id, e)
             self.report_task_result(task_id, err_msg=str(e))
             return False
-        if out_chunks:
-            self._stub.report_evaluation_metrics(
-                model_version,
-                {k: np.concatenate(v) for k, v in out_chunks.items()},
-                np.concatenate(label_chunks),
-                scored_version=self._eval_scored_version,
-            )
-        self.report_task_result(task_id)
+        self._report_eval_outputs(
+            task_id, model_version, out_chunks, label_chunks
+        )
         return True
 
     # -- export -------------------------------------------------------------
@@ -1607,7 +1727,18 @@ class ElasticAllReduceWorker:
         self._drain_ckpt()
         if self._job_type == JobType.TRAINING_WITH_EVALUATION:
             try:
-                self._evaluate_only(final=True)
+                if (
+                    self.trainer.is_sharded
+                    and self.trainer._ts is not None
+                ):
+                    # the world is still formed (ranks leave below) and
+                    # every rank enters _finalize from the SAME
+                    # quiescence round, so the lockstep eval stays
+                    # aligned; it also drains the queue collectively —
+                    # each round every idle rank re-polls for tasks
+                    self._collective_evaluate(final=True)
+                else:
+                    self._evaluate_only(final=True)
             except Exception:
                 logger.warning("final eval round failed", exc_info=True)
         self._process_save_model_task_if_needed()

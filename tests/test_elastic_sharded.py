@@ -571,8 +571,10 @@ def test_sharded_forward_assembles_eval_params_from_checkpoint(tmp_path):
 @pytest.mark.slow
 def test_sharded_elastic_evaluation_interleave(tmp_path, monkeypatch):
     """TRAINING_WITH_EVALUATION on the sharded elastic plane: eval
-    rounds trigger off worker-reported versions and score
-    checkpoint-assembled tables via the host-twin model."""
+    rounds trigger off worker-reported versions and score IN-PLANE
+    (lockstep collective forwards at aligned sync points — since r5 no
+    host twin or checkpoint is in the eval path; this config keeps
+    checkpoints on to prove the cadence and eval compose)."""
     from elasticdl_tpu.common.args import parse_master_args
     from elasticdl_tpu.master.local_instance_manager import (
         LocalInstanceManager,
@@ -1381,3 +1383,120 @@ def test_mirror_rejects_non_leading_dim_shards_at_establish():
             )
     finally:
         dist_mod.ensure_world = orig
+
+@pytest.mark.slow
+def test_pp_dp_evaluation_interleave_no_twin_no_disk(tmp_path, monkeypatch):
+    """TRAINING_WITH_EVALUATION on the pp x dp elastic plane with NO
+    checkpoint dir and NO build_host_model: eval rounds score on the
+    collective plane itself (lockstep in-plane forwards at aligned sync
+    points) — the r4 host-twin requirement is gone, and the stage
+    parameters never materialize in one host's RAM (the reference's
+    evaluate-on-the-training-plane semantics,
+    reference worker/worker.py:659-693)."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.data.example import encode_example
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+    from elasticdl_tpu.master.local_instance_manager import (
+        LocalInstanceManager,
+    )
+    from elasticdl_tpu.master.master import Master
+    from tests.test_elastic_allreduce import _worker_env
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    monkeypatch.setenv("EDL_FORM_GRACE_SECS", "120")
+    train_dir = tmp_path / "train"
+    val_dir = tmp_path / "val"
+    train_dir.mkdir()
+    val_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for directory, n in ((train_dir, 128), (val_dir, 32)):
+        with RecordIOWriter(str(directory / "tokens.edlr")) as f:
+            for _ in range(n):
+                f.write(
+                    encode_example(
+                        {
+                            "tokens": rng.integers(
+                                0, 64, size=(64,), dtype=np.int64
+                            )
+                        }
+                    )
+                )
+    model_def = "transformer_lm.transformer_lm.custom_model"
+    model_params = (
+        "pipeline_stages=2,vocab_size=64,num_layers=2,num_heads=2,"
+        "head_dim=8,embed_dim=32,mlp_dim=64,use_flash=False"
+    )
+    args = parse_master_args(
+        [
+            "--job_name", "ppdp-inplane-eval",
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", "16",
+            "--num_minibatches_per_task", "1",
+            "--num_epochs", "2",
+            "--training_data", str(train_dir),
+            "--validation_data", str(val_dir),
+            "--evaluation_steps", "3",
+            "--evaluation_start_delay_secs", "0",
+            "--num_workers", "2",
+            "--num_ps_pods", "0",
+            "--port", "0",
+            "--distribution_strategy", "AllreduceStrategy",
+        ]
+    )
+    master = Master(args)
+    master.prepare()
+    assert master.evaluation_service is not None
+
+    published = []
+    orig_publish = master.evaluation_service._publish_summary
+
+    def capture_publish(round_):
+        published.append(
+            (round_.model_version, round_.get_evaluation_summary())
+        )
+        return orig_publish(round_)
+
+    master.evaluation_service._publish_summary = capture_publish
+
+    def worker_command(worker_id):
+        return [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.worker.main",
+            "--worker_id", str(worker_id),
+            "--job_type", "training_with_evaluation",
+            "--master_addr", "localhost:%d" % master.port,
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", "16",
+            "--distribution_strategy", "AllreduceStrategy",
+            "--comm_host", "localhost",
+            # NO --checkpoint_dir and the zoo has NO build_host_model:
+            # the in-plane eval needs neither
+        ]
+
+    manager = LocalInstanceManager(
+        master.task_d,
+        2,
+        worker_command,
+        env=_worker_env(),
+        membership=master.membership,
+    )
+    master.instance_manager = manager
+    manager.start_workers()
+    runner = threading.Thread(
+        target=master.run, kwargs={"poll_secs": 0.5}, daemon=True
+    )
+    runner.start()
+    runner.join(timeout=300)
+    assert not runner.is_alive(), "master did not finish"
+    assert master.task_d.finished()
+    manager.stop_relaunch_and_remove_all_pods()
+
+    assert published, "no evaluation round completed"
+    for version, metrics in published:
+        assert version > 0
+        assert metrics and "token_accuracy" in str(metrics), metrics
