@@ -126,7 +126,9 @@ def snapshot_tree(tree):
     return list(_snapshot_entries(tree, copy_host=True))
 
 
-def write_snapshot(directory, snap, version=0, process_index=None):
+def write_snapshot(
+    directory, snap, version=0, process_index=None, logical_dim0=None
+):
     """Phase 2 of a save: write save records' shard files + manifest.
 
     ``snap`` is any iterable of :func:`_snapshot_entries` records — a
@@ -180,6 +182,10 @@ def write_snapshot(directory, snap, version=0, process_index=None):
             "dtype": str(dtype),
             "shards": [],
         }
+        if logical_dim0 and path in logical_dim0:
+            # the saved dim 0 carries this world's padding; host
+            # consumers clip back to the model's declared rows
+            entry["logical_dim0"] = int(logical_dim0[path])
         for slices, i, data in shards:
             fname = "%s.p%d.s%d.npy" % (safe, pid, i)
             _np_save(os.path.join(directory, fname), data)
@@ -204,12 +210,18 @@ def write_snapshot(directory, snap, version=0, process_index=None):
     )
 
 
-def save_sharded(directory, tree, version=0):
+def save_sharded(directory, tree, version=0, logical_dim0=None):
     """Write this process's shards of ``tree`` (a pytree of jax/np
     arrays) into ``directory``. Every participating process must call it
     (collective-free: pure local writes). Streams leaf-by-leaf: peak
-    host memory is ~one leaf, not the whole local model."""
-    write_snapshot(directory, _snapshot_entries(tree), version=version)
+    host memory is ~one leaf, not the whole local model.
+    ``logical_dim0``: see :func:`write_snapshot`."""
+    write_snapshot(
+        directory,
+        _snapshot_entries(tree),
+        version=version,
+        logical_dim0=logical_dim0,
+    )
 
 
 def _merged_manifest(directory):
@@ -234,6 +246,8 @@ def _merged_manifest(directory):
                     "shards": [],
                 },
             )
+            if "logical_dim0" in entry:
+                merged["logical_dim0"] = entry["logical_dim0"]
             merged["shards"].extend(entry["shards"])
     return version, leaves
 
@@ -253,9 +267,14 @@ class _LeafReader:
             )
         return self._cache[fname]
 
-    def read(self, index):
+    def read(self, index, target_shape=None):
+        """Assemble the requested slice. ``target_shape`` (when it
+        differs from the stored shape) means the caller restores into a
+        different world's PADDED space: rows beyond the stored extent
+        are padding by construction and fill with zeros — coverage is
+        only demanded for the stored rows."""
         shape = self._entry["shape"]
-        want = _index_to_slices(index, shape)
+        want = _index_to_slices(index, target_shape or shape)
         out = np.zeros(
             [stop - start for start, stop in want],
             dtype=_np_dtype(self._entry["dtype"]),
@@ -282,7 +301,17 @@ class _LeafReader:
             covered += int(
                 np.prod([e - s for s, e in inter], dtype=np.int64)
             )
-        total = int(np.prod(out.shape, dtype=np.int64))
+        # demand coverage only within the STORED extent: the want
+        # clamped per-dim to the stored shape
+        stored_want = [
+            (ws, min(we, int(sd)))
+            for (ws, we), sd in zip(want, shape)
+        ]
+        total = int(
+            np.prod(
+                [max(0, e - s) for s, e in stored_want], dtype=np.int64
+            )
+        )
         if covered < total:
             raise ValueError(
                 "checkpoint shards cover %d/%d elements of the requested "
@@ -291,12 +320,19 @@ class _LeafReader:
         return out
 
 
-def load_sharded(directory, shardings):
+def load_sharded(directory, shardings, target_shapes=None):
     """Restore a pytree onto device: ``shardings`` is a pytree (same
     structure as saved) of ``jax.sharding.Sharding``; each device
-    materializes only its own slice bytes. Returns (version, tree)."""
+    materializes only its own slice bytes. Returns (version, tree).
+
+    ``target_shapes`` ({'a/b/c': shape}): restore those leaves into a
+    DIFFERENT global shape than stored — the new world's padded dim 0
+    for PadDim0 leaves (parallel/elastic.py). Rows beyond the stored
+    extent fill with zeros; stored rows beyond the target are dropped
+    (both are past the logical rows by construction)."""
     version, leaves = _merged_manifest(directory)
     flat_shardings = _leaf_entries(shardings)
+    target_shapes = target_shapes or {}
     out_flat = []
     for path, sharding in flat_shardings:
         if path not in leaves:
@@ -305,10 +341,13 @@ def load_sharded(directory, shardings):
             )
         entry = leaves[path]
         reader = _LeafReader(directory, entry)
+        shape = tuple(target_shapes.get(path) or entry["shape"])
         arr = jax.make_array_from_callback(
-            tuple(entry["shape"]),
+            shape,
             sharding,
-            lambda index, r=reader: r.read(index),
+            lambda index, r=reader, t=shape: r.read(
+                index, target_shape=t
+            ),
         )
         out_flat.append(arr)
     treedef = jax.tree_util.tree_structure(shardings)
@@ -316,7 +355,11 @@ def load_sharded(directory, shardings):
 
 
 def load_sharded_to_host(directory):
-    """Restore to host numpy (tooling / model export); full arrays."""
+    """Restore to host numpy (tooling / model export); full arrays.
+    PadDim0 leaves come back clipped to their LOGICAL rows (the
+    manifest records ``logical_dim0``), so host consumers — export,
+    host-twin scoring — see the model's declared shapes, not a
+    world's padding."""
     version, leaves = _merged_manifest(directory)
     tree = {}
     for path, entry in leaves.items():
@@ -324,6 +367,9 @@ def load_sharded_to_host(directory):
         full = reader.read(
             tuple(slice(0, d) for d in entry["shape"])
         )
+        logical = entry.get("logical_dim0")
+        if logical is not None and full.shape[0] > int(logical):
+            full = full[: int(logical)]
         node = tree
         parts = path.split("/")
         for part in parts[:-1]:
@@ -349,6 +395,7 @@ class ShardedCheckpointManager:
         self._steps = checkpoint_steps
         self._keep_max = keep_max
         self._expected_writers = None
+        self._logical_dim0 = None
         self._async = None
         if async_io:
             from elasticdl_tpu.common.async_checkpoint import (
@@ -363,6 +410,13 @@ class ShardedCheckpointManager:
         eviction distinguish a complete newer version from a torn one;
         the elastic worker refreshes it at every (re-)establish."""
         self._expected_writers = max(1, int(n)) if n else None
+
+    def set_logical_dim0(self, by_path):
+        """{'a/b/c': true rows} for PadDim0 leaves the current world
+        padded — recorded in manifests so host-side restores clip the
+        padding off. Refreshed at every (re-)establish (padding is a
+        per-world property)."""
+        self._logical_dim0 = dict(by_path) if by_path else None
 
     @property
     def steps(self):
@@ -429,19 +483,26 @@ class ShardedCheckpointManager:
     def save(self, tree, version):
         directory = self._dir_for(version)
         pid = jax.process_index()
+        logical = self._logical_dim0
         if self._async is not None:
             snap = snapshot_tree(tree)
 
             def _write():
                 write_snapshot(
-                    directory, snap, version=version, process_index=pid
+                    directory,
+                    snap,
+                    version=version,
+                    process_index=pid,
+                    logical_dim0=logical,
                 )
                 if self._keep_max and pid == 0:
                     self._evict()
 
             self._async.submit(_write, label="ckpt_v%d" % version)
             return directory
-        save_sharded(directory, tree, version)
+        save_sharded(
+            directory, tree, version=version, logical_dim0=logical
+        )
         if self._keep_max and pid == 0:
             self._evict()
         return directory

@@ -348,6 +348,25 @@ def _max_checkpoint_version(candidate_dirs):
     return best
 
 
+class PadDim0:
+    """Marks a sharded spec whose leaves' dim 0 may be zero-PADDED up
+    to the next multiple of the world's shard count, so non-divisor
+    world sizes place cleanly (a kill 8 -> 7 keeps training instead of
+    erroring). Only sound for leaves whose extra rows are INERT —
+    embedding tables, whose rows beyond the declared vocab are never
+    addressed (real vocab sizes like GPT-2's 50257 have no divisor
+    structure, so Megatron-style padding is the only general answer).
+    Leaves with structural dim-0 semantics (stacked pipeline stages)
+    must NOT be marked: a zero stage would change the math, and their
+    divisibility is kept by the membership layer's world-size rounding
+    instead."""
+
+    __slots__ = ("spec",)
+
+    def __init__(self, spec):
+        self.spec = spec
+
+
 def collect_sharded_paths(param_specs):
     """Flatten a nested param_specs dict into {path tuple: PartitionSpec}.
 
@@ -355,7 +374,9 @@ def collect_sharded_paths(param_specs):
     enclosing prefix (stored as ``prefix + ("**",)``): the stacked stage
     subtree of a pipeline (parallel/pipeline.py PipelinedStack) has many
     leaves of varying depth that all shard the same way, which per-leaf
-    spec paths cannot express."""
+    spec paths cannot express. :class:`PadDim0` markers are unwrapped
+    (use :func:`collect_paddable_paths` to recover which spec paths
+    carried one)."""
     paths = {}
     if not param_specs:
         return paths
@@ -365,10 +386,47 @@ def collect_sharded_paths(param_specs):
             for k, sub in spec_tree.items():
                 walk(sub, prefix + (k,))
         else:
+            if isinstance(spec_tree, PadDim0):
+                spec_tree = spec_tree.spec
             paths[prefix] = spec_tree
 
     walk(param_specs, ())
     return paths
+
+
+def collect_paddable_paths(param_specs):
+    """Spec paths whose leaves were marked :class:`PadDim0`."""
+    paddable = set()
+    if not param_specs:
+        return paddable
+
+    def walk(spec_tree, prefix):
+        if hasattr(spec_tree, "items"):
+            for k, sub in spec_tree.items():
+                walk(sub, prefix + (k,))
+        elif isinstance(spec_tree, PadDim0):
+            paddable.add(prefix)
+
+    walk(param_specs, ())
+    return paddable
+
+
+def dim0_shard_count(spec, axes):
+    """How many ways a leaf's dim 0 splits on a mesh laid out ``axes``."""
+    entry = spec[0] if spec is not None and len(spec) else None
+    if entry is None:
+        return 1
+    axs = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+    count = 1
+    for a in axs:
+        count *= int(axes[a])
+    return count
+
+
+def padded_dim0(shape0, spec, axes):
+    """dim 0 rounded UP to the next multiple of its shard count."""
+    count = dim0_shard_count(spec, axes)
+    return -(-int(shape0) // count) * count
 
 
 def spec_path_matches(spec_path, leaf_names):
@@ -723,6 +781,8 @@ class ElasticDPTrainer:
         self._mesh_axes_fn = mesh_axes_fn
         self.restore_provider = restore_provider
         self._sharded_paths = {}
+        self._paddable_spec_paths = set()
+        self._logical_dim0 = {}  # padded leaves: path names -> true dim0
         self._state_specs = None
         self._mesh = None
         self._spec = None
@@ -812,6 +872,9 @@ class ElasticDPTrainer:
         if self._builder is not None:
             self._module, param_specs = self._builder(self._mesh)
             self._sharded_paths = collect_sharded_paths(param_specs)
+            self._paddable_spec_paths = collect_paddable_paths(
+                param_specs
+            )
         self._check_optimizer_coupling()
         t_init = t_world
         if self._sharded_paths:
@@ -918,6 +981,65 @@ class ElasticDPTrainer:
             "exclude the sharded leaves."
         )
 
+    def _leaf_is_paddable(self, names):
+        return any(
+            spec_path_matches(spec_path, names)
+            for spec_path in self._paddable_spec_paths
+        )
+
+    def _pad_abstract(self, abstract):
+        """This world's placement shapes: PadDim0-marked sharded leaves
+        whose dim 0 doesn't divide the mesh round UP (recorded in
+        ``_logical_dim0``); everything else passes through. Resets the
+        logical map — padding is a per-world property."""
+        from elasticdl_tpu.common.pytree import key_path_names
+
+        self._logical_dim0 = {}
+        axes = {
+            name: int(self._mesh.shape[name])
+            for name in self._mesh.axis_names
+        }
+
+        def pad(key_path, leaf, spec):
+            if not _is_sharded_spec(spec):
+                return leaf
+            names = tuple(key_path_names(key_path))
+            pad0 = padded_dim0(leaf.shape[0], spec, axes)
+            if pad0 == leaf.shape[0] or not self._leaf_is_paddable(
+                names
+            ):
+                return leaf
+            self._logical_dim0[names] = int(leaf.shape[0])
+            return jax.ShapeDtypeStruct(
+                (pad0,) + tuple(leaf.shape[1:]), leaf.dtype
+            )
+
+        return jax.tree_util.tree_map_with_path(
+            pad, abstract, self._state_specs
+        )
+
+    def _pad_tree_values(self, tree, padded_abstract):
+        """Zero-pad host values up to this world's placement shapes."""
+
+        def pad(x, leaf):
+            x = np.asarray(x)
+            if x.shape == tuple(leaf.shape):
+                return x
+            out = np.zeros(tuple(leaf.shape), x.dtype)
+            out[: x.shape[0]] = x
+            return out
+
+        return jax.tree_util.tree_map(pad, tree, padded_abstract)
+
+    def logical_dim0_by_path(self):
+        """{'a/b/c': true dim0} for this world's padded leaves — the
+        checkpoint manager records these so host-side consumers
+        (export, host-twin scoring) clip the padding back off."""
+        return {
+            "/".join(names): v
+            for names, v in self._logical_dim0.items()
+        }
+
     def _establish_sharded(self, example_batch):
         """Place sharded-parameter state: the in-memory replica plane
         first (no disk in the path — see ShardMirror), then the newest
@@ -938,7 +1060,13 @@ class ElasticDPTrainer:
         self._state_specs = build_state_specs(
             abstract, self._sharded_paths
         )
-        self._check_shard_divisibility(abstract)
+        # PadDim0-marked leaves whose dim 0 doesn't divide THIS world
+        # get zero-padded placement shapes (recorded in _logical_dim0);
+        # everything downstream — placement, mirrors, restore targets —
+        # works in this world's padded space, while checkpoints and the
+        # plan math stay anchored to the logical rows
+        padded = self._pad_abstract(abstract)
+        self._check_shard_divisibility(padded)
         candidates = (
             self.restore_provider() if self.restore_provider else None
         ) or []
@@ -965,9 +1093,32 @@ class ElasticDPTrainer:
                     "checkpoints",
                     exc_info=True,
                 )
+        # EVERY PadDim0 leaf restores into THIS world's placement shape
+        # (padded, or the logical rows when this world divides): the
+        # stored checkpoint may carry a DIFFERENT world's padding, and
+        # rows past the logical extent are zeros either way. Keying on
+        # currently-padded leaves alone would let a padded-world
+        # checkpoint restore at its stored padded shape into a
+        # divisible world — desynchronizing the state from the specs.
+        from elasticdl_tpu.common.pytree import key_path_names
+
+        target_shapes = {}
+
+        def _collect_target(key_path, leaf, spec):
+            names = tuple(key_path_names(key_path))
+            if _is_sharded_spec(spec) and self._leaf_is_paddable(names):
+                target_shapes["/".join(names)] = tuple(leaf.shape)
+
+        jax.tree_util.tree_map_with_path(
+            _collect_target, padded, self._state_specs
+        )
         for restore_dir in candidates:
             try:
-                version, self._ts = load_sharded(restore_dir, shardings)
+                version, self._ts = load_sharded(
+                    restore_dir,
+                    shardings,
+                    target_shapes=target_shapes or None,
+                )
                 logger.info(
                     "sharded state restored at v%d from %s",
                     version,
@@ -1015,7 +1166,9 @@ class ElasticDPTrainer:
                     "restorable checkpoint: state RE-INITIALIZED "
                     "(enable --checkpoint_steps to bound this loss)"
                 )
-            init_ts = self._host_init_ts(example)
+            init_ts = self._pad_tree_values(
+                self._host_init_ts(example), padded
+            )
             # version continuity: re-initialized state must start PAST
             # any existing checkpoint version, or future saves would
             # reuse an old ckpt_vN directory whose stale manifests (from
@@ -1326,9 +1479,21 @@ class ElasticDPTrainer:
 
         # the OLD world's mesh layout is reconstructible from its
         # process count alone (the zoo hook is deterministic), so every
-        # new rank — joiners included — computes identical old blocks
+        # new rank — joiners included — computes identical old blocks.
+        # Blocks live in each world's PADDED space (pad == logical for
+        # divisible worlds) and are CLIPPED to the logical rows: the
+        # pad rows are zeros by construction, so the plan only ever
+        # moves real rows, whatever padding either world used.
+        def clipped_block(axes, spec, shape0, pid):
+            pad0 = padded_dim0(shape0, spec, axes)
+            lo, hi = process_dim0_block(
+                axes, spec, pad0, n_local, pid
+            )
+            return lo, min(hi, int(shape0))
+
         n_olds = {n for has, v, n, _ in info if has}
         old_blocks_by_n = {}
+        old_bases_by_n = {}  # UNCLIPPED lo (slicing into mirror arrays)
         for n in n_olds:
             try:
                 old_axes = self._world_axes(n * n_local)
@@ -1342,7 +1507,20 @@ class ElasticDPTrainer:
             old_blocks_by_n[n] = {
                 path: (
                     lambda pid, _axes=old_axes, _spec=spec, _s0=shape[0]:
-                    process_dim0_block(_axes, _spec, _s0, n_local, pid)
+                    clipped_block(_axes, _spec, _s0, pid)
+                )
+                for path, (shape, _, spec) in meta.items()
+            }
+            old_bases_by_n[n] = {
+                path: (
+                    lambda pid, _axes=old_axes, _spec=spec, _s0=shape[0]:
+                    process_dim0_block(
+                        _axes,
+                        _spec,
+                        padded_dim0(_s0, _spec, _axes),
+                        n_local,
+                        pid,
+                    )[0]
                 )
                 for path, (shape, _, spec) in meta.items()
             }
@@ -1376,7 +1554,6 @@ class ElasticDPTrainer:
                 )
             return False
         target_v, n_old, assignments = plan
-        old_blocks = old_blocks_by_n[n_old]
 
         n_proc_new = self._spec.num_processes
         n_dev = self._mesh.devices.size
@@ -1392,11 +1569,15 @@ class ElasticDPTrainer:
         m = self._mirror
         my_old_pid = m.old_pid if m is not None else -1
 
+        old_bases = old_bases_by_n[n_old]
+
         def my_piece(path, lo, hi, kind):
+            # base = the UNCLIPPED start of the source block (the mirror
+            # arrays include any old-world pad rows)
             if kind == 0:
-                base, _ = old_blocks[path](my_old_pid)
+                base = old_bases[path](my_old_pid)
                 return m.own[path][lo - base : hi - base]
-            base, _ = old_blocks[path]((my_old_pid - 1) % n_old)
+            base = old_bases[path]((my_old_pid - 1) % n_old)
             return m.replica[path][lo - base : hi - base]
 
         psum_specs = {
@@ -1422,8 +1603,12 @@ class ElasticDPTrainer:
         for r in range(n_proc_new):
             bufs = {}
             for path, (shape, dtype, spec) in meta.items():
+                # the new rank's block in THIS world's padded space
+                # (plan pieces are clipped to the logical rows, so the
+                # buffer's pad tail simply stays zero)
+                new_pad0 = padded_dim0(shape[0], spec, new_axes)
                 r_lo, r_hi = process_dim0_block(
-                    new_axes, spec, shape[0], n_local, r
+                    new_axes, spec, new_pad0, n_local, r
                 )
                 # device slot 0 carries the process contribution; the
                 # other local slots stay zero so the psum over devices
@@ -1488,10 +1673,11 @@ class ElasticDPTrainer:
             names = tuple(key_path_names(key_path))
             if _is_sharded_spec(spec):
                 local = my_shards[names]
+                new_pad0 = padded_dim0(leaf.shape[0], spec, new_axes)
                 return jax.make_array_from_process_local_data(
                     NamedSharding(self._mesh, spec),
                     local,
-                    tuple(leaf.shape),
+                    (new_pad0,) + tuple(leaf.shape[1:]),
                 )
             return broadcasted
 
@@ -1557,10 +1743,13 @@ class ElasticDPTrainer:
         if problems:
             raise ValueError(
                 "sharded parameters do not divide the %d-device world: "
-                "%s. Pad the sharded dimension (e.g. vocab_size) to a "
-                "multiple of every world size the job can shrink/grow "
-                "to — a multiple of num_workers * local_devices is the "
-                "usual choice." % (self._mesh.devices.size, "; ".join(problems))
+                "%s. For row tables whose extra rows are inert "
+                "(embeddings), mark the spec PadDim0 in the zoo's "
+                "param_shardings and the elastic plane pads/reshards "
+                "automatically; otherwise pad the sharded dimension "
+                "(e.g. vocab_size) to a multiple of every world size "
+                "the job can shrink/grow to."
+                % (self._mesh.devices.size, "; ".join(problems))
             )
         if mirror_problems and self.mirror_enabled():
             raise ValueError(
@@ -1825,7 +2014,12 @@ class ElasticDPTrainer:
         """Write this process's shards of the train state (no gather)."""
         from elasticdl_tpu.common.sharded_checkpoint import save_sharded
 
-        save_sharded(directory, self._ts, version=self.version)
+        save_sharded(
+            directory,
+            self._ts,
+            version=self.version,
+            logical_dim0=self.logical_dim0_by_path() or None,
+        )
 
     def restore_sharded(self, directory):
         """Replace the established state with a sharded checkpoint,
@@ -1835,7 +2029,22 @@ class ElasticDPTrainer:
         shardings = jax.tree_util.tree_map(
             lambda a: a.sharding, self._ts
         )
-        version, ts = load_sharded(directory, shardings)
+        # every PadDim0 leaf restores at the CURRENT placement shape
+        # (self._ts already carries it) whatever padding the stored
+        # checkpoint used — see the same logic in _establish_sharded
+        from elasticdl_tpu.common.pytree import key_path_names
+
+        target_shapes = {}
+
+        def _collect(key_path, leaf):
+            names = tuple(key_path_names(key_path))
+            if self._leaf_is_paddable(names):
+                target_shapes["/".join(names)] = tuple(leaf.shape)
+
+        jax.tree_util.tree_map_with_path(_collect, self._ts)
+        version, ts = load_sharded(
+            directory, shardings, target_shapes=target_shapes or None
+        )
         self._ts = ts
         self._checked_ts = ts
         self._host_ts = host_copy(ts)

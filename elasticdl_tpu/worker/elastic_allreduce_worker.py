@@ -634,6 +634,12 @@ class ElasticAllReduceWorker:
                         if self.trainer.is_sharded
                         else 1
                     )
+                    # PadDim0 leaves the new world padded: manifests
+                    # record the logical rows so host-side restores
+                    # (export, twin scoring) clip the padding off
+                    self._ckpt.set_logical_dim0(
+                        self.trainer.logical_dim0_by_path()
+                    )
                 if (
                     self._ckpt is not None
                     and not self._restore_attempted

@@ -125,10 +125,15 @@ def build_host_model(**params):
 def param_shardings(mesh, table_axis="data", **_params):
     """PartitionSpecs for the HBM-resident tables; everything else
     (dense layers, optimizer moments of dense layers) replicates, and
-    the tables' optimizer state co-shards with them automatically."""
+    the tables' optimizer state co-shards with them automatically.
+    PadDim0: vocab rows are inert beyond the declared size, so the
+    elastic plane may zero-pad them to place on NON-DIVISOR world
+    sizes (a kill 8 -> 7 keeps training instead of erroring)."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(table_axis, None)
+    from elasticdl_tpu.parallel.elastic import PadDim0
+
+    spec = PadDim0(P(table_axis, None))
     return {
         "embedding": {"table": spec},
         "id_bias": {"table": spec},

@@ -81,13 +81,16 @@ def test_hbm_deepfm_matches_dense_training():
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
-    # place sharded per the zoo's param_shardings hook
-    specs = zoo.param_shardings(mesh)
+    # place sharded per the zoo's param_shardings hook (specs may be
+    # PadDim0-wrapped; collect_sharded_paths unwraps)
+    from elasticdl_tpu.parallel.elastic import collect_sharded_paths
+
+    specs = collect_sharded_paths(zoo.param_shardings(mesh))
     placed = jax.tree_util.tree_map(jax.device_put, params)
     for layer in ("embedding", "id_bias"):
         placed[layer]["table"] = jax.device_put(
             params[layer]["table"],
-            NamedSharding(mesh, specs[layer]["table"]),
+            NamedSharding(mesh, specs[(layer, "table")]),
         )
 
     with mesh:
