@@ -696,6 +696,240 @@ def bench_preemption():
     )
 
 
+def bench_ps(quick=False):
+    """Host-PS plane throughput (the reference's deployment shape):
+    deepfm trained against 2 OS-process parameter servers over real
+    loopback gRPC — async per-step push_gradient/pull round trips
+    (reference ps/servicer.py:90-150) — with the bf16 wire compression
+    off and on. Tells users when to pick the host-PS plane over the
+    in-mesh HBM plane (BASELINE.md r5 row). The whole measurement runs
+    in a CPU-forced subprocess: the host-PS plane is host-side by
+    design, and the parent may hold (or be unable to reach) the
+    accelerator. Returns {"examples_per_sec": X,
+    "examples_per_sec_bf16": Y}."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench, json\n"
+        "print('PSBENCH ' + json.dumps(bench._bench_ps_impl(%r)))\n"
+    ) % (here, quick)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=1800,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired as e:
+        # the PS grandchildren watch their parent's pid and exit with it
+        raise RuntimeError(
+            "ps bench timed out:\n%s" % str(e.stdout or "")[-2000:]
+        ) from e
+    for line in proc.stdout.splitlines():
+        if line.startswith("PSBENCH "):
+            return json.loads(line[len("PSBENCH "):])
+    raise RuntimeError(
+        "ps bench failed:\n" + proc.stdout[-2000:] + proc.stderr[-2000:]
+    )
+
+
+def _force_cpu_backend():
+    """Pin jax to CPU in THIS process (a sitecustomize may have pinned
+    an accelerator platform via jax.config, so env vars alone do not
+    stick — same recipe as tests/conftest.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax.extend.backend import clear_backends
+    except ImportError:
+        clear_backends = getattr(jax, "clear_backends", None)
+    if clear_backends is not None:
+        clear_backends()
+
+
+def _bench_ps_impl(quick=False):
+    import socket
+    import subprocess
+    import tempfile
+
+    _force_cpu_backend()
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import (
+        MODEL_ZOO_PATH,
+        DatasetName,
+        create_recordio_file,
+    )
+
+    records = 512 if quick else 4096
+    batch = 32
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    # PS bootstrap: CPU-forced, and a parent-death watchdog so a killed
+    # bench driver (subprocess timeout) cannot leak PS grandchildren
+    ps_boot = (
+        "import os, sys, threading, time\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "bench._force_cpu_backend()\n"
+        "_parent = os.getppid()\n"
+        "def _watch():\n"
+        "    while os.getppid() == _parent:\n"
+        "        time.sleep(1.0)\n"
+        "    os._exit(0)\n"
+        "threading.Thread(target=_watch, daemon=True).start()\n"
+        "from elasticdl_tpu.ps.parameter_server import ParameterServer\n"
+        "from elasticdl_tpu.common.args import parse_ps_args\n"
+        "server = ParameterServer(parse_ps_args(sys.argv[1:]))\n"
+        "server.prepare()\n"
+        "server.run()\n"
+    ) % here
+
+    def launch_fleet(wire, err_dir):
+        # bind-then-close port picking has a TOCTOU window; a lost race
+        # surfaces through the stderr files below instead of silently
+        ports = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("localhost", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        procs = []
+        for i, port in enumerate(ports):
+            err = open(
+                os.path.join(err_dir, "ps-%s-%d.err" % (wire or "f32", i)),
+                "wb",
+            )
+            procs.append(
+                (
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-c", ps_boot,
+                            "--ps_id", str(i),
+                            "--port", str(port),
+                            "--model_zoo", MODEL_ZOO_PATH,
+                            "--model_def", model_def,
+                            "--use_async", "true",
+                            "--grads_to_wait", "1",
+                            "--wire_dtype", wire,
+                        ],
+                        env=env,
+                        stdout=subprocess.DEVNULL,
+                        stderr=err,
+                    ),
+                    err,
+                )
+            )
+        deadline = time.time() + 60
+        for (proc, err), port in zip(procs, ports):
+            while True:
+                if proc.poll() is not None:
+                    err.flush()
+                    raise RuntimeError(
+                        "PS exited rc=%d at boot: %s"
+                        % (
+                            proc.returncode,
+                            open(err.name, "rb").read()[-2000:],
+                        )
+                    )
+                try:
+                    with socket.create_connection(
+                        ("localhost", port), 1.0
+                    ):
+                        break
+                except OSError:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "PS did not come up: %s"
+                            % open(err.name, "rb").read()[-2000:]
+                        )
+                    time.sleep(0.2)
+        return procs, ["localhost:%d" % p for p in ports]
+
+    def stop_fleet(procs):
+        for proc, _ in procs:
+            proc.terminate()
+        for proc, err in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+            err.close()
+
+    def run_job(addrs, wire, data, n):
+        shards = {data: (0, n)}
+        task_d = TaskDispatcher(shards, {}, {}, batch * 4, 1)
+        master = MasterServicer(
+            1,
+            batch,
+            None,
+            task_d,
+            checkpoint_service=CheckpointService("", 0, 0, False),
+            use_async=True,
+        )
+        worker = Worker(
+            worker_id=1,
+            job_type=JobType.TRAINING_ONLY,
+            minibatch_size=batch,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def=model_def,
+            model_params=model_params,
+            ps_client=PSClient(
+                [BoundPS(a) for a in addrs], wire_dtype=wire
+            ),
+        )
+        worker._stub = InProcessMaster(master)
+        t0 = time.perf_counter()
+        worker.run()
+        dt = time.perf_counter() - t0
+        if not task_d.finished():
+            raise RuntimeError("PS bench job did not finish")
+        return n / dt
+
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        f = create_recordio_file(
+            records, DatasetName.FRAPPE, 10, temp_dir=tmp
+        )
+        warm = create_recordio_file(
+            batch * 4, DatasetName.FRAPPE, 10, temp_dir=tmp
+        )
+        # a FRESH fleet per arm so BOTH directions carry the arm's wire
+        # dtype (the PS compresses pulls per ITS flag — a shared fleet
+        # would leave the pull direction f32 in the bf16 arm); the
+        # warmup job per arm pays the worker jit compiles (first arm
+        # only — the process-level cache persists) and the fleet's
+        # lazy init (every arm), keeping the A/B symmetric
+        for wire in ("", "bfloat16"):
+            procs, addrs = launch_fleet(wire, tmp)
+            try:
+                run_job(addrs, wire, warm, batch * 4)
+                eps = run_job(addrs, wire, f, records)
+            finally:
+                stop_fleet(procs)
+            key = (
+                "examples_per_sec_bf16" if wire else "examples_per_sec"
+            )
+            results[key] = eps
+    return results
+
+
 def bench_resnet(quick=False, profile_dir=None):
     """Fused jitted ResNet-50 train step (fwd+bwd+SGD, bf16 MXU compute)
     with on-device synthetic data: the compute-path ceiling the input
@@ -835,6 +1069,23 @@ def main(argv=None):
                 results["_desc"],
                 results["take"] / 1e6,
                 results["psum"] / 1e6,
+            ),
+            update,
+        )
+        return 0
+
+    if "--ps" in argv:
+        res = bench_ps(quick)
+        _emit(
+            "ps_deepfm_examples_per_sec",
+            round(res["examples_per_sec"], 1),
+            "examples/sec, deepfm vs 2 OS-process PS over loopback "
+            "gRPC, async push/pull per step (bf16 wire: %.1f ex/s, "
+            "%.2fx)"
+            % (
+                res["examples_per_sec_bf16"],
+                res["examples_per_sec_bf16"]
+                / max(res["examples_per_sec"], 1e-9),
             ),
             update,
         )
