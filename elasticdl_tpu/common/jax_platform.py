@@ -1,0 +1,35 @@
+"""Honor an explicit ``JAX_PLATFORMS`` env var in every process entry.
+
+A sitecustomize may pre-register an accelerator PJRT plugin and pin
+``jax_platforms`` through jax.config at interpreter startup, silently
+overriding the env var — a user's ``JAX_PLATFORMS=cpu edl train ...``
+would then still initialize (and hang on a wedged) accelerator
+transport. Every framework process entry (CLI/master, worker, PS) calls
+:func:`honor_jax_platforms_env` before any backend initializes; the
+elastic worker additionally re-applies platform selection at each world
+formation (parallel/distributed._configure_platform). Unset env leaves
+the platform selection untouched.
+"""
+
+import os
+import sys
+
+
+def honor_jax_platforms_env():
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+    except ImportError:
+        return  # the caller's import sites will say so
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception as e:
+        # do NOT swallow silently: the run would proceed on the wrong
+        # platform, the exact failure this helper exists to prevent
+        print(
+            "warning: could not apply JAX_PLATFORMS=%s (%s); the "
+            "process may use a different jax platform" % (plat, e),
+            file=sys.stderr,
+        )

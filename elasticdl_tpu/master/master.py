@@ -205,11 +205,17 @@ class Master:
                 )
             except (TypeError, ValueError):
                 pass
-            multiple = stages if stages > 1 else 1
+            raw_workers = int(getattr(args, "num_workers", 0) or 0)
+            # the stage multiple models ONE DEVICE PER WORKER PROCESS
+            # (the k8s pod shape); a single-process job (num_workers
+            # <= 1, e.g. the local in-process mode) holds every local
+            # device in one mesh, where mesh_axes validates the stage
+            # fit at establish instead
+            multiple = stages if stages > 1 and raw_workers > 1 else 1
             env_multiple = os.environ.get("EDL_WORLD_SIZE_MULTIPLE")
             if env_multiple:
                 multiple = max(1, int(env_multiple))
-            num_workers = max(1, getattr(args, "num_workers", 0))
+            num_workers = max(1, raw_workers)
             if multiple > num_workers:
                 # every bump would round the world down to ZERO members
                 # — a silent never-trains stall, not elasticity
@@ -429,7 +435,9 @@ class Master:
 
 def main():
     from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.jax_platform import honor_jax_platforms_env
 
+    honor_jax_platforms_env()
     args = parse_master_args()
     master = Master(args)
     master.prepare()

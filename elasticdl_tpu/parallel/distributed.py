@@ -102,6 +102,15 @@ def _configure_platform():
                 ).strip()
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    else:
+        # an explicit JAX_PLATFORMS must survive a sitecustomize's
+        # config pin here too — the world re-forms drop and re-create
+        # backends, and each re-create re-resolves the platform
+        from elasticdl_tpu.common.jax_platform import (
+            honor_jax_platforms_env,
+        )
+
+        honor_jax_platforms_env()
 
 
 def _clear_backends():

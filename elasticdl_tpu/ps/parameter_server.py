@@ -59,7 +59,9 @@ class ParameterServer:
 
 def main():
     from elasticdl_tpu.common.args import parse_ps_args
+    from elasticdl_tpu.common.jax_platform import honor_jax_platforms_env
 
+    honor_jax_platforms_env()
     args = parse_ps_args()
     server = ParameterServer(args)
     server.prepare()

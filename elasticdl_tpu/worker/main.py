@@ -17,6 +17,9 @@ from elasticdl_tpu.worker.worker import Worker
 
 
 def main():
+    from elasticdl_tpu.common.jax_platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     args = parse_worker_args()
     if args.distribution_strategy == "AllreduceStrategy":
         # the elastic worker must not touch the JAX backend before its
