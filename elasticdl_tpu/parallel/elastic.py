@@ -935,8 +935,27 @@ class ElasticDPTrainer:
             # every rank reaches this point during formation, so the
             # refresh collective is aligned; it also resets
             # _last_mirror_version identically on every rank (joiners
-            # included), keeping the cadence predicate global
-            self.refresh_mirror()
+            # included), keeping the cadence predicate global. A
+            # FAILED refresh (a peer death racing this formation — the
+            # collective fails on every rank together) must not crash
+            # the worker out of an otherwise-recoverable establish:
+            # swallow it, and advance the cadence marker so the ranks'
+            # next-refresh predicate stays aligned whatever mix of
+            # old mirrors they keep (the planner version-filters stale
+            # ones); the broken world surfaces at the first step and
+            # takes the ordinary recovery path
+            try:
+                self.refresh_mirror()
+            except Exception:
+                logger.warning(
+                    "establish-tail replica refresh failed; the next "
+                    "cadence point (or re-form) retries",
+                    exc_info=True,
+                )
+                try:
+                    self._last_mirror_version = self.version
+                except Exception:
+                    pass  # device also wedged: the step failure owns it
         logger.info(
             "elastic plane established: epoch=%d rank=%d/%d devices=%d%s",
             spec.epoch,
@@ -1210,10 +1229,13 @@ class ElasticDPTrainer:
         ppermute."""
         if not self.mirror_enabled() or self._ts is None:
             return False
-        if (
-            self._mirror is not None
-            and version - self._last_mirror_version < self.mirror_steps
-        ):
+        # gate on the VERSION MARKER alone, never on _mirror presence:
+        # the marker is aligned across ranks by construction (set by
+        # every establish-tail attempt, success or failure), while
+        # _mirror presence diverges — a joiner has none, survivors keep
+        # stale ones — and a presence-gated predicate would send the
+        # joiner into the collective ppermute alone
+        if version - self._last_mirror_version < self.mirror_steps:
             return False
         self.refresh_mirror()
         return True
