@@ -335,6 +335,22 @@ def add_common_args_between_master_and_worker(parser):
         "dtype on the wire (PS-mode hot path); receivers upcast back "
         "to f32 before any optimizer math",
     )
+    parser.add_argument(
+        "--hot_row_cache_rows",
+        type=int,
+        default=0,
+        help="PS mode: keep an LRU of this many recently pulled "
+        "embedding rows on the worker, served locally instead of over "
+        "gRPC while fresh (0 disables; see docs/sparse_fast_path.md)",
+    )
+    parser.add_argument(
+        "--hot_row_staleness_window",
+        type=int,
+        default=0,
+        help="How many PS model versions a hot-row cache entry may lag "
+        "before it is re-pulled; 0 (default) binds it to the SSP "
+        "window, --get_model_steps",
+    )
 
 
 def parse_master_args(master_args=None):

@@ -73,12 +73,46 @@ class Tensor:
 
     __radd__ = __add__
 
+    def combined(self):
+        """Row-combined copy of a sparse tensor (dense: self).
+
+        Duplicate ``indices`` are merged by summing their rows — the
+        resolution ``__add__``'s concatenation defers to apply time,
+        done eagerly. Pushing ``t.combined()`` instead of ``t`` puts
+        one row per unique id on the wire with identical training
+        semantics (the PS applies the sum either way)."""
+        if not self.is_indexed_slices():
+            return self
+        indices, values = combine_indexed_slices(self.indices, self.values)
+        return Tensor(self.name, values, indices=indices)
+
     def to_bytes(self):
         return serialize_tensor(self)
 
     @classmethod
     def from_bytes(cls, data):
         return deserialize_tensor(data)
+
+
+def combine_indexed_slices(indices, values):
+    """Segment-sum duplicate rows: returns (unique_indices, summed_values).
+
+    The sparse-comms row-combine both embedding planes share
+    (nn/sparse_comms.py): the worker runs it before any gradient push so
+    the wire carries one row per unique id, and the PS runs it before
+    any optimizer apply (ps/optimizer_wrapper.py delegates here).
+    ``unique_indices`` comes back sorted (np.unique order)."""
+    indices = np.asarray(indices, dtype=np.int64)
+    values = np.asarray(values, dtype=np.float32)
+    unique, inverse = np.unique(indices, return_inverse=True)
+    if len(unique) == len(indices):
+        # already duplicate-free: skip the scatter (hot path when the
+        # lookup plan deduped before the pull)
+        order = np.argsort(indices, kind="stable")
+        return unique, values[order]
+    combined = np.zeros((len(unique), values.shape[1]), dtype=np.float32)
+    np.add.at(combined, inverse, values)
+    return unique, combined
 
 
 def serialize_tensor(t):

@@ -137,20 +137,30 @@ class Dataset:
             _END = object()
             cancel = threading.Event()
 
+            def put_or_cancel(item):
+                """True once ``item`` is enqueued; False if cancelled
+                first. EVERY producer put goes through here — including
+                the terminal _END and exception sentinels: an unbounded
+                q.put of those would block forever when the consumer was
+                abandoned with a full queue right as the source
+                exhausted (or raised), the exact leak the cooperative
+                cancel exists to prevent."""
+                while not cancel.is_set():
+                    try:
+                        q.put(item, timeout=0.5)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
             def produce():
                 try:
                     for x in self._gen_factory():
-                        while not cancel.is_set():
-                            try:
-                                q.put(x, timeout=0.5)
-                                break
-                            except queue.Full:
-                                continue
-                        if cancel.is_set():
+                        if not put_or_cancel(x):
                             return
-                    q.put(_END)
+                    put_or_cancel(_END)
                 except BaseException as e:  # propagate into consumer
-                    q.put(e)
+                    put_or_cancel(e)
 
             t = threading.Thread(target=produce, daemon=True)
             t.start()

@@ -224,18 +224,32 @@ def plan_lookup(ids, bucket_min=8):
     return unique, idx, bucket
 
 
-def plan_lookup_multi(ids_list, bucket_min=8):
+def plan_lookup_multi(ids_list, bucket_min=8, dedup=True):
     """Union lookup plan over every call of one layer per forward.
 
     Returns (unique_ids (k,), [idx per call], bucket_size): one shared
     rows pull covers all calls (a tied embedding reads the same table),
     each call keeping its own position array into that buffer.
+
+    This host-side batch-wide dedup is the PS plane's half of the
+    sparse-comms fast path (nn/sparse_comms.py): only unique rows are
+    pulled, and since every occurrence gathers from its unique slot, the
+    step's row gradients come back ALREADY combined (the take VJP
+    scatter-adds over the plan's positions) — one row per unique id in
+    both wire directions. ``dedup=False`` builds the naive
+    per-occurrence plan (every id keeps its own slot; duplicates pull
+    and push duplicate rows) — the pre-fast-path wire behavior, kept
+    for benchmarking and equivalence tests.
     """
     arrays = [np.asarray(ids) for ids in ids_list]
     flat = np.concatenate(
         [a.reshape(-1).astype(np.int64) for a in arrays]
     )
-    unique, inverse = np.unique(flat, return_inverse=True)
+    if dedup:
+        unique, inverse = np.unique(flat, return_inverse=True)
+    else:
+        unique = flat
+        inverse = np.arange(flat.size, dtype=np.int64)
     k = len(unique)
     bucket = bucket_min
     while bucket < k:

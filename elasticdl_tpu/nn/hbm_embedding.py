@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.nn.sparse_comms import padded_unique
 from elasticdl_tpu.parallel.ring_attention import shard_map
 
 
@@ -140,37 +141,46 @@ def a2a_lookup_collective(
     bound (call inside shard_map / an outer collective step).
 
     ``table_local``: this device's (V/n, D) shard; ``ids_flat``: this
-    device's flat id slice. Returns (ids, D) — or, with
-    ``return_overflow=True``, ``(rows, n_overflowed)`` where
-    ``n_overflowed`` is this device's LOCAL count of ids that didn't fit
-    their per-peer capacity bucket and therefore read zero rows. The
-    caller owns aggregation, because only it knows how ids were spread:
-    psum over ``axis`` when each device routed a distinct slice (the
-    elastic plane), no-op when the ids were replicated (each device
-    already counted the whole batch). See :func:`all_to_all_lookup` for
-    the routing/capacity semantics."""
+    device's flat id slice. Negative ids are SKIP slots (the
+    :func:`~elasticdl_tpu.nn.sparse_comms.padded_unique` padding): they
+    consume no per-peer capacity, read zero rows, and are never counted
+    as overflow. Returns (ids, D) — or, with ``return_overflow=True``,
+    ``(rows, n_overflowed)`` where ``n_overflowed`` is this device's
+    LOCAL count of live ids that didn't fit their per-peer capacity
+    bucket and therefore read zero rows. The caller owns aggregation,
+    because only it knows how ids were spread: psum over ``axis`` when
+    each device routed a distinct slice (the elastic plane), no-op when
+    the ids were replicated (each device already counted the whole
+    batch). See :func:`all_to_all_lookup` for the routing/capacity
+    semantics."""
     n = jax.lax.psum(1, axis)
     me = jax.lax.axis_index(axis)
     rows_per = table_local.shape[0]
     mm = ids_flat.shape[0]  # ids local to this batch shard
     cap = mm if capacity is None else min(capacity, mm)
 
+    live = ids_flat >= 0
     owner = jnp.clip(ids_flat // rows_per, 0, n - 1)
+    # skip slots bucket past every real peer (owner n) so they sort to
+    # the end and cannot displace live ids from their capacity windows
+    owner = jnp.where(live, owner, n)
     order = jnp.argsort(owner, stable=True)
     sorted_owner = owner[order]
     sorted_ids = ids_flat[order]
-    counts = jnp.bincount(owner, length=n)
+    sorted_live = live[order]
+    counts = jnp.bincount(owner, length=n + 1)
     starts = jnp.cumsum(counts) - counts
     pos = jnp.arange(mm) - starts[sorted_owner]
-    ok = pos < cap
-    # overflow entries write to a trash column (cap) so they can't
-    # clobber a live slot; the buffer is sliced back to cap below
+    ok = (pos < cap) & sorted_live
+    # overflow and skip entries write to a trash column (cap) so they
+    # can't clobber a live slot; the buffer is sliced back to cap below
     pos = jnp.where(ok, pos, cap)
+    write_owner = jnp.minimum(sorted_owner, n - 1)
 
     # (n, cap) send buffers: row p holds the ids this device asks
     # peer p for; invalid slots carry id -1
     send_ids = jnp.full((n, cap + 1), -1, jnp.int32)
-    send_ids = send_ids.at[sorted_owner, pos].set(sorted_ids)[:, :cap]
+    send_ids = send_ids.at[write_owner, pos].set(sorted_ids)[:, :cap]
     pos = jnp.where(ok, pos, 0)
     recv_ids = jax.lax.all_to_all(
         send_ids, axis, split_axis=0, concat_axis=0, tiled=True
@@ -186,17 +196,53 @@ def a2a_lookup_collective(
         rows, axis, split_axis=0, concat_axis=0, tiled=True
     )  # row p = rows for the ids I sent to peer p
 
-    out_sorted = back[sorted_owner, pos]
+    out_sorted = back[write_owner, pos]
     out_sorted = jnp.where(ok[..., None], out_sorted, 0)
     inv = jnp.argsort(order, stable=True)
     out = out_sorted[inv]
     if not return_overflow:
         return out
-    return out, jnp.sum(~ok).astype(jnp.int32)
+    n_over = jnp.sum(sorted_live & ~ok).astype(jnp.int32)
+    return out, n_over
+
+
+def a2a_dedup_lookup_collective(
+    table_local, ids_flat, axis, capacity=None, return_overflow=False
+):
+    """Dedup-before-comm variant of :func:`a2a_lookup_collective`.
+
+    Batch-wide unique ids (static-shape :func:`padded_unique`) are the
+    only thing routed over the ``axis`` ring; per-occurrence rows are
+    restored by a LOCAL gather through the inverse map. The gather's
+    transpose is a scatter-add over the inverse map, so the backward
+    all_to_all also carries exactly one combined gradient row per
+    unique id — with k unique ids in an m-id batch both wire directions
+    shrink by m/k. ``capacity`` therefore bounds UNIQUE ids per peer
+    here; a duplicate-heavy batch needs proportionally less of it.
+    Overflow counts unique ids dropped (each dropped unique id zeroes
+    every occurrence that maps to it)."""
+    uids, inv, _ = padded_unique(ids_flat)
+    out = a2a_lookup_collective(
+        table_local,
+        uids,
+        axis,
+        capacity=capacity,
+        return_overflow=return_overflow,
+    )
+    if not return_overflow:
+        return jnp.take(out, inv, axis=0)
+    rows_u, n_over = out
+    return jnp.take(rows_u, inv, axis=0), n_over
 
 
 def all_to_all_lookup(
-    table, ids, mesh, axis, capacity=None, return_overflow=False
+    table,
+    ids,
+    mesh,
+    axis,
+    capacity=None,
+    return_overflow=False,
+    dedup=False,
 ):
     """Row exchange by explicit ``all_to_all`` routing (the BASELINE.json
     north-star formulation); differentiable.
@@ -229,6 +275,14 @@ def all_to_all_lookup(
     alone, so the row gradients route straight back to their owners and
     the dense (V, D) gradient never exists — each device only ever holds
     its own (V/n, D) gradient shard.
+
+    ``dedup=True`` switches to the dedup-before-comm fast path
+    (:func:`a2a_dedup_lookup_collective`): each device routes only its
+    batch-wide UNIQUE ids and restores per-occurrence rows by a local
+    gather through the inverse map, so both wire directions carry one
+    row per unique id and ``capacity`` bounds unique ids per peer —
+    on duplicate-heavy batches the same correctness holds at a
+    fraction of the capacity (and therefore of the ICI traffic).
     """
     _check_divisible(table, mesh, axis)
     orig_shape = ids.shape
@@ -236,9 +290,10 @@ def all_to_all_lookup(
 
     axes = set(mesh.axis_names)
     batch_axis = "data" if ("data" in axes and axis != "data") else None
+    body = a2a_dedup_lookup_collective if dedup else a2a_lookup_collective
 
     def _lookup(table_local, ids_flat):
-        out = a2a_lookup_collective(
+        out = body(
             table_local,
             ids_flat,
             axis,
@@ -279,6 +334,14 @@ class HbmEmbedding(nn.Module):
     "a2a"/"psum" force a form. ``capacity`` tunes the a2a per-peer
     bucket (see :func:`all_to_all_lookup`).
 
+    ``dedup`` (default True) routes only batch-wide unique ids over the
+    wire and restores per-occurrence rows (and combines duplicate-row
+    gradients) through a local inverse-map gather — the sparse-comms
+    fast path (docs/sparse_fast_path.md). With dedup on, ``capacity``
+    bounds UNIQUE ids per peer, so power-law batches need far less of
+    it. Set ``dedup=False`` to meter raw per-occurrence routing (the
+    pre-dedup wire behavior).
+
     ``collective=True``: for use INSIDE an outer shard_map (the
     multi-process elastic step, parallel/elastic.py) where nesting
     another shard_map is impossible. ``axis`` must be bound by the
@@ -306,6 +369,7 @@ class HbmEmbedding(nn.Module):
     method: str = "auto"
     capacity: int = None
     collective: bool = False
+    dedup: bool = True
 
     @nn.compact
     def __call__(self, ids, training=False):
@@ -368,7 +432,12 @@ class HbmEmbedding(nn.Module):
                     "elastic plane's sharded batch cannot provide"
                 )
             flat = jnp.reshape(ids, (-1,))
-            out, n_over = a2a_lookup_collective(
+            body = (
+                a2a_dedup_lookup_collective
+                if self.dedup
+                else a2a_lookup_collective
+            )
+            out, n_over = body(
                 table,
                 flat,
                 self.axis,
@@ -399,6 +468,7 @@ class HbmEmbedding(nn.Module):
                     self.axis,
                     capacity=self.capacity,
                     return_overflow=True,
+                    dedup=self.dedup,
                 )
                 meter(n_over)
             else:

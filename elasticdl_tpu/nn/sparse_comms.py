@@ -1,0 +1,64 @@
+"""Shared sparse-comms optimizer for both embedding planes.
+
+Recommendation batches (DeepFM-style power-law ID distributions) repeat
+the same embedding IDs many times per batch, yet a naive sparse plane
+ships every occurrence over the wire: the HBM plane all-to-alls duplicate
+rows over ICI and the host-PS plane pulls/pushes duplicate rows over
+gRPC. This module holds the primitives both planes use to stop doing
+that:
+
+- :func:`padded_unique` — a jit-compatible ``np.unique`` analog with
+  static shapes (sorted unique values compacted to the front, -1
+  padding after, plus the inverse map). The HBM plane routes only the
+  unique slots through ``lax.all_to_all`` and gathers locally through
+  the inverse map; the transpose of that local gather is a segment-sum,
+  so the BACKWARD wire also carries exactly one gradient row per unique
+  id (nn/hbm_embedding.py).
+- the host-PS plane's batch planning (nn/embedding.py
+  ``plan_lookup_multi``) runs the same dedup on host with ``np.unique``
+  before any pull, and the worker combines duplicate gradient rows with
+  ``common/tensor.py combine_indexed_slices`` before any push
+  (worker/ps_client.py); the hot-row LRU that serves repeated pulls
+  locally lives next to the client it accelerates
+  (worker/ps_client.py ``HotRowCache``).
+
+See docs/sparse_fast_path.md for the end-to-end picture.
+"""
+
+import jax.numpy as jnp
+
+
+def padded_unique(ids_flat):
+    """Jit-compatible unique-with-inverse over a flat int id vector.
+
+    Returns ``(uids, inv, n_unique)`` where ``uids`` has the SAME static
+    shape ``(m,)`` as the input — the sorted unique values compacted to
+    the front and ``-1`` padding after — ``inv`` maps each input
+    position to its slot in ``uids`` (so ``uids[inv]`` reproduces the
+    input), and ``n_unique`` is the traced count of live slots.
+
+    The -1 padding is understood by the a2a routing bodies
+    (nn/hbm_embedding.py): padded slots consume no per-peer capacity,
+    read zero rows, and are never counted as overflow. Gathering the
+    routed unique rows back through ``inv`` restores per-occurrence
+    rows; the VJP of that gather is a scatter-add over ``inv``, which
+    IS the row-combine of duplicate gradients — no separate backward
+    pass is needed.
+    """
+    ids_flat = jnp.asarray(ids_flat)
+    m = ids_flat.shape[0]
+    if m == 0:
+        return ids_flat, jnp.zeros((0,), jnp.int32), jnp.int32(0)
+    order = jnp.argsort(ids_flat, stable=True)
+    s = ids_flat[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), s[1:] != s[:-1]]
+    )
+    slot = jnp.cumsum(first) - 1  # unique slot of each sorted element
+    uids = jnp.full((m,), -1, ids_flat.dtype).at[slot].set(s)
+    inv = (
+        jnp.zeros((m,), jnp.int32)
+        .at[order]
+        .set(slot.astype(jnp.int32))
+    )
+    return uids, inv, (slot[-1] + 1).astype(jnp.int32)

@@ -56,6 +56,15 @@ from elasticdl_tpu.training.step import (
 )
 
 
+# re-exported: the trainer's historical home for the escapable-call
+# machinery; the implementation lives in the leaf module so the
+# graft-entry device probe can import it without the training stack
+from elasticdl_tpu.common.escapable import (  # noqa: F401
+    EscapeTimeout,
+    escapable_call,
+)
+
+
 def build_world_mesh(mesh_axes_fn=None):
     """The elastic world's device mesh.
 
@@ -867,7 +876,14 @@ class ElasticDPTrainer:
         t_world = _time.time()
         self._spec = spec
         self._mesh = build_world_mesh(self._mesh_axes_fn)
-        self._mirror_perm_fn = None  # mesh changed: rebuild on demand
+        # mesh changed: drop EVERY cached jitted callable bound to the
+        # old mesh before anything below (the establish-time
+        # _replicated_source_rank/_gather_mirror_info all-gathers) can
+        # run — a cached fn executed against the dead world's mesh
+        # would wedge or corrupt the re-form
+        self._mirror_perm_fn = None
+        self._eval_fn = None
+        self._gather_fns = {}
         self._wedged = False  # fresh backend: device fetches are safe again
         if self._builder is not None:
             self._module, param_specs = self._builder(self._mesh)
@@ -918,9 +934,6 @@ class ElasticDPTrainer:
             _time.time() - t_init,
         )
         self._checked_ts = self._ts
-        # mesh/world changed: rebuild the cached callables on demand
-        self._eval_fn = None
-        self._gather_fns = {}
         self._step_fn = make_elastic_train_step(
             self._module,
             self._loss_fn,
@@ -1919,62 +1932,21 @@ class ElasticDPTrainer:
         turns one process failure into two — exactly the adjacent
         double failure the replica plane cannot cover.
 
-        So every device interaction runs on a sacrificial DAEMON
-        thread (daemon, not an executor: concurrent.futures joins its
-        workers at interpreter exit, so one abandoned wedged thread
-        would hang the process forever at shutdown — exactly the
-        zombie state this exists to avoid); the host waits with the
-        worker-provided ``abort_check`` probe (no hard timeout — a
-        first-step compile legitimately takes minutes). When the
-        master has already moved the world on, the host abandons the
-        stuck thread (left parked in the dead gloo op), marks the
-        trainer wedged, and raises WorldBroken — the ordinary
-        failed-step recovery path, with this rank's host state intact
-        for the replica-plane reassembly."""
-        import queue as _queue
-        import threading as _threading
-        import time as _time
-
-        out = _queue.Queue(maxsize=1)
-
-        def runner():
-            try:
-                out.put((True, fn()))
-            except BaseException as e:  # noqa: BLE001 - re-raised below
-                out.put((False, e))
-
-        t = _threading.Thread(
-            target=runner, name="edl-device", daemon=True
-        )
-        t.start()
-        t0 = _time.monotonic()
-        last_check = t0
-        while True:
-            try:
-                ok, value = out.get(timeout=0.05)
-            except _queue.Empty:
-                pass
-            else:
-                if ok:
-                    return value
-                raise value
-            now = _time.monotonic()
-            if (
-                self.abort_check is not None
-                and now - t0 >= 2.0
-                and now - last_check >= 1.0
-            ):
-                last_check = now
-                try:
-                    moved_on = self.abort_check()
-                except Exception:
-                    moved_on = False
-                if moved_on:
-                    self._wedged = True
-                    raise distributed.WorldBroken(
-                        "world moved on while this rank's device "
-                        "stream was wedged by a peer loss"
-                    )
+        Delegates to :func:`escapable_call` with the worker-provided
+        ``abort_check`` probe and NO hard timeout (a first-step compile
+        legitimately takes minutes). When the master has already moved
+        the world on, the stuck thread is abandoned (left parked in the
+        dead gloo op), the trainer marks itself wedged, and WorldBroken
+        takes the ordinary failed-step recovery path with this rank's
+        host state intact for the replica-plane reassembly."""
+        try:
+            return escapable_call(fn, should_abort=self.abort_check)
+        except EscapeTimeout:
+            self._wedged = True
+            raise distributed.WorldBroken(
+                "world moved on while this rank's device "
+                "stream was wedged by a peer loss"
+            )
 
     def validate(self):
         """Force-complete all dispatched work; True if it all succeeded.

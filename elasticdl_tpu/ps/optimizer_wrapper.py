@@ -74,13 +74,13 @@ class OptimizerWrapper:
 
     @staticmethod
     def combine_duplicate_ids(indices, values):
-        """Sum rows of duplicate ids (reference merges IndexedSlices)."""
-        indices = np.asarray(indices, dtype=np.int64)
-        values = np.asarray(values, dtype=np.float32)
-        unique, inverse = np.unique(indices, return_inverse=True)
-        combined = np.zeros((len(unique), values.shape[1]), dtype=np.float32)
-        np.add.at(combined, inverse, values)
-        return unique, combined
+        """Sum rows of duplicate ids (reference merges IndexedSlices).
+
+        Delegates to the shared sparse-comms row-combine so the PS-side
+        apply and the worker-side pre-push combine are the same code."""
+        from elasticdl_tpu.common.tensor import combine_indexed_slices
+
+        return combine_indexed_slices(indices, values)
 
     def _row_state_template(self, dim):
         """opt.init on a single zero row: slot layout + fresh-row values.

@@ -71,12 +71,21 @@ class PserverServicer:
         }
 
     def pull_embedding_vector(self, req):
-        """Rows for req['ids'] of table req['name'] (lazy init)."""
+        """Rows for req['ids'] of table req['name'] (lazy init).
+
+        The response carries this shard's model version so the worker's
+        hot-row cache (worker/ps_client.py) can tag the rows and age
+        them out by the same staleness counter the async LR modulation
+        discounts by."""
+        version = self._parameters.version
         ids = np.asarray(req["ids"], dtype=np.int64)
         if ids.size == 0:
-            return {"rows": np.zeros((0, 0), np.float32)}
+            return {
+                "rows": np.zeros((0, 0), np.float32),
+                "version": version,
+            }
         rows = self._parameters.get_embedding_param(req["name"], ids)
-        return {"rows": rows}
+        return {"rows": rows, "version": version}
 
     def push_model(self, req):
         """First-write-wins model init (reference :70-79)."""
@@ -128,11 +137,15 @@ class PserverServicer:
                 self._parameters.check_grad(t)
                 if t.is_indexed_slices():
                     if t.name in self._indexed_sum:
+                        # row-combine as we accumulate: Tensor.__add__
+                        # concatenates, so grads_to_wait stale-free
+                        # rounds would otherwise buffer one copy of
+                        # every duplicate row until apply time
                         self._indexed_sum[t.name] = (
                             self._indexed_sum[t.name] + t
-                        )
+                        ).combined()
                     else:
-                        self._indexed_sum[t.name] = t
+                        self._indexed_sum[t.name] = t.combined()
                 else:
                     if t.name in self._dense_sum:
                         self._dense_sum[t.name] = (

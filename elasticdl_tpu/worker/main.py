@@ -44,8 +44,16 @@ def _run(args):
         from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
 
         addrs = [a for a in args.ps_addrs.split(",") if a]
+        window = getattr(args, "hot_row_staleness_window", 0)
+        if window <= 0:
+            # default staleness bound: the SSP window the worker already
+            # trains under between model pulls
+            window = getattr(args, "get_model_steps", 1)
         ps_client = PSClient(
-            [BoundPS(a) for a in addrs], wire_dtype=wire_dtype
+            [BoundPS(a) for a in addrs],
+            wire_dtype=wire_dtype,
+            hot_row_cache_rows=getattr(args, "hot_row_cache_rows", 0),
+            staleness_window=window,
         )
     from elasticdl_tpu.common.model_utils import get_dict_from_params_str
 

@@ -8,6 +8,8 @@ row/variable placement is stable across restarts and matches the
 checkpoint layout.
 """
 
+from collections import OrderedDict
+
 import numpy as np
 
 from elasticdl_tpu.common.hash_utils import (
@@ -17,16 +19,112 @@ from elasticdl_tpu.common.hash_utils import (
 from elasticdl_tpu.common.tensor import Tensor
 
 
+class HotRowCache:
+    """Worker-side LRU of recently pulled embedding rows, with
+    version-tagged invalidation.
+
+    Power-law id distributions re-pull the same head rows every batch;
+    this cache serves those repeats locally instead of over gRPC. Every
+    entry is tagged with the owning PS shard's model version at pull
+    time; the client notes the newest version it has SEEN per shard
+    (from pull AND push responses — the same version counter
+    ps/servicer.py's staleness machinery modulates the LR by), and an
+    entry older than ``window`` versions behind that is a miss. The
+    served rows are therefore stale by at most ``window`` optimizer
+    steps of that shard — the same bounded-staleness contract SSP local
+    updates already run under (``get_model_steps``, with the async LR
+    discounted by 1/staleness via master/learning_rate_modulator.py) —
+    so the cache never adds a staleness mode the training loop doesn't
+    already tolerate.
+    """
+
+    def __init__(self, max_rows, window=1):
+        if max_rows <= 0:
+            raise ValueError("max_rows must be positive")
+        if window < 0:
+            raise ValueError("window must be >= 0")
+        self._max_rows = max_rows
+        self._window = window
+        self._rows = OrderedDict()  # (name, id) -> (shard, version, row)
+        self._latest = {}  # shard -> newest version seen in any response
+        self.hits = 0
+        self.misses = 0
+
+    def note_version(self, shard, version):
+        """Record a version observed in shard ``shard``'s response."""
+        if version is None or version < 0:
+            return
+        if version > self._latest.get(shard, -1):
+            self._latest[shard] = version
+
+    def get(self, name, row_id):
+        """The cached row, or None on miss/stale (stale entries drop)."""
+        key = (name, int(row_id))
+        entry = self._rows.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        shard, version, row = entry
+        if version < self._latest.get(shard, -1) - self._window:
+            del self._rows[key]
+            self.misses += 1
+            return None
+        self._rows.move_to_end(key)
+        self.hits += 1
+        return row
+
+    def put(self, name, row_id, shard, version, row):
+        if version is None:
+            return  # unversioned response: nothing safe to tag with
+        key = (name, int(row_id))
+        # copy: ``row`` is usually a view into the pull's full response
+        # array, and storing the view would pin that whole buffer for
+        # as long as any one of its rows stays hot
+        self._rows[key] = (shard, version, np.array(row, np.float32))
+        self._rows.move_to_end(key)
+        while len(self._rows) > self._max_rows:
+            self._rows.popitem(last=False)
+
+    def __len__(self):
+        return len(self._rows)
+
+
 class PSClient:
-    def __init__(self, ps_stubs, wire_dtype=""):
+    def __init__(
+        self,
+        ps_stubs,
+        wire_dtype="",
+        combine_push=True,
+        hot_row_cache_rows=0,
+        staleness_window=1,
+    ):
         """``ps_stubs``: list of objects exposing the Pserver dict-RPC
         methods — rpc.core Clients bound with ``BoundPS`` below, or
         in-process PserverServicer instances (the reference test rung 2
         uses both). ``wire_dtype="bfloat16"`` compresses pushed
         gradients (see rpc/wire_compression.py); pulled params
-        decompress by the response's own field."""
+        decompress by the response's own field.
+
+        Sparse fast path knobs (docs/sparse_fast_path.md):
+        ``combine_push`` (default on) segment-sums duplicate sparse rows
+        before the wire so each push carries one row per unique id;
+        ``hot_row_cache_rows`` > 0 enables a :class:`HotRowCache` of
+        that many rows whose entries stay valid for
+        ``staleness_window`` PS versions (wire it to the worker's SSP
+        window, ``get_model_steps``)."""
         self._ps = ps_stubs
         self._wire_dtype = wire_dtype
+        self._combine_push = combine_push
+        self._cache = (
+            HotRowCache(hot_row_cache_rows, staleness_window)
+            if hot_row_cache_rows > 0
+            else None
+        )
+
+    @property
+    def hot_row_cache(self):
+        """The HotRowCache (None when disabled) — stats live on it."""
+        return self._cache
 
     @property
     def num_ps(self):
@@ -70,11 +168,13 @@ class PSClient:
 
         named = {}
         versions = []
-        for ps in self._ps:
+        for shard, ps in enumerate(self._ps):
             resp = ps.pull_variable({})
             if not resp.get("model_init_status"):
                 return False, -1, {}
             versions.append(resp["version"])
+            if self._cache is not None:
+                self._cache.note_version(shard, resp["version"])
             for t in decompress_tensors(
                 resp.get("params", []), resp.get("compressed_f32")
             ):
@@ -93,6 +193,11 @@ class PSClient:
         for name, arr in (dense_named or {}).items():
             reqs[string_to_id(name, self.num_ps)].append(Tensor(name, arr))
         for t in sparse_tensors or ():
+            if self._combine_push:
+                # one row per unique id on the wire; the PS applies the
+                # sum either way (optimizer_wrapper combines at apply),
+                # so this only shrinks the payload
+                t = t.combined()
             for shard, (values, ids) in scatter_embedding_vector(
                 t.values, t.indices, self.num_ps
             ).items():
@@ -100,7 +205,7 @@ class PSClient:
         from elasticdl_tpu.rpc.wire_compression import compress_tensors
 
         accepted, out_version = True, -1
-        for ps, tensors in zip(self._ps, reqs):
+        for shard, (ps, tensors) in enumerate(zip(self._ps, reqs)):
             tensors, compressed = compress_tensors(
                 tensors, self._wire_dtype
             )
@@ -113,19 +218,38 @@ class PSClient:
             )
             accepted = resp["accepted"]
             out_version = resp["version"]
+            if self._cache is not None:
+                # the apply this push triggered advanced the shard's
+                # version: noting it here ages our cached copies of the
+                # rows it just rewrote
+                self._cache.note_version(shard, resp["version"])
         return accepted, out_version
 
     # -- embeddings ---------------------------------------------------------
 
     def pull_embedding_vectors(self, name, ids):
-        """Scatter ids to shards by id%N, gather, restore original order."""
+        """Scatter ids to shards by id%N, gather, restore original order.
+
+        With the hot-row cache enabled, cached fresh rows are served
+        locally and only the misses cross the wire (a shard whose ids
+        all hit is skipped entirely); pulled rows enter the cache tagged
+        with the response's model version."""
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
             return np.zeros((0, 0), np.float32)
         shard_ids = ids % self.num_ps
         out = None
+        hit_rows = {}  # position -> cached row
+        if self._cache is not None:
+            for pos in range(len(ids)):
+                row = self._cache.get(name, ids[pos])
+                if row is not None:
+                    hit_rows[pos] = row
         for shard in np.unique(shard_ids):
             positions = np.nonzero(shard_ids == shard)[0]
+            positions = [p for p in positions if p not in hit_rows]
+            if not positions:
+                continue
             resp = self._ps[int(shard)].pull_embedding_vector(
                 {"name": name, "ids": ids[positions]}
             )
@@ -138,6 +262,19 @@ class PSClient:
             if out is None:
                 out = np.empty((len(ids), got.shape[1]), np.float32)
             out[positions] = got
+            if self._cache is not None:
+                version = resp.get("version")
+                self._cache.note_version(int(shard), version)
+                for p, row in zip(positions, got):
+                    self._cache.put(
+                        name, ids[p], int(shard), version, row
+                    )
+        if hit_rows:
+            if out is None:
+                dim = next(iter(hit_rows.values())).shape[0]
+                out = np.empty((len(ids), dim), np.float32)
+            for pos, row in hit_rows.items():
+                out[pos] = row
         return out
 
 

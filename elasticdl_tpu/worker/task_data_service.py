@@ -243,7 +243,20 @@ class TaskDataService:
                 self._parked_export_task = task
                 continue
             with self._ledger_lock:
-                self._inflight.append(task)
+                # re-check the round under the SAME hold as the append:
+                # requeue_inflight can bump _round_id and clear the
+                # ledger between the check above and here, and an
+                # append after that would charge the next round's
+                # records against a task the master already requeued
+                # (double-train + wrong accounting)
+                stale = self._round_id != gen_id
+                if not stale:
+                    self._inflight.append(task)
+            if stale:
+                self._worker.report_task_result(
+                    task.task_id, "round abandoned (spare park)"
+                )
+                return
             for record in self.data_reader.read_records(task):
                 if record is not None:
                     yield record

@@ -84,6 +84,7 @@ class Worker:
         data_reader_params=None,
         seed=0,
         precision=None,
+        sparse_dedup=True,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -93,6 +94,11 @@ class Worker:
         self._get_model_steps = get_model_steps
         self._max_minibatch_retry_num = max_minibatch_retry_num
         self._seed = seed
+        # sparse-comms fast path: batch-wide id dedup before every row
+        # pull, which also makes the pushed row gradients come back
+        # pre-combined (docs/sparse_fast_path.md). False restores the
+        # naive per-occurrence plan for benchmarking/equivalence runs.
+        self._sparse_dedup = sparse_dedup
 
         spec = get_model_spec(
             model_zoo=model_zoo,
@@ -343,7 +349,9 @@ class Worker:
             # one union pull per layer, however many times it is called:
             # every call slot gathers from the same rows buffer, so row
             # gradients of a tied embedding accumulate across calls
-            unique, idxs, bucket = plan_lookup_multi(ids_list)
+            unique, idxs, bucket = plan_lookup_multi(
+                ids_list, dedup=self._sparse_dedup
+            )
             if self._ps_client is not None:
                 rows = self._ps_client.pull_embedding_vectors(
                     path_name(path), unique

@@ -165,9 +165,11 @@ def test_a2a_overflow_is_counted_not_silent():
     assert int(n_over) == 2
 
     # layer-level: the metrics collection accumulates across train steps
+    # (dedup=False: this test meters PER-OCCURRENCE capacity overflow;
+    # the dedup fast path would resolve these duplicate ids in 2 slots)
     model = HbmEmbedding(
         vocab_size=16, features=2, mesh=mesh, axis="data",
-        method="a2a", capacity=2,
+        method="a2a", capacity=2, dedup=False,
     )
     variables = model.init(jax.random.PRNGKey(0), ids)
     state = {k: v for k, v in variables.items() if k != "params"}
