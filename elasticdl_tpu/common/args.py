@@ -351,6 +351,43 @@ def add_common_args_between_master_and_worker(parser):
         "before it is re-pulled; 0 (default) binds it to the SSP "
         "window, --get_model_steps",
     )
+    add_bool_param(
+        parser,
+        "--ps_fanout",
+        True,
+        "Issue the per-shard RPCs of each logical PS call concurrently "
+        "(one round trip per call instead of one per shard); false "
+        "restores the serial loop (docs/dense_overlap.md)",
+    )
+    parser.add_argument(
+        "--ps_push_inflight",
+        type=non_neg_int,
+        default=0,
+        help="PS mode: allow this many gradient pushes in flight "
+        "behind the compute (1 = double buffering; 0 = synchronous "
+        "push). The window drains at every model pull and task "
+        "boundary, so staleness stays inside the SSP window "
+        "(docs/dense_overlap.md); pair with async PS "
+        "(--use_async), where late stale-rejections cannot occur",
+    )
+    parser.add_argument(
+        "--rpc_deadline_s",
+        type=float,
+        default=60.0,
+        help="Deadline in seconds for each PS data-plane RPC: a dead "
+        "PS pod fails the call (DEADLINE_EXCEEDED into the worker's "
+        "minibatch retry loop) instead of hanging forever. 0 disables. "
+        "Control-plane master RPCs are NOT bounded (a worker parked on "
+        "get_task must block)",
+    )
+    parser.add_argument(
+        "--rpc_retries",
+        type=non_neg_int,
+        default=2,
+        help="Retries (doubling backoff) for UNAVAILABLE PS data-plane "
+        "RPCs — the shape a restarting PS pod presents; deadline "
+        "expiry is never retried at this layer",
+    )
 
 
 def parse_master_args(master_args=None):
@@ -391,6 +428,15 @@ def parse_ps_args(ps_args=None):
     add_bool_param(parser, "--lr_staleness_modulation", False, "")
     parser.add_argument(
         "--wire_dtype", default="", choices=["", "bfloat16"]
+    )
+    parser.add_argument(
+        "--rpc_inject_delay_ms",
+        type=float,
+        default=0.0,
+        help="Test/bench fault injection: sleep this long in every RPC "
+        "handler before serving it — models cross-pod network RTT on "
+        "loopback fleets so overlap benchmarks measure what a real "
+        "deployment would see. 0 (default) disables",
     )
     parser.add_argument(
         "--log_level",

@@ -37,7 +37,21 @@ class ParameterServer:
         )
 
     def prepare(self):
-        self._server = serve(self.servicer.rpc_methods(), self._args.port)
+        methods = self.servicer.rpc_methods()
+        delay_ms = getattr(self._args, "rpc_inject_delay_ms", 0.0) or 0.0
+        if delay_ms > 0:
+            # bench/test fault injection (--rpc_inject_delay_ms):
+            # emulate cross-pod RTT on a loopback fleet by sleeping in
+            # every handler before serving it
+            def delayed(fn, delay_s=delay_ms / 1e3):
+                def handler(req):
+                    time.sleep(delay_s)
+                    return fn(req)
+
+                return handler
+
+            methods = {name: delayed(fn) for name, fn in methods.items()}
+        self._server = serve(methods, self._args.port)
         logger.info(
             "RPC server started on port %d", self._server._edl_port
         )

@@ -11,9 +11,12 @@ Methods take/return plain dicts (the rpc.core message model) so the same
 object serves real gRPC or in-process tests unchanged.
 """
 
+import contextlib
 import threading
 
 import numpy as np
+
+_NULL_LOCK = contextlib.nullcontext()
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.tensor import Tensor
@@ -53,19 +56,29 @@ class PserverServicer:
     # -- RPC methods --------------------------------------------------------
 
     def pull_variable(self, req):
-        """All non-embedding params + init status (reference :36-57)."""
+        """All non-embedding params + init status (reference :36-57).
+
+        Sync mode snapshots under the gradient lock: with workers'
+        overlapped data planes a pull can land mid-apply, and an
+        unguarded ``to_named_arrays`` would hand back a torn mix of
+        pre- and post-step values tagged with one version. Async mode
+        stays lock-free — hogwild reads are its contract, and the LR
+        staleness modulation already prices them in."""
         from elasticdl_tpu.rpc.wire_compression import compress_tensors
 
         if not self._parameters.initialized:
             return {"model_init_status": False, "version": -1}
-        named = self._parameters.to_named_arrays()
+        lock = self._lock if not self._use_async else _NULL_LOCK
+        with lock:
+            named = self._parameters.to_named_arrays()
+            version = self._parameters.version
         params, compressed = compress_tensors(
             [Tensor(n, v) for n, v in sorted(named.items())],
             self._wire_dtype,
         )
         return {
             "model_init_status": True,
-            "version": self._parameters.version,
+            "version": version,
             "params": params,
             "compressed_f32": compressed,
         }

@@ -14,6 +14,7 @@ Message model: a dict whose values are JSON scalars/lists, np.ndarrays,
 
 import json
 import struct
+import time
 from concurrent import futures
 
 import numpy as np
@@ -147,11 +148,31 @@ def serve(methods, port, max_workers=64):
 
 
 class Client:
-    """Bytes-frame RPC client: ``client.call("method", **fields)``."""
+    """Bytes-frame RPC client: ``client.call("method", **fields)``.
 
-    def __init__(self, addr):
+    ``deadline_s``: per-attempt gRPC deadline in seconds; ``None``
+    (default) keeps the historical block-forever behavior — the
+    control-plane master channel relies on it (a worker parked on
+    ``get_task`` against a busy master must wait, not error). The PS
+    data plane passes a finite deadline so a dead PS pod fails the call
+    in seconds and feeds the worker's existing minibatch retry loop
+    instead of hanging a fan-out forever.
+
+    ``retries``/``backoff_s``: transient-transport retry. Only
+    UNAVAILABLE is retried (channel down / connection refused — the
+    shape a restarting PS pod presents); DEADLINE_EXCEEDED is NOT,
+    so the caller-visible failure bound stays ~``deadline_s`` rather
+    than ``deadline_s * (retries + 1)``. Backoff doubles per attempt.
+    """
+
+    def __init__(self, addr, deadline_s=None, retries=0, backoff_s=0.2):
         import grpc
 
+        self._grpc = grpc
+        self._deadline_s = deadline_s if deadline_s else None
+        self._retries = retries
+        self._backoff_s = backoff_s
+        self._sleep = time.sleep  # injectable for tests
         self._channel = grpc.insecure_channel(
             addr,
             options=[
@@ -167,7 +188,13 @@ class Client:
         )
         self._stubs = {}
 
-    def call(self, rpc_name, **fields):
+    def call(self, rpc_name, _retriable=True, **fields):
+        """``_retriable=False`` opts this call out of the UNAVAILABLE
+        retry: a non-idempotent RPC (``push_gradient`` — async mode
+        applies on receipt) must not be resent when the connection died
+        AFTER the server processed it, or the gradient applies twice.
+        The underscore keeps the name out of the protocol field space.
+        """
         stub = self._stubs.get(rpc_name)
         if stub is None:
             stub = self._channel.unary_unary(
@@ -176,7 +203,24 @@ class Client:
                 response_deserializer=lambda b: b,
             )
             self._stubs[rpc_name] = stub
-        return unpack_message(stub(pack_message(fields)))
+        request = pack_message(fields)
+        attempt = 0
+        while True:
+            try:
+                return unpack_message(
+                    stub(request, timeout=self._deadline_s)
+                )
+            except self._grpc.RpcError as err:
+                code = err.code() if callable(getattr(err, "code", None)) else None
+                retriable = (
+                    _retriable
+                    and code == self._grpc.StatusCode.UNAVAILABLE
+                    and attempt < self._retries
+                )
+                if not retriable:
+                    raise
+                self._sleep(self._backoff_s * (2 ** attempt))
+                attempt += 1
 
     def close(self):
         self._channel.close()

@@ -49,11 +49,21 @@ def _run(args):
             # default staleness bound: the SSP window the worker already
             # trains under between model pulls
             window = getattr(args, "get_model_steps", 1)
+        deadline_s = getattr(args, "rpc_deadline_s", 60.0)
         ps_client = PSClient(
-            [BoundPS(a) for a in addrs],
+            [
+                BoundPS(
+                    a,
+                    deadline_s=deadline_s if deadline_s > 0 else None,
+                    retries=getattr(args, "rpc_retries", 2),
+                )
+                for a in addrs
+            ],
             wire_dtype=wire_dtype,
             hot_row_cache_rows=getattr(args, "hot_row_cache_rows", 0),
             staleness_window=window,
+            fanout=getattr(args, "ps_fanout", True),
+            push_inflight=getattr(args, "ps_push_inflight", 0),
         )
     from elasticdl_tpu.common.model_utils import get_dict_from_params_str
 
@@ -197,7 +207,13 @@ def _run(args):
         ),
         precision=args.precision_policy or None,
     )
-    worker.run()
+    try:
+        worker.run()
+    finally:
+        if ps_client is not None:
+            # settles any still-pending async pushes and releases the
+            # fan-out threads
+            ps_client.close()
     return 0
 
 

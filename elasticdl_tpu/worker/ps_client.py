@@ -6,9 +6,19 @@ partitioned to PS shards by name hash (:279-291), embedding rows by
 pull-merge of dense params. Partition placement uses common/hash_utils so
 row/variable placement is stable across restarts and matches the
 checkpoint layout.
+
+Overlap (docs/dense_overlap.md): every logical data-plane call fans its
+per-shard RPCs out concurrently over a small thread pool, so an N-shard
+fleet costs one round trip instead of N; ``push_inflight > 0`` makes
+``push_gradient`` non-blocking behind a bounded in-flight window that
+drains at every ``pull_dense`` and at worker task boundaries. The caller
+contract is single-threaded: one worker thread drives the client; the
+internal pools only ever run the per-shard legs and the queued pushes.
 """
 
-from collections import OrderedDict
+import threading
+from collections import OrderedDict, deque
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -36,6 +46,10 @@ class HotRowCache:
     discounted by 1/staleness via master/learning_rate_modulator.py) —
     so the cache never adds a staleness mode the training loop doesn't
     already tolerate.
+
+    Thread-safe: with the overlapped data plane, push completions note
+    versions from the fan-out/push threads while the worker thread
+    probes and fills, so every mutation runs under one internal lock.
     """
 
     def __init__(self, max_rows, window=1):
@@ -45,6 +59,7 @@ class HotRowCache:
             raise ValueError("window must be >= 0")
         self._max_rows = max_rows
         self._window = window
+        self._mu = threading.Lock()
         self._rows = OrderedDict()  # (name, id) -> (shard, version, row)
         self._latest = {}  # shard -> newest version seen in any response
         self.hits = 0
@@ -54,11 +69,22 @@ class HotRowCache:
         """Record a version observed in shard ``shard``'s response."""
         if version is None or version < 0:
             return
-        if version > self._latest.get(shard, -1):
-            self._latest[shard] = version
+        with self._mu:
+            if version > self._latest.get(shard, -1):
+                self._latest[shard] = version
 
     def get(self, name, row_id):
         """The cached row, or None on miss/stale (stale entries drop)."""
+        with self._mu:
+            return self._get_locked(name, row_id)
+
+    def get_rows(self, name, row_ids):
+        """Probe one batch under a single lock acquisition; one entry
+        per id, None on miss (the read-side twin of put_rows)."""
+        with self._mu:
+            return [self._get_locked(name, r) for r in row_ids]
+
+    def _get_locked(self, name, row_id):
         key = (name, int(row_id))
         entry = self._rows.get(key)
         if entry is None:
@@ -76,6 +102,18 @@ class HotRowCache:
     def put(self, name, row_id, shard, version, row):
         if version is None:
             return  # unversioned response: nothing safe to tag with
+        with self._mu:
+            self._put_locked(name, row_id, shard, version, row)
+
+    def put_rows(self, name, row_ids, shard, version, rows):
+        """Insert one pulled batch under a single lock acquisition."""
+        if version is None:
+            return
+        with self._mu:
+            for row_id, row in zip(row_ids, rows):
+                self._put_locked(name, row_id, shard, version, row)
+
+    def _put_locked(self, name, row_id, shard, version, row):
         key = (name, int(row_id))
         # copy: ``row`` is usually a view into the pull's full response
         # array, and storing the view would pin that whole buffer for
@@ -86,7 +124,8 @@ class HotRowCache:
             self._rows.popitem(last=False)
 
     def __len__(self):
-        return len(self._rows)
+        with self._mu:
+            return len(self._rows)
 
 
 class PSClient:
@@ -97,6 +136,8 @@ class PSClient:
         combine_push=True,
         hot_row_cache_rows=0,
         staleness_window=1,
+        fanout=True,
+        push_inflight=0,
     ):
         """``ps_stubs``: list of objects exposing the Pserver dict-RPC
         methods — rpc.core Clients bound with ``BoundPS`` below, or
@@ -111,7 +152,15 @@ class PSClient:
         ``hot_row_cache_rows`` > 0 enables a :class:`HotRowCache` of
         that many rows whose entries stay valid for
         ``staleness_window`` PS versions (wire it to the worker's SSP
-        window, ``get_model_steps``)."""
+        window, ``get_model_steps``).
+
+        Overlap knobs (docs/dense_overlap.md): ``fanout`` (default on)
+        issues the per-shard RPCs of one logical call concurrently;
+        ``push_inflight`` > 0 makes ``push_gradient`` non-blocking with
+        at most that many logical pushes on the wire (1 = classic
+        double buffering: compute batch k+1 while batch k's gradients
+        travel). The window drains at every ``pull_dense`` and via
+        :meth:`drain`."""
         self._ps = ps_stubs
         self._wire_dtype = wire_dtype
         self._combine_push = combine_push
@@ -120,6 +169,14 @@ class PSClient:
             if hot_row_cache_rows > 0
             else None
         )
+        self._fanout_enabled = bool(fanout)
+        self._fanout_pool = None
+        self._push_inflight = max(0, int(push_inflight))
+        self._push_pool = None
+        self._pending_pushes = deque()
+        # combined outcome of async pushes reaped since the last drain
+        self._reaped_accepted = True
+        self._last_push_version = -1
 
     @property
     def hot_row_cache(self):
@@ -130,13 +187,82 @@ class PSClient:
     def num_ps(self):
         return len(self._ps)
 
+    @property
+    def push_inflight_window(self):
+        return self._push_inflight
+
     def _ps_of_var(self, name):
         return self._ps[string_to_id(name, self.num_ps)]
+
+    # -- concurrent shard fan-out -------------------------------------------
+
+    def _get_fanout_pool(self):
+        if self._fanout_pool is None:
+            # wider than num_ps: one multi-table pull produces
+            # (tables x shards) legs that should all fly in one round
+            self._fanout_pool = ThreadPoolExecutor(
+                max_workers=min(16, max(self.num_ps, 8)),
+                thread_name_prefix="edl-ps-fanout",
+            )
+        return self._fanout_pool
+
+    def _run_sharded(self, calls):
+        """Run ``[(shard, thunk), ...]`` and return ``{shard: result}``.
+
+        With fan-out on, every thunk is submitted to the pool at once
+        and the per-shard round trips overlap, so one logical call costs
+        the slowest shard, not the sum of shards. Completion handling is
+        deterministic either way: results are consumed in ascending
+        shard order, and on failure the lowest-numbered failing shard's
+        exception is raised only after EVERY call finished — no RPC is
+        left in flight mutating caller-visible state after the raise.
+        """
+        if not calls:
+            return {}
+        if not self._fanout_enabled or len(calls) == 1:
+            return {shard: thunk() for shard, thunk in calls}
+        pool = self._get_fanout_pool()
+        futs = [(shard, pool.submit(thunk)) for shard, thunk in calls]
+        results, errors = {}, []
+        for shard, fut in futs:
+            try:
+                results[shard] = fut.result()
+            except Exception as err:  # noqa: BLE001 — re-raised below
+                errors.append((shard, err))
+        if errors:
+            errors.sort(key=lambda pair: pair[0])
+            raise errors[0][1]
+        return results
+
+    def close(self):
+        """Drain pending pushes and release the fan-out/push threads.
+
+        Best-effort on the drain: close() runs from teardown paths
+        (worker main's finally), where a dead-shard error has already
+        surfaced through drain()/pull_dense and must not mask the
+        original failure — it is logged, not re-raised."""
+        try:
+            self.drain()
+        except Exception as err:  # noqa: BLE001 — teardown best-effort
+            from elasticdl_tpu.common.log_utils import default_logger
+
+            default_logger.warning(
+                "async push window failed to drain at close: %s", err
+            )
+        finally:
+            for pool in (self._push_pool, self._fanout_pool):
+                if pool is not None:
+                    pool.shutdown(wait=True)
+            self._push_pool = None
+            self._fanout_pool = None
 
     # -- model lifecycle ----------------------------------------------------
 
     def push_model(self, named_params, embedding_infos=None, version=0):
-        """Partition dense vars by name hash; infos go to every shard."""
+        """Partition dense vars by name hash; infos go to every shard.
+
+        All shard pushes go out concurrently; the call returns only
+        once every shard has acked its partition."""
         partitions = [{} for _ in range(self.num_ps)]
         for name, arr in named_params.items():
             partitions[string_to_id(name, self.num_ps)][name] = arr
@@ -144,32 +270,59 @@ class PSClient:
             {"name": i.name, "dim": i.dim, "initializer": i.initializer}
             for i in embedding_infos or ()
         ]
-        for ps, part in zip(self._ps, partitions):
-            ps.push_model(
-                {
-                    "version": version,
-                    "params": [Tensor(n, v) for n, v in part.items()],
-                    "embedding_infos": infos,
-                }
+        calls = []
+        for shard, (ps, part) in enumerate(zip(self._ps, partitions)):
+            req = {
+                "version": version,
+                "params": [Tensor(n, v) for n, v in part.items()],
+                "embedding_infos": infos,
+            }
+            calls.append(
+                (shard, lambda ps=ps, req=req: ps.push_model(req))
             )
+        self._run_sharded(calls)
 
     def push_embedding_info(self, embedding_infos):
         infos = [
             {"name": i.name, "dim": i.dim, "initializer": i.initializer}
             for i in embedding_infos
         ]
-        for ps in self._ps:
-            ps.push_embedding_info({"embedding_infos": infos})
+        self._run_sharded(
+            [
+                (
+                    shard,
+                    lambda ps=ps: ps.push_embedding_info(
+                        {"embedding_infos": infos}
+                    ),
+                )
+                for shard, ps in enumerate(self._ps)
+            ]
+        )
 
     def pull_dense(self):
         """Merge every shard's params; returns (all_initialized, version,
-        {name: ndarray})."""
+        {name: ndarray}).
+
+        Drains the async-push window first, so the pulled model always
+        reflects this worker's own completed pushes (the in-flight
+        window never widens the SSP staleness bound). All shard pulls
+        are issued concurrently; responses merge in ascending shard
+        order (names are hash-partitioned, so order cannot change the
+        result — the fixed order keeps failure handling deterministic).
+        """
         from elasticdl_tpu.rpc.wire_compression import decompress_tensors
 
+        self.drain()
+        resps = self._run_sharded(
+            [
+                (shard, lambda ps=ps: ps.pull_variable({}))
+                for shard, ps in enumerate(self._ps)
+            ]
+        )
         named = {}
         versions = []
-        for shard, ps in enumerate(self._ps):
-            resp = ps.pull_variable({})
+        for shard in range(self.num_ps):
+            resp = resps[shard]
             if not resp.get("model_init_status"):
                 return False, -1, {}
             versions.append(resp["version"])
@@ -186,8 +339,22 @@ class PSClient:
     def push_gradient(self, dense_named, sparse_tensors, version):
         """Per-shard push: dense by var hash, sparse rows by id shard.
 
-        Returns (accepted, version) of the last response, matching the
-        reference's TODO-choose-last behavior (worker.py:444-450).
+        Returns the COMBINED result across shards: ``accepted`` only
+        when EVERY shard accepted, ``version`` the minimum shard
+        version. This deliberately departs from the reference's
+        TODO-choose-last tail (worker.py:444-450), which reported only
+        the final shard's response and silently masked an earlier
+        shard's stale-gradient rejection.
+
+        With ``push_inflight`` > 0 the call is non-blocking: the whole
+        fan-out (compression included) runs on a push thread while the
+        worker computes the next batch, bounded to ``push_inflight``
+        logical pushes in flight (submitting past the window first
+        reaps the oldest). The immediate return is optimistic —
+        ``(True, last reconciled version)`` — and the true combined
+        outcome is reconciled at the next ``pull_dense``/:meth:`drain`,
+        where a shard failure also re-raises. The default window of 1
+        keeps pushes strictly ordered per shard.
         """
         reqs = [[] for _ in range(self.num_ps)]
         for name, arr in (dense_named or {}).items():
@@ -202,28 +369,91 @@ class PSClient:
                 t.values, t.indices, self.num_ps
             ).items():
                 reqs[shard].append(Tensor(t.name, values, indices=ids))
-        from elasticdl_tpu.rpc.wire_compression import compress_tensors
-
-        accepted, out_version = True, -1
-        for shard, (ps, tensors) in enumerate(zip(self._ps, reqs)):
-            tensors, compressed = compress_tensors(
-                tensors, self._wire_dtype
+        if self._push_inflight <= 0:
+            return self._push_shards(reqs, version)
+        while len(self._pending_pushes) >= self._push_inflight:
+            self._reap_push(self._pending_pushes.popleft())
+        if self._push_pool is None:
+            # one driver thread per window slot, separate from the
+            # fan-out pool (a driver waits on fan-out futures; sharing
+            # the pool could starve its own legs)
+            self._push_pool = ThreadPoolExecutor(
+                max_workers=self._push_inflight,
+                thread_name_prefix="edl-ps-push",
             )
-            resp = ps.push_gradient(
+        self._pending_pushes.append(
+            self._push_pool.submit(self._push_shards, reqs, version)
+        )
+        return True, self._last_push_version
+
+    def _push_shards(self, reqs, version):
+        """One logical push: compress + send every shard leg, combine."""
+
+        def push_one(shard):
+            from elasticdl_tpu.rpc.wire_compression import compress_tensors
+
+            tensors, compressed = compress_tensors(
+                reqs[shard], self._wire_dtype
+            )
+            return self._ps[shard].push_gradient(
                 {
                     "model_version": version,
                     "gradients": tensors,
                     "compressed_f32": compressed,
                 }
             )
-            accepted = resp["accepted"]
-            out_version = resp["version"]
+
+        resps = self._run_sharded(
+            [
+                (shard, lambda shard=shard: push_one(shard))
+                for shard in range(self.num_ps)
+            ]
+        )
+        accepted, out_version = True, None
+        for shard in range(self.num_ps):
+            resp = resps[shard]
+            accepted = accepted and bool(resp["accepted"])
+            out_version = (
+                resp["version"]
+                if out_version is None
+                else min(out_version, resp["version"])
+            )
             if self._cache is not None:
                 # the apply this push triggered advanced the shard's
                 # version: noting it here ages our cached copies of the
                 # rows it just rewrote
                 self._cache.note_version(shard, resp["version"])
-        return accepted, out_version
+        return accepted, (-1 if out_version is None else out_version)
+
+    def _reap_push(self, fut):
+        accepted, version = fut.result()
+        self._reaped_accepted = self._reaped_accepted and accepted
+        if version >= 0:
+            self._last_push_version = max(
+                self._last_push_version, version
+            )
+        return accepted, version
+
+    def drain(self):
+        """Complete every in-flight async push synchronously.
+
+        Returns ``(accepted, version)`` combined over all pushes reaped
+        since the previous drain — ``accepted`` is False if ANY shard
+        of any push rejected, ``version`` is the newest version any
+        push response reported (-1 when nothing completed). A shard
+        failure (e.g. deadline expiry on a dead pod) re-raises here.
+        Called automatically by ``pull_dense``; the worker also calls
+        it at task boundaries, before eval, and before checkpoints.
+        """
+        while self._pending_pushes:
+            self._reap_push(self._pending_pushes.popleft())
+        accepted = self._reaped_accepted
+        self._reaped_accepted = True
+        return accepted, self._last_push_version
+
+    @property
+    def pending_push_count(self):
+        return len(self._pending_pushes)
 
     # -- embeddings ---------------------------------------------------------
 
@@ -233,61 +463,151 @@ class PSClient:
         With the hot-row cache enabled, cached fresh rows are served
         locally and only the misses cross the wire (a shard whose ids
         all hit is skipped entirely); pulled rows enter the cache tagged
-        with the response's model version."""
-        ids = np.asarray(ids, dtype=np.int64)
-        if ids.size == 0:
-            return np.zeros((0, 0), np.float32)
-        shard_ids = ids % self.num_ps
-        out = None
-        hit_rows = {}  # position -> cached row
-        if self._cache is not None:
-            for pos in range(len(ids)):
-                row = self._cache.get(name, ids[pos])
-                if row is not None:
-                    hit_rows[pos] = row
-        for shard in np.unique(shard_ids):
-            positions = np.nonzero(shard_ids == shard)[0]
-            positions = [p for p in positions if p not in hit_rows]
-            if not positions:
+        with the response's model version. The cache is probed once per
+        DISTINCT id (duplicates fan out from that single probe via
+        numpy mask ops — hit/miss stats count probes), and per-shard
+        miss filtering is a mask select, not a per-id Python loop.
+        Shard pulls fan out concurrently; responses land in disjoint
+        row ranges and merge in ascending shard order."""
+        return self.pull_embedding_vectors_multi({name: ids})[name]
+
+    def pull_embedding_vectors_multi(self, ids_by_name):
+        """Pull several tables' rows in ONE fan-out round.
+
+        ``{table_name: ids} -> {table_name: rows}``: every
+        (table, shard) leg flies concurrently, so a model with T
+        embedding layers pays one round trip per batch instead of T
+        (the worker's batch prepare pulls all layers through here).
+        Semantics per table are exactly :meth:`pull_embedding_vectors`;
+        responses merge in sorted (table, shard) order."""
+        state = {}
+        calls = []
+        for name in ids_by_name:
+            ids = np.asarray(ids_by_name[name], dtype=np.int64)
+            st = {"ids": ids, "out": None, "positions": {}}
+            state[name] = st
+            if ids.size == 0:
+                st["out"] = np.zeros((0, 0), np.float32)
                 continue
-            resp = self._ps[int(shard)].pull_embedding_vector(
-                {"name": name, "ids": ids[positions]}
-            )
+            shard_ids = ids % self.num_ps
+            hit_mask = np.zeros(ids.shape, dtype=bool)
+            if self._cache is not None:
+                uniq, inverse = np.unique(ids, return_inverse=True)
+                uniq_rows = self._cache.get_rows(name, uniq)
+                uniq_hit = np.fromiter(
+                    (r is not None for r in uniq_rows),
+                    dtype=bool,
+                    count=len(uniq_rows),
+                )
+                hit_mask = uniq_hit[inverse]
+                if uniq_hit.any():
+                    hit_rows = np.stack(
+                        [r for r in uniq_rows if r is not None]
+                    ).astype(np.float32, copy=False)
+                    out = np.empty(
+                        (len(ids), hit_rows.shape[1]), np.float32
+                    )
+                    # row index into hit_rows for every hitting unique
+                    uniq_to_hit = np.cumsum(uniq_hit) - 1
+                    out[hit_mask] = hit_rows[
+                        uniq_to_hit[inverse[hit_mask]]
+                    ]
+                    st["out"] = out
+            for shard in np.unique(shard_ids[~hit_mask]):
+                shard = int(shard)
+                positions = np.nonzero(
+                    (shard_ids == shard) & ~hit_mask
+                )[0]
+                st["positions"][shard] = positions
+                req = {"name": name, "ids": ids[positions]}
+                calls.append(
+                    (
+                        (name, shard),
+                        lambda shard=shard, req=req: self._ps[
+                            shard
+                        ].pull_embedding_vector(req),
+                    )
+                )
+        resps = self._run_sharded(calls)
+        for name, shard in sorted(resps):
+            resp = resps[(name, shard)]
+            st = state[name]
+            positions = st["positions"][shard]
             got = np.asarray(resp["rows"], dtype=np.float32)
             if got.shape[0] != len(positions):
                 raise ValueError(
                     "PS shard %d returned %d rows for %d ids of %r"
                     % (shard, got.shape[0], len(positions), name)
                 )
-            if out is None:
-                out = np.empty((len(ids), got.shape[1]), np.float32)
-            out[positions] = got
+            if st["out"] is None:
+                st["out"] = np.empty(
+                    (len(st["ids"]), got.shape[1]), np.float32
+                )
+            st["out"][positions] = got
             if self._cache is not None:
                 version = resp.get("version")
-                self._cache.note_version(int(shard), version)
-                for p, row in zip(positions, got):
-                    self._cache.put(
-                        name, ids[p], int(shard), version, row
-                    )
-        if hit_rows:
-            if out is None:
-                dim = next(iter(hit_rows.values())).shape[0]
-                out = np.empty((len(ids), dim), np.float32)
-            for pos, row in hit_rows.items():
-                out[pos] = row
-        return out
+                self._cache.note_version(shard, version)
+                self._cache.put_rows(
+                    name, st["ids"][positions], shard, version, got
+                )
+        return {name: st["out"] for name, st in state.items()}
+
+
+class PSRpcError(RuntimeError):
+    """A PS data-plane RPC failed terminally (deadline expiry, dead
+    pod past retries). RuntimeError on purpose: the worker's minibatch
+    machinery converts RuntimeError into a failed-task report (the
+    task requeues and the worker lives), whereas a raw grpc.RpcError
+    would propagate out of the task loop and kill the worker process.
+    ``code`` carries the gRPC status for callers that branch on it."""
+
+    def __init__(self, addr, method, cause):
+        super().__init__(
+            "PS %s %s failed: %s" % (addr, method, cause)
+        )
+        self.addr = addr
+        self.method = method
+        self.cause = cause
+        code = getattr(cause, "code", None)
+        self.code = code() if callable(code) else None
 
 
 class BoundPS:
-    """Adapts an rpc.core Client to the dict-method PS interface."""
+    """Adapts an rpc.core Client to the dict-method PS interface.
 
-    def __init__(self, addr):
+    ``deadline_s`` bounds every data-plane RPC (rpc/core.Client), so a
+    dead PS pod fails the call in ~``deadline_s`` seconds instead of
+    hanging a fan-out forever; ``retries``/``backoff_s`` retry
+    UNAVAILABLE transients (a restarting pod) — except on
+    ``push_gradient``, which is NOT idempotent (an async PS applies on
+    receipt; resending after a post-apply connection drop would apply
+    the gradient twice). ``None`` keeps the historical blocking
+    channel. Terminal transport failures surface as :class:`PSRpcError`
+    (a RuntimeError), feeding the worker's minibatch retry loop.
+    """
+
+    def __init__(self, addr, deadline_s=None, retries=0, backoff_s=0.2):
         from elasticdl_tpu.rpc.core import Client
 
-        self._client = Client(addr)
+        self._addr = addr
+        self._client = Client(
+            addr,
+            deadline_s=deadline_s,
+            retries=retries,
+            backoff_s=backoff_s,
+        )
 
     def __getattr__(self, method):
         def call(req):
-            return self._client.call(method, **req)
+            import grpc
+
+            try:
+                return self._client.call(
+                    method,
+                    _retriable=(method != "push_gradient"),
+                    **req
+                )
+            except grpc.RpcError as err:
+                raise PSRpcError(self._addr, method, err) from err
 
         return call

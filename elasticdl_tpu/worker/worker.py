@@ -227,6 +227,45 @@ class Worker:
         tensors.extend(sparse_tensors or ())
         return self._stub.report_gradient(tensors, self._model_version)
 
+    def _drain_ps_pushes(self):
+        """Synchronously settle the async gradient-push window.
+
+        Called at every task boundary, before evaluation, and before
+        checkpoint/export so no gradient is still on the wire when the
+        job observes or persists model state (docs/dense_overlap.md).
+        ``pull_dense`` also drains, so the window never widens the SSP
+        staleness bound beyond what get_model_steps already allows.
+        """
+        if self._ps_client is None or not hasattr(
+            self._ps_client, "drain"
+        ):
+            return
+        try:
+            accepted, _ = self._ps_client.drain()
+        except RuntimeError as err:
+            # a PS failure surfacing HERE (a boundary, not a minibatch)
+            # means an already-reported batch's gradient was lost on
+            # the wire — bounded staleness the async plane tolerates,
+            # same as a stale rejection. The worker must survive: the
+            # NEXT minibatch's pull hits the same dead shard inside
+            # the retry machinery, which converts it to a failed-task
+            # report (drain inside pull_dense takes that path too)
+            logger.warning(
+                "async gradient push window drained with a shard "
+                "failure; the in-flight updates were dropped: %s",
+                err,
+            )
+            return
+        if not accepted:
+            # async-window pushes resolve after the optimistic accept;
+            # a late rejection (stale gradient on a sync-mode PS) only
+            # costs that one update — the next pull resynchronizes —
+            # but must not pass silently
+            logger.warning(
+                "async gradient push window drained with rejected "
+                "shard pushes; the rejected updates were dropped"
+            )
+
     def report_evaluation_metrics(self, model_outputs, labels):
         outputs = {
             name: np.concatenate([np.asarray(v) for v in chunks])
@@ -345,17 +384,28 @@ class Worker:
             expected_count=self._embedding_num_calls,
         )
         rows_by_path, idx_by_path, plan = {}, {}, {}
+        lookups = {}
         for path, ids_list in captured.items():
             # one union pull per layer, however many times it is called:
             # every call slot gathers from the same rows buffer, so row
             # gradients of a tied embedding accumulate across calls
-            unique, idxs, bucket = plan_lookup_multi(
+            lookups[path] = plan_lookup_multi(
                 ids_list, dedup=self._sparse_dedup
             )
-            if self._ps_client is not None:
-                rows = self._ps_client.pull_embedding_vectors(
-                    path_name(path), unique
-                )
+        pulled = None
+        if self._ps_client is not None:
+            # one fan-out round for EVERY layer's rows: the per-layer
+            # serial pull loop would pay one PS round trip per table
+            # (docs/dense_overlap.md)
+            pulled = self._ps_client.pull_embedding_vectors_multi(
+                {
+                    path_name(path): unique
+                    for path, (unique, _, _) in lookups.items()
+                }
+            )
+        for path, (unique, idxs, bucket) in lookups.items():
+            if pulled is not None:
+                rows = pulled[path_name(path)]
             else:
                 rows = self._stub.pull_embedding_vectors(
                     path_name(path), unique
@@ -536,6 +586,7 @@ class Worker:
 
     def _process_eval_task(self, task):
         logger.info("the evaluation task_id: %d" % task.task_id)
+        self._drain_ps_pushes()
         eval_info = self._task_data_service.get_validation_dataset(task)
         if not eval_info:
             return
@@ -570,6 +621,7 @@ class Worker:
         )
         if task is None or dataset is None:
             return
+        self._drain_ps_pushes()
         saved_model_path = task.extended_config.get(
             SaveModelConfig.SAVED_MODEL_PATH
         )
@@ -677,6 +729,9 @@ class Worker:
                     batch_count, err_msg
                 )
             del dataset
+            # task boundary: settle the async push window before the
+            # next round's eval/save-model decisions see model state
+            self._drain_ps_pushes()
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                 evaluation_task_executed = self._evaluate_only()
             self._process_save_model_task_if_needed()
@@ -724,3 +779,4 @@ class Worker:
             self._evaluate_only()
         else:
             self._train_and_evaluate()
+        self._drain_ps_pushes()

@@ -1,0 +1,183 @@
+"""Fault-injection PS stubs for overlap/deadline tests.
+
+Two layers match the two ways tests drive the PS data plane:
+
+- :class:`FaultyPS` wraps any in-process PS-interface object (a real
+  ``PserverServicer`` or a synthetic stub) and injects per-call delay,
+  one-shot mid-call kills, and forced rejections — the knobs the
+  fan-out determinism / async-drain regression tests need.
+- :func:`serve_slow_ps` stands up a REAL loopback gRPC server
+  (rpc/core.serve) whose handlers sleep, for exercising the
+  deadline/retry path end to end through grpc's own status codes.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+
+class ShardKilledError(RuntimeError):
+    """Raised by a FaultyPS whose kill switch is set (simulates the
+    transport error a dead pod surfaces once deadlines are bounded)."""
+
+
+class FaultyPS:
+    """In-process PS stub wrapper with injectable faults.
+
+    ``delay_s``: sleep before forwarding every call (per-method filter
+    via ``delay_methods``). ``kill_after``: forward that many calls,
+    then raise :class:`ShardKilledError` on every later one — "dies
+    mid-job". ``reject_pushes``: force ``push_gradient`` responses to
+    ``accepted=False`` while still forwarding (models a stale-gradient
+    rejection on one shard only). A thread-safe call log records
+    ``(method, thread_name, t_start, t_end)`` for concurrency asserts.
+    """
+
+    def __init__(
+        self,
+        inner,
+        delay_s=0.0,
+        delay_methods=None,
+        kill_after=None,
+        reject_pushes=False,
+    ):
+        self._inner = inner
+        self.delay_s = delay_s
+        self.delay_methods = set(delay_methods or ())
+        self.kill_after = kill_after
+        self.reject_pushes = reject_pushes
+        self.calls = []
+        self._mu = threading.Lock()
+        self._n_calls = 0
+
+    def max_concurrency(self):
+        """Largest number of overlapping logged calls."""
+        events = []
+        with self._mu:
+            spans = [(c[2], c[3]) for c in self.calls]
+        for start, end in spans:
+            events.append((start, 1))
+            events.append((end, -1))
+        live = peak = 0
+        for _, step in sorted(events):
+            live += step
+            peak = max(peak, live)
+        return peak
+
+    def _forward(self, method, req):
+        with self._mu:
+            self._n_calls += 1
+            n = self._n_calls
+        if self.kill_after is not None and n > self.kill_after:
+            raise ShardKilledError(
+                "injected shard death (call %d > kill_after %d)"
+                % (n, self.kill_after)
+            )
+        t0 = time.monotonic()
+        if self.delay_s and (
+            not self.delay_methods or method in self.delay_methods
+        ):
+            time.sleep(self.delay_s)
+        resp = getattr(self._inner, method)(req)
+        if method == "push_gradient" and self.reject_pushes:
+            resp = dict(resp)
+            resp["accepted"] = False
+        with self._mu:
+            self.calls.append(
+                (method, threading.current_thread().name, t0, time.monotonic())
+            )
+        return resp
+
+    def __getattr__(self, method):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(req):
+            return self._forward(method, req)
+
+        return call
+
+
+class TablePS:
+    """Minimal synthetic shard: versioned lookup table, no optimizer.
+
+    Rows for id ``i`` are ``i + 1000 * version`` so tests can tell
+    exactly which version a row came from; ``push_gradient`` bumps the
+    version and returns the standard accepted/version response.
+    """
+
+    def __init__(self, dim=4):
+        self.dim = dim
+        self.version = 0
+        self.pulls = 0
+        self.pushes = 0
+
+    def pull_variable(self, req):
+        return {
+            "model_init_status": True,
+            "version": self.version,
+            "params": [],
+        }
+
+    def pull_embedding_vector(self, req):
+        self.pulls += 1
+        ids = np.asarray(req["ids"], np.int64)
+        rows = (
+            ids[:, None].astype(np.float32)
+            + 1000.0 * self.version
+            + np.zeros((1, self.dim), np.float32)
+        )
+        return {"rows": rows, "version": self.version}
+
+    def push_gradient(self, req):
+        self.pushes += 1
+        self.version += 1
+        return {"accepted": True, "version": self.version}
+
+    def push_model(self, req):
+        return {}
+
+    def push_embedding_info(self, req):
+        return {}
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def serve_slow_ps(delay_s, dim=4, port=0):
+    """Real loopback gRPC PS whose every handler sleeps ``delay_s``.
+
+    Returns ``(server, addr)``; stop with ``server.stop(None)``. Built
+    on rpc/core.serve so deadline expiry and UNAVAILABLE surface as the
+    genuine grpc.RpcError codes the client-side bounding must handle.
+    """
+    from elasticdl_tpu.rpc.core import serve
+
+    table = TablePS(dim=dim)
+
+    def slow(fn):
+        def handler(req):
+            time.sleep(delay_s)
+            return fn(req)
+
+        return handler
+
+    methods = {
+        name: slow(getattr(table, name))
+        for name in (
+            "pull_variable",
+            "pull_embedding_vector",
+            "push_gradient",
+            "push_model",
+            "push_embedding_info",
+        )
+    }
+    server = serve(methods, port)
+    return server, "localhost:%d" % server._edl_port

@@ -1,0 +1,73 @@
+"""Tier-1 wiring for scripts/greps_guard.py — the source-pattern guard
+over the two wedge classes VERDICT r5 root-caused (unescapable
+jax.devices() probes; unbounded blocking queue puts)."""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+GUARD = os.path.join(ROOT, "scripts", "greps_guard.py")
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, GUARD],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, (
+        "wedge-pattern guard tripped:\n" + proc.stdout + proc.stderr
+    )
+
+
+def test_guard_detects_both_wedge_classes(tmp_path):
+    pkg = tmp_path / "elasticdl_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n"
+        "import queue\n"
+        "def probe():\n"
+        "    return jax.devices()\n"  # rule 1
+        "def feed(q, item):\n"
+        "    q.put(item)\n"  # rule 2
+    )
+    proc = subprocess.run(
+        [sys.executable, GUARD, "--root", str(tmp_path)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "jax.devices() outside escapable_call" in proc.stdout
+    assert "queue put without timeout+cancel" in proc.stdout
+
+
+def test_guard_accepts_safe_patterns(tmp_path):
+    pkg = tmp_path / "elasticdl_tpu"
+    pkg.mkdir()
+    (pkg / "good.py").write_text(
+        "from elasticdl_tpu.common.escapable import escapable_call\n"
+        "import jax\n"
+        "def probe():\n"
+        "    return escapable_call(jax.devices, timeout=30)\n"
+        "def feed(q, item, cancel):\n"
+        "    while not cancel.is_set():\n"
+        "        try:\n"
+        "            q.put(item, timeout=0.5)\n"
+        "            return True\n"
+        "        except Exception:\n"
+        "            continue\n"
+        "    return False\n"
+        "def cache_fill(cache, k, v):\n"
+        "    cache.put(k, v)\n"  # not a queue: exempt by receiver name
+    )
+    proc = subprocess.run(
+        [sys.executable, GUARD, "--root", str(tmp_path)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,234 @@
+"""rpc/core deadline + retry/backoff coverage, over real loopback gRPC
+(tests/fake_ps.serve_slow_ps) and injected fake stubs.
+
+Pins the split the overlap design relies on: the PS DATA plane is
+deadline-bounded (a dead pod fails a call in ~``--rpc_deadline_s``, no
+indefinite hang), while CONTROL-plane master RPCs keep their historical
+block-forever channel (a worker parked on ``get_task`` must wait).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.rpc.core import Client
+from elasticdl_tpu.worker.ps_client import BoundPS, PSClient, PSRpcError
+from tests.fake_ps import free_port, serve_slow_ps
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture
+def slow_ps():
+    server, addr = serve_slow_ps(delay_s=5.0)
+    yield addr
+    server.stop(None)
+
+
+def test_deadline_expires_within_bound(slow_ps):
+    """A hung handler fails with DEADLINE_EXCEEDED in ~deadline_s,
+    surfaced as PSRpcError — a RuntimeError, so the worker's minibatch
+    machinery reports a failed task instead of dying."""
+    ps = BoundPS(slow_ps, deadline_s=0.5)
+    t0 = time.monotonic()
+    with pytest.raises(PSRpcError) as err:
+        ps.pull_variable({})
+    elapsed = time.monotonic() - t0
+    assert isinstance(err.value, RuntimeError)
+    assert err.value.code == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert elapsed < 3.0, "deadline not honored: %.1fs" % elapsed
+
+
+def test_deadline_expiry_is_not_retried(slow_ps):
+    """retries only cover UNAVAILABLE: with retries=3 a deadline expiry
+    still surfaces in ~one deadline, not deadline * 4."""
+    ps = BoundPS(slow_ps, deadline_s=0.5, retries=3)
+    t0 = time.monotonic()
+    with pytest.raises(PSRpcError):
+        ps.pull_variable({})
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_no_deadline_keeps_blocking_semantics(slow_ps):
+    """deadline_s=None (the control-plane default) waits the handler
+    out — the historical behavior, preserved."""
+    ps = BoundPS(slow_ps)  # no deadline
+    t0 = time.monotonic()
+    resp = ps.pull_variable({})
+    assert resp["model_init_status"]
+    assert time.monotonic() - t0 >= 4.5
+
+
+def test_dead_shard_fails_fanout_within_deadline():
+    """One live shard + one shard killed mid-job: the next fan-out call
+    errors within the deadline envelope instead of hanging."""
+    live_server, live_addr = serve_slow_ps(delay_s=0.0)
+    dead_server, dead_addr = serve_slow_ps(delay_s=0.0)
+    try:
+        client = PSClient(
+            [
+                BoundPS(a, deadline_s=1.0, retries=1, backoff_s=0.05)
+                for a in (live_addr, dead_addr)
+            ],
+            fanout=True,
+        )
+        rows = client.pull_embedding_vectors("emb", np.arange(4))
+        assert rows.shape == (4, 4)
+        dead_server.stop(None)  # shard 1 dies
+        t0 = time.monotonic()
+        with pytest.raises(PSRpcError):
+            client.pull_embedding_vectors("emb", np.arange(4))
+        # UNAVAILABLE fails fast; the bound is deadline + one backoff
+        assert time.monotonic() - t0 < 3.0
+        client.close()
+    finally:
+        live_server.stop(None)
+
+
+def test_async_push_surfaces_dead_shard_on_drain():
+    """A shard killed while a double-buffered push is in flight raises
+    at the drain (the worker's reconcile point), within the deadline."""
+    server0, addr0 = serve_slow_ps(delay_s=0.0)
+    server1, addr1 = serve_slow_ps(delay_s=0.0)
+    try:
+        client = PSClient(
+            [
+                BoundPS(a, deadline_s=1.0, retries=0)
+                for a in (addr0, addr1)
+            ],
+            fanout=True,
+            push_inflight=1,
+        )
+        grads = {"w": np.ones((2,), np.float32)}
+        accepted, _ = client.push_gradient(grads, [], 0)
+        assert accepted
+        client.drain()
+        server1.stop(None)  # dies before the next push's wire time
+        client.push_gradient(grads, [], 1)  # optimistic non-blocking
+        t0 = time.monotonic()
+        with pytest.raises(PSRpcError):
+            client.drain()
+        assert time.monotonic() - t0 < 3.0
+        client.close()
+    finally:
+        server0.stop(None)
+
+
+class _FakeUnavailable(grpc.RpcError):
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+
+def test_unavailable_retries_with_doubling_backoff():
+    """UNAVAILABLE retries `retries` times with doubling backoff, then
+    surfaces; sleeps are injectable so this is timing-free."""
+    client = Client(
+        "localhost:%d" % free_port(), retries=2, backoff_s=0.1
+    )
+    sleeps = []
+    client._sleep = sleeps.append
+    calls = []
+
+    def stub(request, timeout=None):
+        calls.append(timeout)
+        raise _FakeUnavailable()
+
+    client._stubs["pull_variable"] = stub
+    with pytest.raises(grpc.RpcError):
+        client.call("pull_variable")
+    assert len(calls) == 3  # initial + 2 retries
+    assert sleeps == [0.1, 0.2]
+
+
+def test_push_gradient_is_never_retried():
+    """push_gradient is non-idempotent (an async PS applies on
+    receipt): a post-apply connection drop must surface, not resend —
+    resending would apply the same gradient twice."""
+    from elasticdl_tpu.rpc.core import pack_message
+
+    ps = BoundPS(
+        "localhost:%d" % free_port(), retries=3, backoff_s=0.0
+    )
+    ps._client._sleep = lambda s: None
+    pushes, pulls = [], []
+
+    def push_stub(request, timeout=None):
+        pushes.append(timeout)
+        raise _FakeUnavailable()
+
+    def pull_stub(request, timeout=None):
+        pulls.append(timeout)
+        if len(pulls) < 2:
+            raise _FakeUnavailable()
+        return pack_message({"ok": True})
+
+    ps._client._stubs["push_gradient"] = push_stub
+    ps._client._stubs["pull_variable"] = pull_stub
+    with pytest.raises(PSRpcError):
+        ps.push_gradient({"model_version": 0})
+    assert len(pushes) == 1  # no resend of a maybe-applied gradient
+    assert ps.pull_variable({})["ok"] is True
+    assert len(pulls) == 2  # idempotent pulls still retry
+
+
+def test_unavailable_retry_recovers():
+    """A transient UNAVAILABLE (restarting pod) succeeds on retry."""
+    from elasticdl_tpu.rpc.core import pack_message
+
+    client = Client("localhost:%d" % free_port(), retries=2, backoff_s=0.0)
+    client._sleep = lambda s: None
+    attempts = []
+
+    def stub(request, timeout=None):
+        attempts.append(timeout)
+        if len(attempts) < 3:
+            raise _FakeUnavailable()
+        return pack_message({"ok": True})
+
+    client._stubs["push_gradient"] = stub
+    assert client.call("push_gradient")["ok"] is True
+    assert len(attempts) == 3
+
+
+def test_deadline_passed_to_stub():
+    client = Client("localhost:%d" % free_port(), deadline_s=7.5)
+    seen = []
+
+    def stub(request, timeout=None):
+        from elasticdl_tpu.rpc.core import pack_message
+
+        seen.append(timeout)
+        return pack_message({})
+
+    client._stubs["m"] = stub
+    client.call("m")
+    assert seen == [7.5]
+    # deadline_s=0 means "disabled", i.e. block forever
+    assert Client("localhost:1", deadline_s=0)._deadline_s is None
+
+
+def test_master_control_plane_stays_blocking():
+    """MasterClient must NOT pick up data-plane deadlines: get_task
+    parks legitimately while the master is busy/forming."""
+    from elasticdl_tpu.master.rpc_service import MasterClient
+
+    mc = MasterClient("localhost:%d" % free_port())
+    assert mc._client._deadline_s is None
+    assert mc._client._retries == 0
+    # while the PS data-plane default wiring DOES bound its calls
+    from elasticdl_tpu.common.args import parse_worker_args
+
+    args = parse_worker_args(
+        [
+            "--worker_id", "0",
+            "--job_type", "training",
+            "--minibatch_size", "1",
+            "--model_zoo", "z",
+            "--model_def", "m.m.f",
+        ]
+    )
+    assert args.rpc_deadline_s == 60.0
+    assert args.rpc_retries == 2
+    assert args.ps_fanout is True
+    assert args.ps_push_inflight == 0
