@@ -40,6 +40,12 @@ Additional modes (BASELINE.md "measured baselines" rows):
   framework's reader + Dataset shim (decode, map, shuffle, batch,
   prefetch) — what a worker actually runs, so input-pipeline regressions
   show up here.
+- ``--input``: serial vs pipelined worker input plane (task prefetch +
+  parallel ordered decode + vectorized batch assembly + queued acks)
+  through the REAL task data service, under injected ``get_task`` RTT
+  and per-record read latency, with an identical-stream equivalence
+  pre-pass (docs/input_pipeline.md). CPU-only; part of the default
+  suite.
 - ``--preemption``: runs the local elastic allreduce job (3 worker OS
   processes over gloo CPU collectives), kills one mid-job, and reports
   wall-clock vs the undisturbed run — the BASELINE.md "job wall-clock
@@ -1229,6 +1235,186 @@ def _bench_ps_fanout_microbench(quick=False):
     }
 
 
+def bench_input(quick=False):
+    """Serial vs pipelined worker input plane under injected latency.
+
+    Both arms run the REAL task data service + Dataset shim end to end:
+    a fake master whose ``get_task`` pays an injected RTT (the
+    cross-pod dispatch latency a loopback bench hides), a reader whose
+    every record pays an injected read latency, a CPU parse fn, batch
+    assembly, host prefetch. The serial arm is the pre-pipeline shape —
+    no task prefetch, serial map, per-element ``_tree_stack`` batching,
+    synchronous per-task acks. The pipelined arm turns on
+    ``task_prefetch``, ``map(num_parallel_calls)``, vectorized batch
+    assembly, and the boundary-drained ack queue
+    (docs/input_pipeline.md). An equivalence pass first pins that both
+    arms yield IDENTICAL batch contents in IDENTICAL order for a fixed
+    seed.
+    """
+    import threading
+
+    from elasticdl_tpu.data.data_reader import AbstractDataReader, Metadata
+    from elasticdl_tpu.data.input_stats import InputPlaneStats
+    from elasticdl_tpu.master.servicer import TaskResponse
+    from elasticdl_tpu.common.constants import TaskType
+    from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+    # quick still needs enough work for the overlap to beat the thread
+    # overhead on small hosts — undersized arms would report the
+    # pipelined plane as a regression that the full run disproves
+    n_tasks = 8 if quick else 12
+    records_per_task = 48 if quick else 64
+    rtt_s = 0.020  # injected get_task RTT
+    read_lat_s = 0.0003  # injected per-record cold-read latency
+    ack_lat_s = 0.010  # report_task_result shares the master RTT
+    record_dim = 256
+    batch_size = 16
+
+    class _Stub:
+        """Fake master: fixed task list, injected RTT, doing-set ledger."""
+
+        def __init__(self, sleep=True):
+            self._lock = threading.Lock()
+            self._todo = [
+                TaskResponse(
+                    shard_name="shard_%d" % i,
+                    start=0,
+                    end=records_per_task,
+                    type=TaskType.TRAINING,
+                    model_version=0,
+                )
+                for i in range(n_tasks)
+            ]
+            self._next_id = 0
+            self.doing = {}
+            self.reports = []
+            self._sleep = sleep
+
+        def get_task(self, task_type=None):
+            if self._sleep:
+                time.sleep(rtt_s)
+            with self._lock:
+                if not self._todo:
+                    return TaskResponse()  # empty shard: stream ends
+                task = self._todo.pop(0)
+                self._next_id += 1
+                task.task_id = self._next_id
+                self.doing[self._next_id] = task
+                return task
+
+        def report_task_result(self, task_id, err_msg="", exec_counters=None):
+            if self._sleep:
+                time.sleep(ack_lat_s)
+            with self._lock:
+                self.doing.pop(task_id, None)
+                self.reports.append((task_id, err_msg))
+
+    class _Reader(AbstractDataReader):
+        """Deterministic synthetic records with injected read latency."""
+
+        def __init__(self, sleep=True):
+            self._sleep = sleep
+
+        def read_records(self, task):
+            shard = int(task.shard_name.split("_")[1])
+            for i in range(task.start, task.end):
+                if self._sleep:
+                    time.sleep(read_lat_s)
+                yield (
+                    np.int64(shard * records_per_task + i)
+                    .tobytes()
+                    .ljust(8, b"\0")
+                )
+
+        def create_shards(self):
+            return {}
+
+        @property
+        def metadata(self):
+            return Metadata()
+
+    def parse(record):
+        # a deliberately CPU-shaped decode: seed -> deterministic batch row
+        seed = int(np.frombuffer(record[:8], np.int64)[0])
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal(record_dim).astype(np.float32)
+        x = np.tanh(x) * np.float32(seed % 7 + 1)
+        return {"x": x, "y": np.int64(seed)}
+
+    def run_arm(pipelined, sleep=True, stats=None):
+        stub = _Stub(sleep=sleep)
+        tds = TaskDataService(
+            stub,
+            False,
+            data_reader=_Reader(sleep=sleep),
+            task_prefetch=2 if pipelined else 0,
+            ack_queue_size=8 if pipelined else 0,
+            # warm whole tasks: read-ahead of task N+1 overlaps the
+            # consumption of task N (memory-bounded by task_prefetch)
+            prefetch_warm_records=records_per_task,
+            stats=stats,
+        )
+        batches = []
+        t0 = time.perf_counter()
+        while True:
+            ds = tds.get_dataset()
+            if ds is None:
+                break
+            ds = ds.map(
+                parse, num_parallel_calls=4 if pipelined else None
+            ).batch(batch_size, vectorized=pipelined).prefetch(2)
+            for b in ds:
+                batches.append(b)
+                # the worker's per-batch completion accounting: this is
+                # what triggers (sync or queued) task acks
+                tds.report_record_done(int(b["y"].shape[0]))
+            tds.drain_acks()
+        wall = time.perf_counter() - t0
+        assert not stub.doing, "doing-set leak: %r" % stub.doing
+        return batches, wall, stub
+
+    # equivalence pass (no injected latency: it is a correctness check)
+    serial_b, _, _ = run_arm(pipelined=False, sleep=False)
+    pipe_b, _, _ = run_arm(pipelined=True, sleep=False)
+    assert len(serial_b) == len(pipe_b), (len(serial_b), len(pipe_b))
+    for sb, pb in zip(serial_b, pipe_b):
+        np.testing.assert_array_equal(sb["x"], pb["x"])
+        np.testing.assert_array_equal(sb["y"], pb["y"])
+
+    n_examples = n_tasks * records_per_task
+
+    def timed_arm(pipelined):
+        stats = InputPlaneStats()
+        batches, wall, _ = run_arm(pipelined=pipelined, stats=stats)
+        got = sum(int(b["y"].shape[0]) for b in batches)
+        assert got == n_examples, (got, n_examples)
+        return n_examples / wall, stats.snapshot()
+
+    serial_eps, serial_stats = timed_arm(False)
+    pipe_eps, pipe_stats = timed_arm(True)
+    for tag, s in (("serial", serial_stats), ("pipelined", pipe_stats)):
+        print(
+            "[input/%s] starved=%.0fms read=%.0fms parse=%.0fms "
+            "batch=%.0fms consumer_starved=%.0fms ack=%.0fms"
+            % (
+                tag,
+                s["task_starved_s"] * 1e3,
+                s["read_s"] * 1e3,
+                s["parse_s"] * 1e3,
+                s["batch_s"] * 1e3,
+                s["consumer_starved_s"] * 1e3,
+                s["ack_s"] * 1e3,
+            ),
+            file=sys.stderr,
+        )
+    return {
+        "serial": serial_eps,
+        "pipelined": pipe_eps,
+        "rtt_ms": rtt_s * 1e3,
+        "read_lat_us": read_lat_s * 1e6,
+    }
+
+
 def bench_resnet(quick=False, profile_dir=None):
     """Fused jitted ResNet-50 train step (fwd+bwd+SGD, bf16 MXU compute)
     with on-device synthetic data: the compute-path ceiling the input
@@ -1441,6 +1627,29 @@ def main(argv=None):
                 res["fanout_slowest_shard_s"] * 1e3,
                 res["fanout_serial_call_s"] * 1e3,
                 res["fanout_shard_sum_s"] * 1e3,
+            ),
+            update,
+        )
+        return 0
+
+    if "--input" in argv:
+        res = bench_input(quick)
+        _emit(
+            "input_examples_per_sec_pipelined"
+            + ("_quick" if quick else ""),
+            round(res["pipelined"], 1),
+            "examples/sec through the pipelined worker input plane "
+            "(task_prefetch=2, map x4 ordered decode, vectorized batch, "
+            "queued acks) vs %.1f ex/s through the serial plane "
+            "(pipelined %.2fx; both arms on the real task data service "
+            "with %.0f ms injected get_task RTT and %.0f us injected "
+            "per-record read latency; equivalence pre-pass: identical "
+            "batches, identical order)"
+            % (
+                res["serial"],
+                res["pipelined"] / max(res["serial"], 1e-9),
+                res["rtt_ms"],
+                res["read_lat_us"],
             ),
             update,
         )
@@ -1679,6 +1888,7 @@ def main(argv=None):
     # CPU-only sections first: they need no accelerator and must never
     # starve behind a wedged one
     section("elastic_preemption_ratio", ["--preemption-ratio"], 1200)
+    section("input_examples_per_sec_pipelined", ["--input"], 600)
     section("ps_deepfm_examples_per_sec", ["--ps"], 1200)
     # device sections, cheapest diagnosis first
     section(

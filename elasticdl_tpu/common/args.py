@@ -388,6 +388,35 @@ def add_common_args_between_master_and_worker(parser):
         "RPCs — the shape a restarting PS pod presents; deadline "
         "expiry is never retried at this layer",
     )
+    parser.add_argument(
+        "--task_prefetch",
+        type=non_neg_int,
+        default=1,
+        help="Keep this many shard tasks fetched ahead of the one being "
+        "consumed: a background fetcher overlaps the master get_task "
+        "round trip and the cold first-record read with training on "
+        "the current task (docs/input_pipeline.md). 0 restores the "
+        "serial fetch-then-read loop",
+    )
+    parser.add_argument(
+        "--task_ack_queue",
+        type=non_neg_int,
+        default=8,
+        help="Queue up to this many completed-task acknowledgments "
+        "instead of reporting each on the training hot loop; the queue "
+        "drains at every task/eval/checkpoint boundary (and inline on "
+        "overflow). Failure acks always flush immediately. 0 restores "
+        "synchronous per-task acks",
+    )
+    parser.add_argument(
+        "--loss_log_steps",
+        type=non_neg_int,
+        default=20,
+        help="Log the training loss every this many accepted "
+        "minibatches; each log costs a device->host sync, so the "
+        "per-step logging of the reference is off the hot path. 0 "
+        "disables loss logging",
+    )
 
 
 def parse_master_args(master_args=None):

@@ -9,6 +9,7 @@ SDK exactly like the reference is.
 """
 
 import os
+import threading
 from abc import ABC, abstractmethod
 
 from elasticdl_tpu.common.constants import ODPSConfig
@@ -55,12 +56,17 @@ class RecordIODataReader(AbstractDataReader):
         _check_required_kwargs(["data_dir"], kwargs)
         self._kwargs = kwargs
         self._readers = {}
+        # read_records runs concurrently (task-prefetch warm pool +
+        # consumer); an unsynchronized check-then-insert would build
+        # duplicate readers and leak the loser's mmap/fd
+        self._readers_lock = threading.Lock()
 
     def _reader(self, path):
-        if path not in self._readers:
-            # C++ mmap reader when built; Python fallback otherwise
-            self._readers[path] = open_recordio(path)
-        return self._readers[path]
+        with self._readers_lock:
+            if path not in self._readers:
+                # C++ mmap reader when built; Python fallback otherwise
+                self._readers[path] = open_recordio(path)
+            return self._readers[path]
 
     def read_records(self, task):
         yield from self._reader(task.shard_name).read_range(
@@ -93,20 +99,31 @@ class ODPSDataReader(AbstractDataReader):
         super().__init__(**kwargs)
         self._kwargs = kwargs
         self._metadata = Metadata()
+        # per-table reader cache: read_records used to construct a fresh
+        # ODPSReader (table handshake and all) per TASK — the
+        # RecordIODataReader._readers discipline, applied here. Locked:
+        # concurrent warm reads must not race duplicate handshakes.
+        self._readers = {}
+        self._readers_lock = threading.Lock()
 
     def _get_reader(self, table_name):
-        _check_required_kwargs(
-            ["project", "access_id", "access_key"], self._kwargs
-        )
-        from elasticdl_tpu.data.odps_io import ODPSReader
+        with self._readers_lock:
+            if table_name in self._readers:
+                return self._readers[table_name]
+            _check_required_kwargs(
+                ["project", "access_id", "access_key"], self._kwargs
+            )
+            from elasticdl_tpu.data.odps_io import ODPSReader
 
-        return ODPSReader(
-            project=self._kwargs["project"],
-            access_id=self._kwargs["access_id"],
-            access_key=self._kwargs["access_key"],
-            table=table_name,
-            endpoint=self._kwargs.get("endpoint"),
-        )
+            reader = ODPSReader(
+                project=self._kwargs["project"],
+                access_id=self._kwargs["access_id"],
+                access_key=self._kwargs["access_key"],
+                table=table_name,
+                endpoint=self._kwargs.get("endpoint"),
+            )
+            self._readers[table_name] = reader
+            return reader
 
     @staticmethod
     def _table_of(shard_name):
@@ -114,11 +131,14 @@ class ODPSDataReader(AbstractDataReader):
 
     def read_records(self, task):
         reader = self._get_reader(self._table_of(task.shard_name))
-        if self._metadata.column_names is None:
-            columns = self._kwargs.get("columns")
-            self._metadata.column_names = (
-                reader.table_schema_names() if columns is None else columns
-            )
+        with self._readers_lock:
+            if self._metadata.column_names is None:
+                columns = self._kwargs.get("columns")
+                self._metadata.column_names = (
+                    reader.table_schema_names()
+                    if columns is None
+                    else columns
+                )
         yield from reader.read_batch(
             start=task.start,
             end=task.end,
@@ -144,6 +164,13 @@ class ODPSDataReader(AbstractDataReader):
     @property
     def metadata(self):
         return self._metadata
+
+    def close(self):
+        for reader in self._readers.values():
+            close = getattr(reader, "close", None)
+            if close is not None:
+                close()
+        self._readers.clear()
 
 
 def create_data_reader(data_origin, records_per_task=None, **kwargs):

@@ -12,18 +12,34 @@ Elements are arbitrary pytrees (dicts/tuples) of np.ndarray-compatible
 leaves; ``batch`` stacks leaf-wise. ``prefetch`` runs the upstream pipeline
 in a daemon thread so host input overlaps TPU steps (the tf.data
 ``prefetch(1)`` role in reference worker.py:779).
+
+Pipelined stages (docs/input_pipeline.md): ``map(fn, num_parallel_calls=N)``
+decodes on a thread pool with a deterministic in-order merge and the same
+cooperative-cancel discipline ``prefetch`` uses; ``batch`` assembles each
+batch into preallocated per-leaf buffers filled in place (no per-element
+``np.stack`` recursion). A Dataset can carry an
+``input_stats.InputPlaneStats`` object; every transform propagates it and
+charges its stage counter, so one object instruments a whole pipeline.
 """
 
 import collections
+import concurrent.futures
 import queue
 import random as _random
 import threading
+import time
 
 import numpy as np
 
 
 def _tree_stack(elements):
-    """Stack a list of same-structure elements leaf-wise."""
+    """Stack a list of same-structure elements leaf-wise.
+
+    Legacy per-element recursive assembly. Kept as the fallback for leaf
+    types the preallocated fast path cannot host (bytes/str/object
+    leaves, where a common dtype must be computed across the whole
+    batch) and as the reference arm for equivalence tests/benches.
+    """
     first = elements[0]
     if isinstance(first, dict):
         return {
@@ -37,28 +53,166 @@ def _tree_stack(elements):
     return np.stack([np.asarray(e) for e in elements])
 
 
+class _NoFastPath(Exception):
+    """A leaf the vectorized batch assembly must not host."""
+
+
+def _batch_buffers(first, n):
+    """Same-structure tree of preallocated (n, *leaf.shape) buffers."""
+    if isinstance(first, dict):
+        return {k: _batch_buffers(v, n) for k, v in first.items()}
+    if isinstance(first, (tuple, list)):
+        bufs = [_batch_buffers(v, n) for v in first]
+        return tuple(bufs) if isinstance(first, tuple) else bufs
+    leaf = np.asarray(first)
+    if leaf.dtype == object or leaf.dtype.kind in "USV":
+        # strings/bytes/object need a common dtype computed across the
+        # whole batch — np.stack's job, not a fixed-width buffer's
+        raise _NoFastPath
+    return np.empty((n,) + leaf.shape, leaf.dtype)
+
+
+def _batch_fill(buf, element, i):
+    """Write ``element``'s leaves into row ``i`` of the buffers in place."""
+    if isinstance(buf, dict):
+        for k in buf:
+            _batch_fill(buf[k], element[k], i)
+    elif isinstance(buf, (tuple, list)):
+        for b, e in zip(buf, element):
+            _batch_fill(b, e, i)
+    else:
+        leaf = np.asarray(element)  # no copy when already an ndarray
+        if leaf.dtype != buf.dtype or leaf.shape != buf.shape[1:]:
+            # a leaf whose dtype/shape differs from element 0's: raw
+            # assignment would silently cast (int buffer truncating a
+            # float leaf) or broadcast where np.stack would promote or
+            # raise — only the legacy path has the right semantics
+            raise _NoFastPath
+        buf[i] = leaf
+
+
+def _tree_assemble(elements):
+    """Vectorized batch assembly: one preallocated buffer per leaf,
+    filled row by row — no per-element ``np.stack`` recursion and no
+    intermediate per-leaf element lists. Falls back to ``_tree_stack``
+    for leaf types the fixed-width buffers cannot host (bytes/str/
+    object) and for batches whose leaf dtypes/shapes vary across
+    elements (np.stack's promotion semantics apply there)."""
+    try:
+        buffers = _batch_buffers(elements[0], len(elements))
+        for i, e in enumerate(elements):
+            _batch_fill(buffers, e, i)
+    except _NoFastPath:
+        return _tree_stack(elements)
+    return buffers
+
+
 class Dataset:
     """Lazily-evaluated record stream; each transform returns a new Dataset."""
 
-    def __init__(self, gen_factory):
+    def __init__(self, gen_factory, stats=None):
         self._gen_factory = gen_factory
+        # optional InputPlaneStats; inherited by every derived Dataset so
+        # one object instruments the whole pipeline (map charges parse_s,
+        # batch charges batch_s, prefetch charges consumer_starved_s)
+        self._stats = stats
 
     @staticmethod
-    def from_generator(gen_factory):
+    def from_generator(gen_factory, stats=None):
         """gen_factory: zero-arg callable returning a fresh iterator."""
-        return Dataset(gen_factory)
+        return Dataset(gen_factory, stats=stats)
 
     @staticmethod
     def from_tensors(elements):
         elements = list(elements)
         return Dataset(lambda: iter(elements))
 
-    def map(self, fn):
-        def gen():
-            for x in self._gen_factory():
-                yield fn(x)
+    def map(self, fn, num_parallel_calls=None):
+        """Apply ``fn`` per element; with ``num_parallel_calls`` > 1 run it
+        on a thread pool with a DETERMINISTIC IN-ORDER merge.
 
-        return Dataset(gen)
+        Parallel semantics match the serial path exactly: elements come
+        out in input order, and an exception raised by ``fn`` on element
+        i surfaces to the consumer after element i-1, however the pool
+        interleaved the calls. The pool is cooperatively cancelled when
+        the consumer generator is closed/abandoned (same discipline as
+        ``prefetch``): no new elements are pulled from the source and
+        unconsumed futures are cancelled.
+        """
+        stats = self._stats
+        # parse timing accumulates in generator locals and hits the
+        # (locked) stats object once at the end, not per record — the
+        # same discipline task_data_service._yield_records uses; with a
+        # decode pool, per-record stats.add would make N threads
+        # contend on one lock at exactly the stage being parallelized.
+        if not num_parallel_calls or num_parallel_calls <= 1:
+
+            def gen():
+                if stats is None:
+                    for x in self._gen_factory():
+                        yield fn(x)
+                    return
+                parse_s = 0.0
+                perf = time.perf_counter
+                try:
+                    for x in self._gen_factory():
+                        t0 = perf()
+                        out = fn(x)
+                        parse_s += perf() - t0
+                        yield out
+                finally:
+                    stats.add("parse_s", parse_s)
+
+            return Dataset(gen, stats=stats)
+
+        window = 2 * num_parallel_calls
+
+        if stats is None:
+            apply = fn
+        else:
+
+            def apply(x):
+                # duration rides back with the result; the merge loop
+                # accumulates it lock-free
+                t0 = time.perf_counter()
+                out = fn(x)
+                return time.perf_counter() - t0, out
+
+        def gen():
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=num_parallel_calls,
+                thread_name_prefix="edl-map",
+            )
+            pending = collections.deque()
+            parse_s = 0.0
+
+            def resolve(future):
+                # .result() re-raises fn's exception at the failing
+                # element's ordinal position
+                if stats is None:
+                    return future.result()
+                nonlocal parse_s
+                dt, out = future.result()
+                parse_s += dt
+                return out
+
+            try:
+                for x in self._gen_factory():
+                    pending.append(pool.submit(apply, x))
+                    if len(pending) >= window:
+                        yield resolve(pending.popleft())
+                while pending:
+                    yield resolve(pending.popleft())
+            finally:
+                # normal exhaustion, an fn error, or an abandoned
+                # consumer: stop pulling from the source (the loop above
+                # is consumer-driven, so exiting it IS the stop), drop
+                # not-yet-started work, don't block on in-flight calls
+                pool.shutdown(wait=False, cancel_futures=True)
+                if stats is not None:
+                    stats.add("parse_s", parse_s)
+
+        return Dataset(gen, stats=stats)
 
     def filter(self, pred):
         def gen():
@@ -66,13 +220,29 @@ class Dataset:
                 if pred(x):
                     yield x
 
-        return Dataset(gen)
+        return Dataset(gen, stats=self._stats)
 
-    def shuffle(self, buffer_size, seed=None):
-        """Streaming buffer shuffle with tf.data semantics."""
+    def shuffle(self, buffer_size, seed=None, reshuffle_each_iteration=True):
+        """Streaming buffer shuffle with tf.data semantics.
+
+        Like tf.data, each iteration reshuffles by default: a seeded
+        dataset is deterministic WITHIN one iteration, but a ``repeat``
+        re-iteration draws a different order (epoch 2 must not replay
+        epoch 1's order). ``reshuffle_each_iteration=False`` restores
+        the identical-replay behavior.
+        """
+        iteration = collections.deque((0,))  # mutable epoch counter
 
         def gen():
-            rng = _random.Random(seed)
+            epoch = iteration[0]
+            iteration[0] = epoch + 1
+            if seed is None:
+                rng = _random.Random()
+            elif reshuffle_each_iteration:
+                # distinct deterministic stream per iteration
+                rng = _random.Random(seed * 0x9E3779B1 + epoch)
+            else:
+                rng = _random.Random(seed)
             buf = []
             for x in self._gen_factory():
                 buf.append(x)
@@ -83,20 +253,43 @@ class Dataset:
             rng.shuffle(buf)
             yield from buf
 
-        return Dataset(gen)
+        return Dataset(gen, stats=self._stats)
 
-    def batch(self, batch_size, drop_remainder=False):
+    def batch(self, batch_size, drop_remainder=False, vectorized=True):
+        """Group ``batch_size`` elements into one stacked pytree.
+
+        ``vectorized`` (default) assembles each batch into preallocated
+        per-leaf buffers filled in place — one pass, no per-element
+        ``np.stack`` recursion; False keeps the legacy ``_tree_stack``
+        path (the equivalence/bench reference arm). Both produce
+        identical arrays for numeric pytrees; bytes/str/object leaves
+        take the legacy path either way.
+        """
+        assemble = _tree_assemble if vectorized else _tree_stack
+        stats = self._stats
+
+        if stats is None:
+            emit = assemble
+        else:
+
+            def emit(batch):
+                t0 = time.perf_counter()
+                out = assemble(batch)
+                stats.add("batch_s", time.perf_counter() - t0)
+                stats.count("batches")
+                return out
+
         def gen():
             batch = []
             for x in self._gen_factory():
                 batch.append(x)
                 if len(batch) == batch_size:
-                    yield _tree_stack(batch)
+                    yield emit(batch)
                     batch = []
             if batch and not drop_remainder:
-                yield _tree_stack(batch)
+                yield emit(batch)
 
-        return Dataset(gen)
+        return Dataset(gen, stats=stats)
 
     def repeat(self, count=None):
         def gen():
@@ -111,7 +304,7 @@ class Dataset:
                     return
                 n += 1
 
-        return Dataset(gen)
+        return Dataset(gen, stats=self._stats)
 
     def take(self, n):
         def gen():
@@ -120,7 +313,7 @@ class Dataset:
                     return
                 yield x
 
-        return Dataset(gen)
+        return Dataset(gen, stats=self._stats)
 
     def prefetch(self, buffer_size=1):
         """Run the upstream pipeline in a background thread.
@@ -164,9 +357,20 @@ class Dataset:
 
             t = threading.Thread(target=produce, daemon=True)
             t.start()
+            stats = self._stats
             try:
                 while True:
-                    item = q.get()
+                    if stats is None:
+                        item = q.get()
+                    else:
+                        # a consumer blocked here is STARVED: the device
+                        # outran the host input pipeline
+                        t0 = time.perf_counter()
+                        item = q.get()
+                        stats.add(
+                            "consumer_starved_s",
+                            time.perf_counter() - t0,
+                        )
                     if item is _END:
                         return
                     if isinstance(item, BaseException):
@@ -178,7 +382,7 @@ class Dataset:
                 # queue-put or cancellation check
                 cancel.set()
 
-        return Dataset(gen)
+        return Dataset(gen, stats=self._stats)
 
     def device_prefetch(self, buffer_size=2, placement=None):
         """Move elements to device ahead of consumption (double buffering).
@@ -215,7 +419,7 @@ class Dataset:
             while buf:
                 yield buf.popleft()
 
-        return Dataset(gen)
+        return Dataset(gen, stats=self._stats)
 
     def __iter__(self):
         return iter(self._gen_factory())

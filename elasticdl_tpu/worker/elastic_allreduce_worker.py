@@ -82,6 +82,7 @@ class ElasticAllReduceWorker:
         prediction_outputs_processor="PredictionOutputsProcessor",
         remat="",
         replica_refresh_steps=8,
+        task_prefetch=0,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -276,10 +277,16 @@ class ElasticAllReduceWorker:
         # the world on and takes the failed-step recovery path instead
         # of getting fenced (state intact for the replica plane)
         self.trainer.abort_check = self._world_moved_on
+        # task prefetch composes with the spare-park protocol: the
+        # fetcher participates in requeue_inflight's round abandonment,
+        # so every prefetched-but-unconsumed task goes back to the
+        # master (docs/input_pipeline.md). Acks stay synchronous on this
+        # plane — the validate/flush window already defers them.
         self._task_data_service = TaskDataService(
             self,
             self._job_type == JobType.TRAINING_WITH_EVALUATION,
             data_reader_params=data_reader_params,
+            task_prefetch=task_prefetch,
         )
         self._ckpt = None
         if checkpoint_dir and checkpoint_steps:

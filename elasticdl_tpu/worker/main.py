@@ -101,6 +101,7 @@ def _run(args):
             accum_steps=args.grad_accum_steps,
             remat=args.remat,
             replica_refresh_steps=args.replica_refresh_steps,
+            task_prefetch=getattr(args, "task_prefetch", 1),
         )
         if getattr(args, "standby", False):
             # pre-warmed spare: the cold start (jax/flax import chain
@@ -206,6 +207,9 @@ def _run(args):
             args.data_reader_params
         ),
         precision=args.precision_policy or None,
+        task_prefetch=getattr(args, "task_prefetch", 1),
+        task_ack_queue=getattr(args, "task_ack_queue", 8),
+        loss_log_steps=getattr(args, "loss_log_steps", 20),
     )
     try:
         worker.run()

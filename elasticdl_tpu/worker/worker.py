@@ -85,6 +85,9 @@ class Worker:
         seed=0,
         precision=None,
         sparse_dedup=True,
+        task_prefetch=1,
+        task_ack_queue=8,
+        loss_log_steps=20,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -94,6 +97,10 @@ class Worker:
         self._get_model_steps = get_model_steps
         self._max_minibatch_retry_num = max_minibatch_retry_num
         self._seed = seed
+        # loss logging costs a device sync (float(loss)); throttle it to
+        # every N accepted minibatches and fetch lazily (0 = never)
+        self._loss_log_steps = max(0, int(loss_log_steps))
+        self._accepted_steps = 0
         # sparse-comms fast path: batch-wide id dedup before every row
         # pull, which also makes the pushed row gradients come back
         # pre-combined (docs/sparse_fast_path.md). False restores the
@@ -152,6 +159,11 @@ class Worker:
             self,
             self._job_type == JobType.TRAINING_WITH_EVALUATION,
             data_reader_params=data_reader_params,
+            # pipelined input plane: fetch tasks ahead of consumption and
+            # queue success acks for the boundary drains
+            # (docs/input_pipeline.md)
+            task_prefetch=task_prefetch,
+            ack_queue_size=task_ack_queue,
         )
 
     # -- master RPC surface -------------------------------------------------
@@ -534,7 +546,19 @@ class Worker:
                     features, labels
                 )
                 if accepted:
-                    logger.info("Loss is %f" % float(loss))
+                    # float(loss) is a device sync — fetch only on the
+                    # throttled steps (first accepted step, then every
+                    # --loss_log_steps), never on the hot path
+                    self._accepted_steps += 1
+                    if self._loss_log_steps and (
+                        self._accepted_steps % self._loss_log_steps == 1
+                        or self._loss_log_steps == 1
+                    ):
+                        logger.info(
+                            "Loss is %f (accepted step %d)",
+                            float(loss),
+                            self._accepted_steps,
+                        )
                     break
             elif task_type == TaskType.PREDICTION:
                 if self._model_version != min_model_version:
@@ -579,14 +603,22 @@ class Worker:
 
     @staticmethod
     def _batch_count(dataset_batch):
+        # read shape[0] directly: np.asarray on a device_prefetched batch
+        # would force a device->host materialization every step
         leaf = jax.tree_util.tree_leaves(dataset_batch)[0]
-        return int(np.asarray(leaf).shape[0])
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            return int(shape[0])
+        return len(leaf)
 
     # -- evaluation / save-model tasks -------------------------------------
 
     def _process_eval_task(self, task):
         logger.info("the evaluation task_id: %d" % task.task_id)
         self._drain_ps_pushes()
+        # eval boundary: queued training-task acks land before the
+        # master observes this worker's evaluation results
+        self._task_data_service.drain_acks()
         eval_info = self._task_data_service.get_validation_dataset(task)
         if not eval_info:
             return
@@ -622,6 +654,8 @@ class Worker:
         if task is None or dataset is None:
             return
         self._drain_ps_pushes()
+        # checkpoint/export boundary: settle acks before persisting
+        self._task_data_service.drain_acks()
         saved_model_path = task.extended_config.get(
             SaveModelConfig.SAVED_MODEL_PATH
         )
@@ -729,9 +763,12 @@ class Worker:
                     batch_count, err_msg
                 )
             del dataset
-            # task boundary: settle the async push window before the
-            # next round's eval/save-model decisions see model state
+            # task boundary: settle the async push window and the task
+            # ack queue before the next round's eval/save-model
+            # decisions see model/dispatch state
             self._drain_ps_pushes()
+            self._task_data_service.drain_acks()
+            self._log_input_stats()
             if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                 evaluation_task_executed = self._evaluate_only()
             self._process_save_model_task_if_needed()
@@ -770,6 +807,16 @@ class Worker:
                     batch_count, err_msg
                 )
             del dataset
+            self._task_data_service.drain_acks()
+            self._log_input_stats()
+
+    def _log_input_stats(self):
+        """Log + reset the input-plane counters at a stream boundary."""
+        stats = self._task_data_service.stats
+        snap = stats.snapshot()
+        if snap["tasks"] or snap["records"]:
+            logger.info(stats.format_line())
+        stats.reset()
 
     def run(self):
         """Fetch tasks from the master and train/evaluate/predict."""
@@ -780,3 +827,6 @@ class Worker:
         else:
             self._train_and_evaluate()
         self._drain_ps_pushes()
+        # nothing may stay queued when the worker exits: the master's
+        # doing-set must drain for the job to finish
+        self._task_data_service.drain_acks()

@@ -48,7 +48,10 @@ def dataset_fn(dataset, mode, _):
             return features
         return features, (r["label"].astype(np.int32) - 1).reshape(-1)
 
-    dataset = dataset.map(_parse_data)
+    # image decode is the CPU-heavy stage of this pipeline: run it on
+    # the ordered parallel decode pool (in-order merge, so the stream
+    # stays deterministic; docs/input_pipeline.md)
+    dataset = dataset.map(_parse_data, num_parallel_calls=4)
     if mode == Mode.TRAINING:
         dataset = dataset.shuffle(buffer_size=1024)
     return dataset

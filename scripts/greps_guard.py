@@ -16,6 +16,15 @@
    be ``put_nowait``, or be an allowlisted put into an UNBOUNDED queue
    (which never blocks).
 
+3. Unbounded blocking ``queue.get`` in the DATA PLANE (data/ and the
+   task data service): a consumer getting with no timeout and no
+   sentinel discipline blocks forever once its producer dies or the
+   round is abandoned — the input-pipeline twin of rule 2
+   (docs/input_pipeline.md). Every queue-ish ``.get(`` there must carry
+   ``timeout=`` inside a cancel loop, be ``get_nowait``, or be an
+   allowlisted get whose producer is guaranteed to deliver a terminal
+   sentinel/exception (the prefetch _END protocol).
+
 The allowlists are ratchets: per-file maximum occurrence counts. New
 code that trips a rule must adopt the safe pattern or consciously
 extend the allowlist here, with a reason, in the same review.
@@ -50,10 +59,32 @@ ALLOWED_PUTS = {
     "elasticdl_tpu/data/odps_io.py": 1,
     # Queue(maxsize=1) with exactly one put per producer thread
     "elasticdl_tpu/common/escapable.py": 2,
+    # _TaskFetcher._offer: unbounded queue (depth bounded by the slot
+    # semaphore the consumer releases), put under the offer lock so no
+    # item can land after shutdown's final drain
+    "elasticdl_tpu/worker/task_data_service.py": 1,
+}
+
+# data-plane files rule 3 applies to
+GET_SCOPE_PREFIXES = ("elasticdl_tpu/data/",)
+GET_SCOPE_FILES = ("elasticdl_tpu/worker/task_data_service.py",)
+
+ALLOWED_GETS = {
+    # prefetch's consumer get: the producer ALWAYS delivers a terminal
+    # _END or exception sentinel through put_or_cancel, so the get
+    # cannot outlive its producer (two sites: plain + stats-timed)
+    "elasticdl_tpu/data/dataset.py": 2,
 }
 
 DEVICES_RE = re.compile(r"\b_?jax\.devices\(\)")
 PUT_RE = re.compile(r"(?:\b(?P<recv>[A-Za-z_][A-Za-z0-9_]*))?\.put\(")
+GET_RE = re.compile(r"\b(?P<recv>[A-Za-z_][A-Za-z0-9_]*)\.get\(")
+
+
+def _queue_ish(recv):
+    """Receiver names that read as a queue (not a dict/cache .get)."""
+    low = recv.lower()
+    return low == "q" or low.endswith("_q") or "queue" in low
 
 
 def iter_source_files(root):
@@ -77,6 +108,10 @@ def scan_file(path, root):
         lines = f.read().splitlines()
     devices_hits = []
     put_hits = []
+    get_hits = []
+    in_get_scope = rel in GET_SCOPE_FILES or any(
+        rel.startswith(p) for p in GET_SCOPE_PREFIXES
+    )
     for i, line in enumerate(lines):
         m = DEVICES_RE.search(line)
         if (
@@ -97,15 +132,24 @@ def scan_file(path, root):
             if "timeout=" in window:
                 continue
             put_hits.append((rel, i + 1, line.strip()))
-    return devices_hits, put_hits
+        if in_get_scope:
+            for m in GET_RE.finditer(line):
+                if not _queue_ish(m.group("recv")):
+                    continue  # dict/kwargs/cache .get, not a queue
+                window = " ".join(lines[i : i + 3])
+                if "timeout=" in window:
+                    continue
+                get_hits.append((rel, i + 1, line.strip()))
+    return devices_hits, put_hits, get_hits
 
 
 def check(root):
     violations = []
     devices_counts = {}
     put_counts = {}
+    get_counts = {}
     for path in iter_source_files(root):
-        devices_hits, put_hits = scan_file(path, root)
+        devices_hits, put_hits, get_hits = scan_file(path, root)
         for rel, lineno, text in devices_hits:
             devices_counts[rel] = devices_counts.get(rel, 0) + 1
             if devices_counts[rel] > ALLOWED_DEVICES.get(rel, 0):
@@ -121,6 +165,14 @@ def check(root):
                     "%s:%d: blocking queue put without timeout+cancel "
                     "(abandoned-consumer leak risk): %s"
                     % (rel, lineno, text)
+                )
+        for rel, lineno, text in get_hits:
+            get_counts[rel] = get_counts.get(rel, 0) + 1
+            if get_counts[rel] > ALLOWED_GETS.get(rel, 0):
+                violations.append(
+                    "%s:%d: data-plane blocking queue get without "
+                    "timeout/sentinel discipline (dead-producer hang "
+                    "risk): %s" % (rel, lineno, text)
                 )
     return violations
 
@@ -144,8 +196,11 @@ def main(argv=None):
             "Fix: route device probes through "
             "common/escapable.escapable_call; bound queue puts with "
             "timeout= in a cancel loop (see data/dataset.py "
-            "put_or_cancel). Deliberate exceptions extend the "
-            "allowlists in scripts/greps_guard.py with a reason."
+            "put_or_cancel); bound data-plane queue gets with timeout= "
+            "in a cancel loop (see task_data_service._TaskFetcher."
+            "next_item) or a guaranteed terminal sentinel. Deliberate "
+            "exceptions extend the allowlists in scripts/greps_guard.py "
+            "with a reason."
         )
         return 1
     return 0

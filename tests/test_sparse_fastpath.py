@@ -526,6 +526,10 @@ def test_record_stream_round_bump_during_get_task_hands_task_back():
         def report_task_result(self, task_id, err_msg="", exec_counters=None):
             self.reported.append((task_id, err_msg))
 
+    import collections
+
+    from elasticdl_tpu.data.input_stats import InputPlaneStats
+
     worker = _Worker()
     service = TaskDataService.__new__(TaskDataService)
     service._worker = worker
@@ -536,6 +540,12 @@ def test_record_stream_round_bump_during_get_task_hands_task_back():
     service._primed_task = None
     service._metadata_primed = True
     service._round_id = 0
+    service._task_prefetch = 0
+    service._fetcher = None
+    service._ack_queue_size = 0
+    service._ack_queue = collections.deque()
+    service._ack_lock = threading.Lock()
+    service.stats = InputPlaneStats()
     worker.service = service
 
     stream = service._record_stream()
