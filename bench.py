@@ -83,14 +83,63 @@ def _read_baseline(metric):
     try:
         with open(path) as f:
             return json.load(f)["published"].get(metric)
-    except Exception:
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        # no baseline yet / malformed file (including a non-dict top
+        # level): report without a ratchet
         return None
+
+
+_EDLINT_STATE = []
+
+
+def _edlint_regressed():
+    """Violation count of the edlint concurrency gate (cached).
+
+    A perf PR that trades a speedup for a lock-order or queue-
+    discipline regression is not a win: speedup metrics are withheld
+    while the tree is dirty (docs/static_analysis.md)."""
+    if not _EDLINT_STATE:
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            if here not in sys.path:
+                sys.path.insert(0, here)
+            from elasticdl_tpu.tools.edlint.core import run as edlint_run
+
+            violations, _, broken = edlint_run(here)
+            _EDLINT_STATE.append(len(violations) + len(broken))
+        except Exception as e:
+            # analyzer import/scan failure must not silently unlock the
+            # gate NOR block non-speedup reporting
+            print(
+                json.dumps(
+                    {"metric": "edlint_gate", "error": str(e)[-200:]}
+                )
+            )
+            _EDLINT_STATE.append(1)
+    return _EDLINT_STATE[0]
 
 
 def _emit(metric, value, unit, update=False, lower_is_better=False):
     """One driver JSON line. ``vs_baseline`` is uniformly
     higher-is-better: for a lower-is-better metric (preemption ratio)
-    it is baseline/value, so >1 always reads as an improvement."""
+    it is baseline/value, so >1 always reads as an improvement.
+
+    Speedup metrics are gated on a clean edlint run: a perf number
+    measured on top of a concurrency regression is withheld, with the
+    reason in the error line."""
+    if "speedup" in metric and _edlint_regressed():
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "error": "speedup withheld: edlint reports %d "
+                    "violation(s) — fix them or ratchet with a reason "
+                    "(python -m elasticdl_tpu.tools.edlint)"
+                    % _edlint_regressed(),
+                }
+            )
+        )
+        return
     baseline = _read_baseline(metric)
     if baseline:
         ratio = baseline / value if lower_is_better else value / baseline
@@ -1785,6 +1834,21 @@ def main(argv=None):
     except ValueError:
         total_budget = 3600.0
     t_suite = time.monotonic()
+
+    # concurrency gate first: a dirty edlint tree withholds every
+    # speedup metric below (each section subprocess re-checks too),
+    # so the suite fails loudly instead of publishing tainted wins
+    if _edlint_regressed():
+        failures += 1
+        print(
+            json.dumps(
+                {
+                    "metric": "edlint_gate",
+                    "error": "%d violation(s): speedup metrics "
+                    "withheld this run" % _edlint_regressed(),
+                }
+            )
+        )
 
     def section(name, flags, timeout, device=False):
         nonlocal failures, device_wedged

@@ -84,10 +84,18 @@ class Client:
         self.job_name = job_name
         self._image_name = image_name
         self._event_cb = event_callback
+        self._watcher = None  # the k8s Watch, stoppable from close()
+        self._watch_thread = None
         if self._event_cb:
-            threading.Thread(
+            # the Watch is created HERE, before the thread starts, so a
+            # close() racing startup always has a real object to stop —
+            # a stopped Watch's stream() exits at its first check
+            _, _, k8s_watch = _require_k8s()
+            self._watcher = k8s_watch.Watch()
+            self._watch_thread = threading.Thread(
                 target=self._watch, name="event_watcher", daemon=True
-            ).start()
+            )
+            self._watch_thread.start()
         self.cluster = None
         if cluster_spec:
             self.cluster = load_module(cluster_spec).cluster
@@ -95,8 +103,10 @@ class Client:
     # -- watch stream -------------------------------------------------------
 
     def _watch(self):
-        _, _, k8s_watch = _require_k8s()
-        stream = k8s_watch.Watch().stream(
+        watcher = self._watcher
+        if watcher is None:
+            return  # close() beat the thread to its first instruction
+        stream = watcher.stream(
             self.client.list_namespaced_pod,
             self.namespace,
             label_selector=ELASTICDL_JOB_KEY + "=" + self.job_name,
@@ -106,6 +116,26 @@ class Client:
                 self._event_cb(event)
             except Exception:
                 traceback.print_exc()
+
+    def close(self):
+        """Stop the pod-event watch stream and collect its thread.
+
+        The watch generator blocks in the API server's streaming read;
+        ``Watch.stop()`` makes it exit at the next event/heartbeat, so
+        the join is bounded best-effort (the thread is a daemon either
+        way — this just makes teardown deterministic instead of
+        abandoning a live HTTP stream to interpreter exit)."""
+        watcher, self._watcher = self._watcher, None
+        if watcher is not None:
+            try:
+                watcher.stop()
+            except Exception:
+                logger.warning(
+                    "k8s watch stop failed", exc_info=True
+                )
+        thread, self._watch_thread = self._watch_thread, None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
 
     # -- naming -------------------------------------------------------------
 

@@ -212,7 +212,13 @@ class ODPSReader:
                 if next_i < len(plan):
                     submit(next_i)
                     next_i += 1
-                rows = in_flight.get().result()
+                # single-threaded producer==consumer: every submit()
+                # precedes this pop and the loop is guarded by
+                # in_flight.empty(), so the queue can never be empty
+                # here — get_nowait keeps that invariant checkable
+                # (edlint R3) instead of hiding a hang behind a
+                # blocking get
+                rows = in_flight.get_nowait().result()
                 for j in range(0, len(rows), batch_size):
                     yield rows[j : j + batch_size]
         finally:

@@ -158,8 +158,8 @@ def create_recordio(path):
 
         if native_lib() is not None:
             return NativeRecordIOWriter(path)
-    except Exception:
-        pass
+    except (ImportError, OSError):
+        pass  # native lib absent/unloadable: the Python writer is exact
     return RecordIOWriter(path)
 
 
@@ -174,6 +174,6 @@ def open_recordio(path):
 
         if native_lib() is not None:
             return NativeRecordIOReader(path)
-    except Exception:
-        pass
+    except (ImportError, OSError):
+        pass  # native lib absent/unloadable: the Python reader is exact
     return RecordIOReader(path)

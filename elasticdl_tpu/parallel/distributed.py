@@ -185,7 +185,11 @@ def ensure_world(spec, init_timeout=None):
         try:
             jax.distributed.shutdown()
         except Exception:
-            pass
+            logger.debug(
+                "shutdown during failed world-form also failed "
+                "(backends are cleared next anyway)",
+                exc_info=True,
+            )
         _clear_backends()
         raise WorldBroken(
             "could not form world epoch %d (%s)" % (spec.epoch, e)

@@ -968,7 +968,11 @@ class ElasticDPTrainer:
                 try:
                     self._last_mirror_version = self.version
                 except Exception:
-                    pass  # device also wedged: the step failure owns it
+                    # device also wedged: the step failure owns it
+                    logger.debug(
+                        "cadence marker refresh failed too",
+                        exc_info=True,
+                    )
         logger.info(
             "elastic plane established: epoch=%d rank=%d/%d devices=%d%s",
             spec.epoch,

@@ -1,0 +1,6 @@
+"""Correctness tooling for the threaded data plane.
+
+``edlint`` — the AST-based concurrency / jit-purity analyzer
+(docs/static_analysis.md); ``locktrace`` — the runtime lock-order
+sanitizer the data-plane test suites opt into via ``EDL_LOCKTRACE=1``.
+"""

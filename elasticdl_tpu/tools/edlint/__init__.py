@@ -1,0 +1,13 @@
+"""edlint — AST-based concurrency & jit-purity analyzer.
+
+Successor to the regex ratchet ``scripts/greps_guard.py`` (now a thin
+shim over rules R1–R3): a real ``ast`` pass with a rule registry,
+per-rule allowlist ratchets (every entry carries a reason), and a
+findings report. Rule catalog and extension guide:
+``docs/static_analysis.md``.
+
+Run: ``python -m elasticdl_tpu.tools.edlint`` (exit 0 clean / 1 with a
+per-violation report), or the ``edlint`` console entry point.
+"""
+
+from elasticdl_tpu.tools.edlint.core import Finding, main, run  # noqa: F401

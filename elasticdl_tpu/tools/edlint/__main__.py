@@ -1,0 +1,6 @@
+import sys
+
+from elasticdl_tpu.tools.edlint.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

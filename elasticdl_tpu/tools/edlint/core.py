@@ -1,0 +1,327 @@
+"""edlint engine: file walker, per-file AST context, ratchet, report.
+
+A rule is an object with ``id``, ``name``, ``doc`` and a
+``check(ctx) -> [Finding]`` method over a :class:`FileContext` — one
+parsed module plus the binding tables most concurrency rules need
+(which names/attributes in this file hold ``queue.Queue``\\ s, locks,
+conditions, threads). Rules live in ``rules.py``; the allowlist
+ratchets (per rule, per file, max count + reason) live in
+``ratchet.py``.
+
+The ratchet discipline is the same one greps_guard established: an
+allowlist entry is a per-file MAXIMUM occurrence count. New code that
+trips a rule must adopt the safe pattern or consciously extend the
+ratchet with a reason in the same review; entries only ever shrink
+(``--stale`` reports entries whose budget exceeds current use).
+"""
+
+import argparse
+import ast
+import os
+import sys
+from collections import namedtuple
+
+Finding = namedtuple("Finding", "rule path lineno message text")
+
+# binding "kinds": ("name", "q") for a local/module name, ("attr", "_q")
+# for an attribute (self._q / service._q — keyed by the attribute name
+# alone, which is how humans keep these unambiguous within one file)
+
+QUEUE_UNBOUNDED = "unbounded"
+QUEUE_BOUNDED = "bounded"
+
+
+def binding_of(node):
+    """Binding key for an expression used as receiver/target, or None."""
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Attribute):
+        return ("attr", node.attr)
+    return None
+
+
+def dotted(node):
+    """Dotted name of an expression ("jax.devices", "self._q.put"), or
+    "" when any link is not a plain Name/Attribute."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _queue_boundedness(call):
+    """Boundedness of a ``queue.Queue(...)``-style constructor call."""
+    size = call_kwarg(call, "maxsize")
+    if size is None and call.args:
+        size = call.args[0]
+    if size is None:
+        return QUEUE_UNBOUNDED
+    if isinstance(size, ast.Constant) and not size.value:
+        return QUEUE_UNBOUNDED  # maxsize=0/None: never blocks on put
+    return QUEUE_BOUNDED
+
+
+_QUEUE_CTORS = ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue")
+_LOCK_CTORS = ("Lock", "RLock")
+
+
+class FileContext:
+    """One parsed source file plus the binding tables rules share."""
+
+    def __init__(self, path, source):
+        self.path = path  # repo-relative, posix
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parent = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        # binding -> QUEUE_BOUNDED | QUEUE_UNBOUNDED
+        self.queue_bindings = {}
+        # bindings assigned threading.Lock()/RLock() (not Conditions)
+        self.lock_bindings = set()
+        self.condition_bindings = set()
+        self._collect_bindings()
+
+    def line(self, node):
+        try:
+            return self.lines[node.lineno - 1].strip()
+        except IndexError:
+            return ""
+
+    def _collect_bindings(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            tail = dotted(value.func).rsplit(".", 1)[-1]
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                b = binding_of(target)
+                if b is None:
+                    continue
+                if tail in _QUEUE_CTORS:
+                    if tail == "SimpleQueue":
+                        self.queue_bindings[b] = QUEUE_UNBOUNDED
+                    else:
+                        self.queue_bindings[b] = _queue_boundedness(value)
+                elif tail in _LOCK_CTORS:
+                    self.lock_bindings.add(b)
+                elif tail == "Condition":
+                    self.condition_bindings.add(b)
+
+    def enclosing(self, node, kinds):
+        """Nearest ancestor of ``node`` matching ``kinds`` (or None)."""
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, kinds):
+            cur = self.parent.get(cur)
+        return cur
+
+    def walk_shallow(self, node, stop=()):
+        """Walk ``node``'s subtree without descending into ``stop``
+        node types (used to keep "lexically inside" honest — a nested
+        ``def``'s body does not run under the enclosing lock)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            cur = stack.pop()
+            yield cur
+            if not isinstance(cur, stop):
+                stack.extend(ast.iter_child_nodes(cur))
+
+
+def iter_source_files(root):
+    """Scanned scope: the package tree, the model zoo, scripts, and the
+    top-level entry points. Tests are deliberately out of scope — they
+    hold known-bad fixtures for these very rules."""
+    for name in ("__graft_entry__.py", "bench.py"):
+        path = os.path.join(root, name)
+        if os.path.exists(path):
+            yield path
+    for pkg in ("elasticdl_tpu", "model_zoo", "scripts"):
+        top = os.path.join(root, pkg)
+        for dirpath, dirnames, names in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def scan(root, rule_ids=None):
+    """All raw findings over ``root`` (before the ratchet), in
+    (path, lineno) order, plus files that failed to parse."""
+    from elasticdl_tpu.tools.edlint.rules import RULES
+
+    rules = [
+        r for r in RULES if rule_ids is None or r.id in rule_ids
+    ]
+    findings = []
+    broken = []
+    for path in iter_source_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                ctx = FileContext(rel, f.read())
+        except SyntaxError as err:
+            broken.append((rel, str(err)))
+            continue
+        for rule in rules:
+            findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+    return findings, broken
+
+
+def apply_ratchet(findings, allow=None):
+    """Split findings into (violations, counts, allowed).
+
+    ``allow`` is ``{rule_id: {path: {"max": n, "reason": str}}}``. Per
+    (rule, file) the first ``max`` findings in line order are
+    suppressed as consciously-allowlisted; everything past the budget
+    is a violation. ``counts`` maps (rule, path) -> total occurrences
+    (the numbers ``--stale`` compares budgets against).
+    """
+    if allow is None:
+        from elasticdl_tpu.tools.edlint.ratchet import ALLOW
+
+        allow = ALLOW
+    counts = {}
+    violations = []
+    allowed = []
+    for f in findings:
+        key = (f.rule, f.path)
+        counts[key] = counts.get(key, 0) + 1
+        budget = allow.get(f.rule, {}).get(f.path, {}).get("max", 0)
+        if counts[key] <= budget:
+            allowed.append(f)
+        else:
+            violations.append(f)
+    return violations, counts, allowed
+
+
+def stale_entries(counts, allow=None):
+    """Ratchet entries whose budget exceeds current use — the ratchet
+    can (and should) shrink to meet the code."""
+    if allow is None:
+        from elasticdl_tpu.tools.edlint.ratchet import ALLOW
+
+        allow = ALLOW
+    stale = []
+    for rule_id, files in sorted(allow.items()):
+        for path, entry in sorted(files.items()):
+            used = counts.get((rule_id, path), 0)
+            if used < entry.get("max", 0):
+                stale.append((rule_id, path, used, entry["max"]))
+    return stale
+
+
+def run(root, rule_ids=None, allow=None):
+    """(violations, counts, broken) for ``root`` after the ratchet."""
+    findings, broken = scan(root, rule_ids=rule_ids)
+    violations, counts, _ = apply_ratchet(findings, allow=allow)
+    return violations, counts, broken
+
+
+def _default_root():
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def main(argv=None):
+    from elasticdl_tpu.tools.edlint.rules import RULES
+
+    parser = argparse.ArgumentParser(
+        prog="edlint",
+        description="AST-based concurrency & jit-purity analyzer "
+        "(docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "--root",
+        default=_default_root(),
+        help="repo root to scan (default: this package's repo)",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.add_argument(
+        "--stale",
+        action="store_true",
+        help="also report ratchet entries wider than current use "
+        "(the ratchet only shrinks)",
+    )
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule in RULES:
+            print("%s %-18s %s" % (rule.id, rule.name, rule.doc))
+        return 0
+    rule_ids = (
+        tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        if args.rules
+        else None
+    )
+    violations, counts, broken = run(args.root, rule_ids=rule_ids)
+    rc = 0
+    if broken:
+        rc = 1
+        print("edlint: %d unparseable file(s)" % len(broken))
+        for rel, err in broken:
+            print("  %s: %s" % (rel, err))
+    if violations:
+        rc = 1
+        print("edlint: %d violation(s)" % len(violations))
+        for f in violations:
+            print(
+                "  %s:%d: [%s] %s: %s"
+                % (f.path, f.lineno, f.rule, f.message, f.text)
+            )
+        print(
+            "Fix the pattern (docs/static_analysis.md has the safe "
+            "idiom per rule) or consciously extend the ratchet in "
+            "elasticdl_tpu/tools/edlint/ratchet.py with a reason, in "
+            "the same review."
+        )
+    if args.stale:
+        # scope the stale check to the rules that actually ran: a
+        # subset run (the greps_guard shim's R1-R3) has zero counts
+        # for every other rule and must not read their budgets as slack
+        stale = [
+            s
+            for s in stale_entries(counts)
+            if rule_ids is None or s[0] in rule_ids
+        ]
+        if stale:
+            rc = 1
+            print("edlint: %d stale ratchet entr(ies)" % len(stale))
+            for rule_id, path, used, budget in stale:
+                print(
+                    "  %s %s: budget %d, used %d — shrink it"
+                    % (rule_id, path, budget, used)
+                )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,794 @@
+"""The edlint rule catalog (R1–R7). See docs/static_analysis.md.
+
+R1–R3 absorb scripts/greps_guard.py's regex rules as real AST passes:
+calls (not prose or uncalled pass-throughs) for the device probe, and
+receiver-typed queue discipline — a ``.put`` on a queue this file
+provably constructed UNBOUNDED is safe by construction and needs no
+allowlist entry, while the old regexes had to ratchet those by hand.
+
+R4–R7 are rules the regexes could not express: thread lifecycle,
+blocking-call-under-lock (with one-file transitive call-chain
+propagation), silent broad excepts, and jit purity.
+"""
+
+import ast
+
+from elasticdl_tpu.tools.edlint.core import (
+    Finding,
+    QUEUE_UNBOUNDED,
+    binding_of,
+    call_kwarg,
+    dotted,
+)
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _queue_ish(name):
+    """Receiver names that read as a queue (not a dict/cache .get)."""
+    low = (name or "").lower()
+    return low == "q" or low.endswith("_q") or "queue" in low
+
+
+def _receiver(call):
+    """(binding, simple name) of an attribute call's receiver."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None, ""
+    b = binding_of(func.value)
+    name = b[1] if b else ""
+    return b, name
+
+
+def _has_timeout(call):
+    if call_kwarg(call, "timeout") is not None:
+        return True
+    block = call_kwarg(call, "block")
+    return isinstance(block, ast.Constant) and block.value is False
+
+
+def _fn_scopes(ctx):
+    """Every function/method node with its enclosing class (or None)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = ctx.enclosing(node, ast.ClassDef)
+            out.append((node, cls))
+    return out
+
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class Rule:
+    id = "?"
+    name = "?"
+    doc = ""
+
+    def finding(self, ctx, node, message):
+        return Finding(self.id, ctx.path, node.lineno, message, ctx.line(node))
+
+
+# ---------------------------------------------------------------------------
+# R1 — device probe
+# ---------------------------------------------------------------------------
+
+
+class DeviceProbeRule(Rule):
+    id = "R1"
+    name = "device-probe"
+    doc = (
+        "jax.devices() must run through common/escapable.escapable_call "
+        "(the r5 wedged-transport outage class); passing jax.devices "
+        "UNCALLED to escapable_call is the safe idiom and does not match"
+    )
+
+    MESSAGE = (
+        "jax.devices() outside escapable_call "
+        "(wedged-transport hang risk)"
+    )
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and dotted(node.func) in (
+                "jax.devices",
+                "_jax.devices",
+            ):
+                out.append(self.finding(ctx, node, self.MESSAGE))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R2 — queue put discipline
+# ---------------------------------------------------------------------------
+
+
+class QueuePutRule(Rule):
+    id = "R2"
+    name = "queue-put"
+    doc = (
+        "a blocking .put on a bounded (or unknown) queue must carry "
+        "timeout= inside a cancel loop or be put_nowait; puts into a "
+        "queue this file constructed UNBOUNDED never block and pass"
+    )
+
+    MESSAGE = (
+        "blocking queue put without timeout+cancel "
+        "(abandoned-consumer leak risk)"
+    )
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "put"
+            ):
+                continue
+            b, rname = _receiver(node)
+            if "cache" in rname.lower():
+                continue  # HotRowCache.put and kin: not a queue
+            known = ctx.queue_bindings.get(b) if b else None
+            if known == QUEUE_UNBOUNDED:
+                continue  # put never blocks: safe by construction
+            if known is None and not _queue_ish(rname):
+                continue  # dict/store .put on a non-queue receiver
+            if _has_timeout(node):
+                continue
+            out.append(self.finding(ctx, node, self.MESSAGE))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R3 — data-plane queue get discipline
+# ---------------------------------------------------------------------------
+
+
+class QueueGetRule(Rule):
+    id = "R3"
+    name = "queue-get"
+    doc = (
+        "in the data plane (data/, task_data_service) a blocking queue "
+        ".get must carry timeout= inside a cancel loop, be get_nowait, "
+        "or be allowlisted with a guaranteed terminal sentinel"
+    )
+
+    MESSAGE = (
+        "data-plane blocking queue get without timeout/sentinel "
+        "discipline (dead-producer hang risk)"
+    )
+
+    SCOPE_PREFIXES = ("elasticdl_tpu/data/",)
+    SCOPE_FILES = ("elasticdl_tpu/worker/task_data_service.py",)
+
+    def _in_scope(self, path):
+        return path in self.SCOPE_FILES or any(
+            path.startswith(p) for p in self.SCOPE_PREFIXES
+        )
+
+    def check(self, ctx):
+        if not self._in_scope(ctx.path):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+            ):
+                continue
+            b, rname = _receiver(node)
+            known = ctx.queue_bindings.get(b) if b else None
+            if known is None and not _queue_ish(rname):
+                continue  # dict/kwargs/cache .get, not a queue
+            if _has_timeout(node):
+                continue
+            out.append(self.finding(ctx, node, self.MESSAGE))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R4 — thread lifecycle
+# ---------------------------------------------------------------------------
+
+_SHUTDOWNISH = (
+    "stop",
+    "close",
+    "shutdown",
+    "cancel",
+    "terminate",
+    "abort",
+    "join",
+    "wait",
+    "drain",
+    "release",
+    "__exit__",
+    "__del__",
+)
+
+
+class ThreadLifecycleRule(Rule):
+    id = "R4"
+    name = "thread-lifecycle"
+    doc = (
+        "every threading.Thread must be daemonized or reachably joined, "
+        "and a class that spawns one must own a shutdown/cancel path "
+        "(a stop/close/shutdown-ish method, a cancel Event .set(), or a "
+        ".join of the thread); a ThreadPoolExecutor bound to a name "
+        "must be .shutdown() somewhere in its file"
+    )
+
+    def _is_thread_ctor(self, node):
+        d = dotted(node.func)
+        return d in ("threading.Thread", "_threading.Thread", "Thread")
+
+    def _joined(self, ctx, b):
+        if b is None:
+            return False
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and binding_of(node.func.value) == b
+            ):
+                return True
+        return False
+
+    def _assigned_binding(self, ctx, node):
+        parent = ctx.parent.get(node)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            return binding_of(parent.targets[0])
+        return None
+
+    def _executor_shut_down(self, ctx, b):
+        """A ``.shutdown()`` on the executor's own binding, or on a
+        receiver that reads as an executor (the ``for pool in (...):
+        pool.shutdown()`` teardown idiom) — an unrelated shutdown like
+        ``jax.distributed.shutdown()`` must not mask a leaked pool."""
+        for n in ast.walk(ctx.tree):
+            if not (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "shutdown"
+            ):
+                continue
+            recv = binding_of(n.func.value)
+            if recv == b:
+                return True
+            low = (recv[1] if recv else "").lower()
+            if "pool" in low or "exec" in low:
+                return True
+        return False
+
+    def _class_has_shutdown_path(self, ctx, cls, ctor, b):
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                low = stmt.name.lower()
+                if any(s in low for s in _SHUTDOWNISH):
+                    return True
+        # a cancel Event .set() anywhere in the spawning function chain
+        # (the Dataset.prefetch idiom: generator finally sets the
+        # producer's cancel event) also counts as a cancel path
+        scope = ctx.enclosing(ctor, (ast.FunctionDef, ast.AsyncFunctionDef))
+        while scope is not None:
+            for node in ast.walk(scope):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "set"
+                    and not node.args
+                    and not node.keywords
+                ):
+                    return True
+            scope = ctx.enclosing(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+        return self._joined(ctx, b)
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func).rsplit(".", 1)[-1] == "ThreadPoolExecutor":
+                b = self._assigned_binding(ctx, node)
+                if b is not None and not self._executor_shut_down(ctx, b):
+                    out.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "ThreadPoolExecutor is never shut down "
+                            "(its threads outlive the owner)",
+                        )
+                    )
+                continue
+            if not self._is_thread_ctor(node):
+                continue
+            daemon = call_kwarg(node, "daemon")
+            daemonized = (
+                isinstance(daemon, ast.Constant) and daemon.value is True
+            )
+            b = self._assigned_binding(ctx, node)
+            if not daemonized and not self._joined(ctx, b):
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "thread is neither daemonized nor joined "
+                        "(leaks and blocks interpreter exit)",
+                    )
+                )
+                continue
+            cls = ctx.enclosing(node, ast.ClassDef)
+            if cls is not None and not self._class_has_shutdown_path(
+                ctx, cls, node, b
+            ):
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "thread-spawning class %r has no shutdown/"
+                        "cancel path (stop/close/shutdown method, "
+                        "cancel-event .set(), or join)" % cls.name,
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R5 — blocking call under lock
+# ---------------------------------------------------------------------------
+
+_RPC_METHODS = frozenset(
+    (
+        "get_task",
+        "report_task_result",
+        "report_gradient",
+        "report_evaluation_metrics",
+        "report_version",
+        "get_comm_world",
+        "push_model",
+        "push_gradient",
+        "push_embedding_info",
+        "pull_variable",
+        "pull_embedding_vector",
+        "pull_embedding_vectors",
+        "pull_embedding_vectors_multi",
+        "pull_dense",
+        "call",
+    )
+)
+
+_SUBPROCESS_BLOCKING = frozenset(
+    ("run", "check_call", "check_output", "communicate")
+)
+
+_THREADISH = ("thread", "queue", "proc", "pool", "worker", "fetcher", "beater")
+
+
+class BlockingUnderLockRule(Rule):
+    id = "R5"
+    name = "blocking-under-lock"
+    doc = (
+        "no RPC, blocking queue op, sleep, join/wait/result, or file/"
+        "checkpoint IO lexically inside a `with lock:` body (or an "
+        "acquire/try/finally-release region) — snapshot under the lock, "
+        "do the slow thing after release; one-file call chains through "
+        "same-class methods are followed"
+    )
+
+    def _lockish(self, ctx, expr):
+        b = binding_of(expr)
+        if b is None:
+            return False
+        if b in ctx.condition_bindings:
+            return False  # Condition protocol REQUIRES holding the lock
+        if b in ctx.lock_bindings:
+            return True
+        low = b[1].lower()
+        return "lock" in low or low == "_mu" or low.endswith("_mu")
+
+    def _blocking_kind(self, ctx, call):
+        """Why this single call can block, or None."""
+        d = dotted(call.func)
+        tail = d.rsplit(".", 1)[-1] if d else ""
+        if not isinstance(call.func, ast.Attribute):
+            if d == "open":
+                return "file IO (open)"
+            if d == "sleep":
+                return "sleep"
+            return None
+        b, rname = _receiver(call)
+        low = rname.lower()
+        if tail == "sleep":
+            return "sleep"
+        if tail in ("put", "get"):
+            if "cache" in low:
+                return None
+            known = ctx.queue_bindings.get(b) if b else None
+            if tail == "put" and known == QUEUE_UNBOUNDED:
+                return None
+            if known is not None or _queue_ish(rname):
+                # even a timeout'd queue op stalls every other waiter
+                # on this lock for up to the timeout
+                return "blocking queue %s" % tail
+            return None
+        if tail == "join":
+            if (
+                (b is not None and b in ctx.queue_bindings)
+                or any(t in low for t in _THREADISH)
+                or low in ("t", "q")
+                or low.endswith(("_t", "_q"))
+            ):
+                return "join"
+            return None
+        if tail == "result":
+            return "future result"
+        if tail == "wait":
+            if b in ctx.condition_bindings:
+                return None
+            return "wait"
+        if tail in _RPC_METHODS:
+            return "RPC (%s)" % tail
+        if tail in _SUBPROCESS_BLOCKING and d.startswith("subprocess."):
+            return "subprocess"
+        if tail == "save" and ("checkpoint" in low or "ckpt" in low):
+            return "checkpoint IO"
+        return None
+
+    # -- one-file call-chain propagation --------------------------------
+
+    def _build_summaries(self, ctx):
+        """{func node id: (chain description, example lineno)} for every
+        function that (transitively, within this file) blocks."""
+        methods = {}  # (class name or None, fn name) -> node
+        scopes = _fn_scopes(ctx)
+        for fn, cls in scopes:
+            methods[(cls.name if cls else None, fn.name)] = fn
+
+        def direct(fn):
+            for node in ctx.walk_shallow(fn, stop=_FUNC):
+                if isinstance(node, ast.Call):
+                    kind = self._blocking_kind(ctx, node)
+                    if kind:
+                        return kind, node.lineno
+            return None
+
+        def callees(fn, cls):
+            for node in ctx.walk_shallow(fn, stop=_FUNC):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and cls is not None
+                ):
+                    target = methods.get((cls.name, f.attr))
+                    if target is not None:
+                        yield f.attr, target
+                elif isinstance(f, ast.Name):
+                    target = methods.get((None, f.id))
+                    if target is not None:
+                        yield f.id, target
+
+        summaries = {}
+        state = {}  # node id -> "visiting" | "done"
+
+        def summarize(fn, cls):
+            key = id(fn)
+            if state.get(key) == "done":
+                return summaries.get(key)
+            if state.get(key) == "visiting":
+                return None  # recursion: break the cycle
+            state[key] = "visiting"
+            result = None
+            hit = direct(fn)
+            if hit:
+                result = ("%s [%s]" % (fn.name, hit[0]), hit[1])
+            else:
+                for name, target in callees(fn, cls):
+                    target_cls = ctx.enclosing(target, ast.ClassDef)
+                    sub = summarize(target, target_cls)
+                    if sub:
+                        result = ("%s -> %s" % (fn.name, sub[0]), sub[1])
+                        break
+            state[key] = "done"
+            if result:
+                summaries[key] = result
+            return result
+
+        for fn, cls in scopes:
+            summarize(fn, cls)
+        by_name = {}
+        for (cls_name, fn_name), fn in methods.items():
+            if id(fn) in summaries:
+                by_name[(cls_name, fn_name)] = summaries[id(fn)]
+        return by_name
+
+    def _locked_regions(self, ctx):
+        """(region statements, lock text) for `with lock:` bodies and
+        try-bodies whose finally releases a lock."""
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if self._lockish(ctx, item.context_expr):
+                        yield node.body, ctx.line(node)
+                        break
+            elif isinstance(node, ast.Try) and node.finalbody:
+                for fin in node.finalbody:
+                    released = any(
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "release"
+                        and self._lockish(ctx, n.func.value)
+                        for n in ast.walk(fin)
+                    )
+                    if released:
+                        yield node.body, ctx.line(node)
+                        break
+
+    def check(self, ctx):
+        summaries = self._build_summaries(ctx)
+        out = []
+        seen = set()
+        for body, _ in self._locked_regions(ctx):
+            for stmt in body:
+                for node in [stmt] + list(
+                    ctx.walk_shallow(stmt, stop=_FUNC)
+                ):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    kind = self._blocking_kind(ctx, node)
+                    if kind:
+                        seen.add(id(node))
+                        out.append(
+                            self.finding(
+                                ctx,
+                                node,
+                                "blocking call under lock (%s) — "
+                                "snapshot under the lock, %s after "
+                                "release" % (kind, kind.split()[0]),
+                            )
+                        )
+                        continue
+                    f = node.func
+                    chain = None
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                    ):
+                        cls = ctx.enclosing(node, ast.ClassDef)
+                        if cls is not None:
+                            chain = summaries.get((cls.name, f.attr))
+                    elif isinstance(f, ast.Name):
+                        chain = summaries.get((None, f.id))
+                    if chain:
+                        seen.add(id(node))
+                        out.append(
+                            self.finding(
+                                ctx,
+                                node,
+                                "call chain blocks under lock "
+                                "(%s)" % chain[0],
+                            )
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R6 — silent broad except
+# ---------------------------------------------------------------------------
+
+_BROAD = ("Exception", "BaseException")
+_LOGGISH = ("log", "logger", "logging", "warn", "print")
+
+
+class SilentExceptRule(Rule):
+    id = "R6"
+    name = "silent-except"
+    doc = (
+        "a bare `except:` or `except Exception:` whose body neither "
+        "logs, re-raises, nor does real work swallows failures "
+        "silently — log it, narrow the type, or re-raise"
+    )
+
+    def _broad(self, handler):
+        t = handler.type
+        if t is None:
+            return True
+        names = t.elts if isinstance(t, ast.Tuple) else [t]
+        for n in names:
+            d = dotted(n)
+            if d.rsplit(".", 1)[-1] in _BROAD:
+                return True
+        return False
+
+    def _handled(self, handler):
+        """True when the body raises, logs, or does anything beyond
+        pass/continue/break/constant-return."""
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Return) and (
+                stmt.value is None or isinstance(stmt.value, ast.Constant)
+            ):
+                continue
+            return True
+        return False
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.ExceptHandler)
+                and self._broad(node)
+                and not self._handled(node)
+            ):
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "broad except swallows silently "
+                        "(log it, narrow the type, or re-raise)",
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R7 — jit purity
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+_LOG_METHODS = (
+    "debug",
+    "info",
+    "warning",
+    "error",
+    "exception",
+    "critical",
+    "log",
+)
+
+
+class JitPurityRule(Rule):
+    id = "R7"
+    name = "jit-purity"
+    doc = (
+        "a function handed to jax.jit/pjit (directly, via shard_map/"
+        "partial, or as a decorator) must not print/log, mutate "
+        "globals or self, or touch queue/threading/sleep — the side "
+        "effect fires once per TRACE, not per step, and host syncs "
+        "inside traced code wedge the device pipeline"
+    )
+
+    def _is_jit(self, func_expr):
+        d = dotted(func_expr)
+        return d in _JIT_NAMES or d.endswith(".pjit")
+
+    def _resolve(self, ctx, expr, depth=0):
+        """The FunctionDef/Lambda a jit argument ultimately names."""
+        if depth > 4 or expr is None:
+            return None
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Call):
+            # shard_map(fn, ...) / functools.partial(fn, ...): trace
+            # through to the wrapped callable
+            tail = dotted(expr.func).rsplit(".", 1)[-1]
+            if tail in ("shard_map", "partial", "checkpoint", "remat"):
+                if expr.args:
+                    return self._resolve(ctx, expr.args[0], depth + 1)
+            return None
+        if isinstance(expr, ast.Name):
+            target = None
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == expr.id
+                ):
+                    target = node
+            return target
+        return None
+
+    def _impurity(self, ctx, fn):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                return "mutates enclosing scope (%s)" % (
+                    "global"
+                    if isinstance(node, ast.Global)
+                    else "nonlocal"
+                )
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        return "mutates self.%s" % t.attr
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            tail = d.rsplit(".", 1)[-1] if d else ""
+            if d == "print":
+                return "calls print"
+            if d.startswith("jax.debug."):
+                continue  # jax.debug.print/callback are trace-aware
+            first = d.split(".", 1)[0]
+            low_first = first.lower()
+            if (
+                "logger" in low_first or low_first == "logging"
+            ) and tail in _LOG_METHODS:
+                return "calls %s.%s" % (first, tail)
+            if first in ("threading", "queue") or d in (
+                "time.sleep",
+                "sleep",
+            ):
+                return "touches %s" % d
+            if d == "open":
+                return "opens a file"
+        return None
+
+    def check(self, ctx):
+        out = []
+        targets = []  # (jit-site node, resolved fn)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and self._is_jit(node.func):
+                fn = self._resolve(ctx, node.args[0] if node.args else None)
+                if fn is not None:
+                    targets.append((node, fn))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit(dec):
+                        targets.append((node, node))
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and dotted(dec.func).rsplit(".", 1)[-1]
+                        == "partial"
+                        and dec.args
+                        and self._is_jit(dec.args[0])
+                    ):
+                        targets.append((node, node))
+        seen = set()
+        for site, fn in targets:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            why = self._impurity(ctx, fn)
+            if why:
+                out.append(
+                    self.finding(
+                        ctx,
+                        site,
+                        "jit-traced function is impure: %s (fires per "
+                        "trace, not per step; host effects inside "
+                        "traced code are the silent-retrace/host-sync "
+                        "footgun)" % why,
+                    )
+                )
+        return out
+
+
+RULES = (
+    DeviceProbeRule(),
+    QueuePutRule(),
+    QueueGetRule(),
+    ThreadLifecycleRule(),
+    BlockingUnderLockRule(),
+    SilentExceptRule(),
+    JitPurityRule(),
+)

@@ -1,0 +1,291 @@
+"""Runtime lock-order sanitizer for the threaded data plane.
+
+The static side (edlint R5) catches blocking work under a lock; this
+module catches the OTHER hang class static analysis cannot see — lock
+ORDER inversions across threads (ABBA), the classic elastic-system
+wedge where the prefetch thread holds the ledger lock wanting the ack
+lock while the requeue path holds the ack lock wanting the ledger.
+
+It is a lockdep-style acquisition-graph sanitizer: every traced lock
+acquire records a ``held -> acquiring`` edge per lock currently held
+by the thread, and an acquire whose edges would close a cycle raises
+:class:`LockOrderError` *at acquire time, before blocking* — a
+would-be deadlock becomes a deterministic, diagnosable exception with
+the full cycle and the source sites that created each edge. The graph
+is global and cumulative, so an inversion is caught even when the two
+threads never actually interleave into the deadlock during the run
+(potential deadlocks, not just realized ones).
+
+Reentrant ``RLock`` re-acquisition by the owning thread adds no edges
+(no false positive), and ``Condition`` works: the traced RLock
+implements the ``_is_owned``/``_release_save``/``_acquire_restore``
+protocol.
+
+Usage: the tier-1 data-plane suites opt in via ``EDL_LOCKTRACE=1``
+(tests/conftest.py installs/uninstalls around each test;
+scripts/check.sh runs them that way). ``install()`` patches
+``threading.Lock``/``threading.RLock`` with factories that return
+traced locks ONLY for callers inside the scoped source trees
+(elasticdl_tpu/ and tests/ by default) — jax/grpc/stdlib internals
+keep real locks, so the graph stays our code's graph. Explicit
+:func:`Lock`/:func:`RLock` constructors are always traced, for direct
+use in tests.
+"""
+
+import os
+import sys
+import threading as _threading
+import _thread
+
+_REAL_LOCK = _thread.allocate_lock
+_REAL_RLOCK = _threading._RLock  # the pure-python RLock type
+
+DEFAULT_SCOPE = ("elasticdl_tpu", "tests")
+
+
+class LockOrderError(RuntimeError):
+    """Acquiring this lock would close a cycle in the lock-order graph
+    (a potential ABBA deadlock). Raised BEFORE the acquire blocks."""
+
+
+def _site(depth=2):
+    frame = sys._getframe(depth)
+    return "%s:%d" % (
+        os.path.basename(frame.f_code.co_filename),
+        frame.f_lineno,
+    )
+
+
+class _Tracer:
+    """The global acquisition graph plus per-thread held stacks."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        # id(lock) -> {id(successor): "siteA -> siteB" edge provenance}
+        self._edges = {}
+        self._names = {}  # id(lock) -> display name
+        self._local = _threading.local()
+
+    def _held(self):
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    def _path(self, src, dst):
+        """Edge path src ~> dst in the graph, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            for nxt in self._edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _describe(self, ids):
+        return " -> ".join(self._names.get(i, "<lock>") for i in ids)
+
+    def before_acquire(self, lock, site):
+        """Record ``held -> lock`` edges; raise on a would-be cycle.
+
+        Runs BEFORE the underlying acquire so the offending thread gets
+        the exception instead of the deadlock."""
+        held = self._held()
+        lid = id(lock)
+        if any(h is lock for h in held):
+            return  # reentrant re-acquire: never a new ordering edge
+        if not held:
+            with self._mu:
+                self._names[lid] = lock.name
+            return
+        with self._mu:
+            self._names[lid] = lock.name
+            for h in held:
+                cycle = self._path(lid, id(h))
+                if cycle is not None:
+                    provenance = [
+                        self._edges[a].get(b, "?")
+                        for a, b in zip(cycle, cycle[1:])
+                    ]
+                    raise LockOrderError(
+                        "lock-order inversion: acquiring %r at %s "
+                        "while holding %r would close the cycle "
+                        "[%s -> %s]; prior edges: %s"
+                        % (
+                            lock.name,
+                            site,
+                            h.name,
+                            self._describe(cycle),
+                            lock.name,
+                            "; ".join(provenance),
+                        )
+                    )
+            for h in held:
+                self._edges.setdefault(id(h), {}).setdefault(
+                    lid, "%s held at %s" % (h.name, site)
+                )
+
+    def on_acquired(self, lock):
+        self._held().append(lock)
+
+    def on_release(self, lock):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+
+class _TracedBase:
+    _REENTRANT = False
+
+    def __init__(self, name=None):
+        self._inner = (
+            _REAL_RLOCK() if self._REENTRANT else _REAL_LOCK()
+        )
+        self.name = name or "%s@%s" % (
+            type(self).__name__,
+            _site(2),
+        )
+
+    def acquire(self, blocking=True, timeout=-1):
+        tracer = _tracer
+        if tracer is not None and blocking:
+            tracer.before_acquire(self, _site(2))
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and tracer is not None:
+            tracer.on_acquired(self)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        tracer = _tracer
+        if tracer is not None:
+            tracer.on_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):
+        return "<%s %r %r>" % (
+            type(self).__name__,
+            self.name,
+            self._inner,
+        )
+
+
+class TracedLock(_TracedBase):
+    """A ``threading.Lock`` that participates in the order graph."""
+
+
+class TracedRLock(_TracedBase):
+    """A ``threading.RLock`` that participates in the order graph.
+
+    Implements the ``Condition`` owner protocol; reentrant re-acquire
+    by the owning thread records no ordering edge."""
+
+    _REENTRANT = True
+
+    def locked(self):
+        # the pure-python _RLock grows .locked() only in 3.13; emulate
+        # from its owner field so the traced lock stays a drop-in
+        inner = self._inner
+        if hasattr(inner, "locked"):
+            return inner.locked()
+        return inner._owner is not None
+
+    # -- Condition protocol -------------------------------------------
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        tracer = _tracer
+        count = state[0] if isinstance(state, tuple) else 1
+        if tracer is not None:
+            for _ in range(count):
+                tracer.on_release(self)
+        return state
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        tracer = _tracer
+        count = state[0] if isinstance(state, tuple) else 1
+        if tracer is not None:
+            for _ in range(count):
+                tracer.on_acquired(self)
+
+
+def Lock(name=None):
+    """An always-traced mutual-exclusion lock."""
+    return TracedLock(name=name)
+
+
+def RLock(name=None):
+    """An always-traced reentrant lock."""
+    return TracedRLock(name=name)
+
+
+# ---------------------------------------------------------------------------
+# global install: patch threading.Lock/RLock for scoped callers
+# ---------------------------------------------------------------------------
+
+_tracer = None
+_saved = None
+
+
+def enabled():
+    """The tier-1 opt-in switch (scripts/check.sh sets it)."""
+    return os.environ.get("EDL_LOCKTRACE") == "1"
+
+
+def _in_scope(scope):
+    filename = sys._getframe(2).f_code.co_filename
+    parts = filename.replace(os.sep, "/")
+    return any("/%s/" % s in parts or parts.startswith(s) for s in scope)
+
+
+def install(scope=DEFAULT_SCOPE):
+    """Start tracing: fresh graph; ``threading.Lock``/``RLock`` return
+    traced locks for callers whose source file lives under ``scope``
+    (real locks otherwise — stdlib/jax/grpc internals stay out of the
+    graph). Idempotent per session; :func:`uninstall` restores."""
+    global _tracer, _saved
+    _tracer = _Tracer()
+    if _saved is None:
+        _saved = (_threading.Lock, _threading.RLock)
+
+        def lock_factory():
+            if _in_scope(scope):
+                return TracedLock(name="Lock@%s" % _site(2))
+            return _REAL_LOCK()
+
+        def rlock_factory():
+            if _in_scope(scope):
+                return TracedRLock(name="RLock@%s" % _site(2))
+            return _REAL_RLOCK()
+
+        _threading.Lock = lock_factory
+        _threading.RLock = rlock_factory
+
+
+def uninstall():
+    """Stop tracing and restore the real lock constructors. Locks
+    created while installed keep working (acquire/release just stops
+    recording once the tracer is gone)."""
+    global _tracer, _saved
+    _tracer = None
+    if _saved is not None:
+        _threading.Lock, _threading.RLock = _saved
+        _saved = None

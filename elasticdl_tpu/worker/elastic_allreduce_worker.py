@@ -394,6 +394,10 @@ class ElasticAllReduceWorker:
                 ps(None, **(get_dict_from_params_str(model_params) or {}))
             )
         except Exception:
+            logger.debug(
+                "model ps() probe failed; assuming PS mode",
+                exc_info=True,
+            )
             return True
 
     def _ckpt_dirs_newest_first(self):
@@ -596,7 +600,8 @@ class ElasticAllReduceWorker:
                 self._worker_id, self._host, awaiting=False
             )
         except Exception:
-            pass  # registration happens via the await loop anyway
+            # registration happens via the await loop anyway
+            logger.debug("pre-registration poll failed", exc_info=True)
         first = self._prime()
         if first is None:
             # no training data ever assigned; still serve eval/save
@@ -606,7 +611,11 @@ class ElasticAllReduceWorker:
             try:
                 self._stub.leave_comm_world(self._worker_id)
             except Exception:
-                pass
+                logger.debug(
+                    "leave announcement missed; the confirm-timeout "
+                    "fencer clears this member",
+                    exc_info=True,
+                )
             self._finalize()
             return losses
         self._retry_batch = first
@@ -628,7 +637,12 @@ class ElasticAllReduceWorker:
                         try:
                             self._stub.leave_comm_world(self._worker_id)
                         except Exception:
-                            pass
+                            logger.debug(
+                                "leave announcement missed; the "
+                                "confirm-timeout fencer clears this "
+                                "member",
+                                exc_info=True,
+                            )
                         break
                     self._retry_batch = example = first
                 self.trainer.establish(world, example_batch=example)
@@ -737,7 +751,10 @@ class ElasticAllReduceWorker:
                         self._worker_id, self._host, awaiting=False
                     )
                 except Exception:
-                    pass
+                    logger.debug(
+                        "liveness beat missed (master busy/unreachable)",
+                        exc_info=True,
+                    )
 
         beater = None
         if self._stub is not None:
@@ -782,6 +799,10 @@ class ElasticAllReduceWorker:
                 self._worker_id, self._host, awaiting=False
             )
         except Exception:
+            logger.debug(
+                "world probe failed; not treating as moved-on",
+                exc_info=True,
+            )
             return False
         dead = set(w.get("dead", ()))
         members = getattr(self, "_world_members", None) or ()
@@ -1069,7 +1090,9 @@ class ElasticAllReduceWorker:
         try:
             total = a2a_overflow_total(ts.state)
         except Exception:
-            return  # mid-failure state; the step error path owns it
+            # mid-failure state; the step error path owns it
+            logger.debug("overflow counter fetch failed", exc_info=True)
+            return
         if total and total > self._overflow_alarmed:
             logger.warning(
                 "embedding a2a capacity overflow: %d ids have read zero "
@@ -1251,6 +1274,11 @@ class ElasticAllReduceWorker:
                 try:
                     loaded_version, tree = load_sharded_to_host(directory)
                 except Exception:
+                    logger.debug(
+                        "eval restore skipped torn checkpoint %s",
+                        directory,
+                        exc_info=True,
+                    )
                     continue
                 self._eval_params = (
                     tree["params"],
@@ -1366,6 +1394,11 @@ class ElasticAllReduceWorker:
                 except Exception:
                     # newest may be mid-write by a peer; older complete
                     # versions are fine for a lagged eval
+                    logger.debug(
+                        "lagged-eval restore skipped %s",
+                        directory,
+                        exc_info=True,
+                    )
                     continue
             if tree is not None:
                 if self._forward_fn is None:
@@ -1507,7 +1540,12 @@ class ElasticAllReduceWorker:
             try:
                 self.report_task_result(task_id, err_msg=str(e))
             except Exception:
-                pass  # master unreachable: its death detection requeues
+                # master unreachable: its death detection requeues
+                logger.debug(
+                    "fail-report for eval task %d also failed",
+                    task_id,
+                    exc_info=True,
+                )
 
     def _start_eval_task(self, task):
         """Materialize one eval task's batches for the lockstep rounds.
@@ -1694,6 +1732,11 @@ class ElasticAllReduceWorker:
                 v, tree = load_sharded_to_host(older)
                 return tree["params"], tree.get("state") or {}, v
             except Exception:
+                logger.debug(
+                    "restore skipped torn checkpoint %s",
+                    older,
+                    exc_info=True,
+                )
                 continue
         return None, None, 0
 

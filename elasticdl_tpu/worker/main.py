@@ -12,6 +12,7 @@ from elasticdl_tpu.common.args import (
     parse_worker_args,
     warn_accum_unsupported,
 )
+from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.master.rpc_service import MasterClient
 from elasticdl_tpu.worker.worker import Worker
 
@@ -170,7 +171,11 @@ def _run(args):
                 if stub is not None:
                     stub.leave_comm_world(worker._worker_id)
             except Exception:
-                pass
+                logger.debug(
+                    "leave announcement missed; the watch dead-lists "
+                    "this exit and survivors reform",
+                    exc_info=True,
+                )
         if worker._preempted:
             # distinct exit code: the instance manager relaunches a
             # replacement (exit 0 would read as "job done for me").

@@ -299,14 +299,19 @@ class TaskDataService:
                 return 0
             return max(0, _task_span(self._inflight[0]) - self._record_cursor)
 
-    def _acknowledge(self, task, err_msg):
-        """Report one finished task (and its failure tally) to the master.
+    def _acknowledge(self, task, err_msg, outbox):
+        """Queue one finished task's acknowledgment (ledger lock held).
 
-        With ``ack_queue_size`` > 0 a SUCCESS ack is queued instead of
-        sent — the RPC moves off the hot loop to the next boundary
-        ``drain_acks`` (or to the inline overflow drain when the queue
-        fills). Failure acks always flush: the master must requeue a
-        failed task promptly, and the flush preserves ack order.
+        Never sends from here: the caller holds the ledger lock, and a
+        master RPC under it would stall the fetcher's round checks and
+        any concurrent spare-park requeue for a full round trip (edlint
+        R5 pinned exactly this chain). With ``ack_queue_size`` > 0 a
+        SUCCESS ack joins the bounded queue drained at boundaries (or
+        on overflow); otherwise it lands in the caller's ``outbox`` and
+        is sent right after the lock is released — the same
+        snapshot-then-release pattern ps/servicer.pull_variable uses.
+        Failure acks still flush promptly: the master must requeue a
+        failed task, and the flush preserves ack order.
         """
         counters = (
             {TaskExecCounterKey.FAIL_COUNT: self._bad_records}
@@ -335,10 +340,7 @@ class TaskDataService:
             if err_msg:
                 self._ack_flush_needed = True
             return
-        with self.stats.timed("ack_s"):
-            self._worker.report_task_result(
-                task.task_id, err_msg, exec_counters=counters
-            )
+        outbox.append((task.task_id, err_msg, counters))
 
     def drain_acks(self):
         """Send every queued task acknowledgment to the master.
@@ -359,8 +361,9 @@ class TaskDataService:
                     task_id, err_msg, exec_counters=counters
                 )
 
-    def _drain_acknowledged(self, err_msg):
-        """Pop + report every ledger task the cursor has moved past.
+    def _drain_acknowledged(self, err_msg, outbox):
+        """Pop every ledger task the cursor has moved past, queueing its
+        ack (bounded ack queue or the caller's ``outbox``).
 
         One batch can straddle several small tasks, so a single cursor
         advance may complete more than one; any failure tally rides out
@@ -371,15 +374,25 @@ class TaskDataService:
         ):
             done = self._inflight.popleft()
             self._record_cursor -= _task_span(done)
-            self._acknowledge(done, err_msg)
+            self._acknowledge(done, err_msg, outbox)
 
     def report_record_done(self, count, err_msg=""):
         """Advance the cursor by ``count`` consumed records."""
+        outbox = []
         with self._ledger_lock:
             self._record_cursor += count
             if err_msg:
                 self._bad_records += count
-            self._drain_acknowledged(err_msg)
+            self._drain_acknowledged(err_msg, outbox)
+        # inline acks go out AFTER the ledger lock is released: the
+        # tasks are already popped, so a racing requeue_inflight cannot
+        # double-report them, and the RPC no longer serializes the
+        # fetcher/requeue paths behind a master round trip
+        for task_id, msg, counters in outbox:
+            with self.stats.timed("ack_s"):
+                self._worker.report_task_result(
+                    task_id, msg, exec_counters=counters
+                )
         if self._ack_queue_size:
             # backpressure OUTSIDE the ledger lock: completed-but-unacked
             # tasks must not pile up in the master's doing-set past the

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One correctness gate for the threaded data plane
+# (docs/static_analysis.md):
+#
+#   1. edlint — the AST concurrency/jit-purity analyzer over the whole
+#      tree, all seven rules, stale-ratchet check on (allowlists may
+#      only shrink);
+#   2. the data-plane suites under EDL_LOCKTRACE=1 — every
+#      threading.Lock/RLock our code takes joins the runtime lock-order
+#      sanitizer (ABBA raises deterministically instead of deadlocking)
+#      and every test asserts no non-daemon thread leaks out.
+#
+# Run from anywhere: ./scripts/check.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== edlint (R1-R7 + stale-ratchet check) =="
+python -m elasticdl_tpu.tools.edlint --stale
+
+echo "== data-plane suites under the lock-order sanitizer =="
+JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
+    tests/test_input_pipeline.py \
+    tests/test_ps_overlap.py \
+    tests/test_async_concurrency.py \
+    tests/test_elastic_pipeline.py \
+    tests/test_locktrace.py \
+    tests/test_edlint.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+
+echo "check.sh: all gates green"

@@ -1,209 +1,35 @@
 #!/usr/bin/env python
-"""Source-pattern guard for the two wedge classes VERDICT r5 root-caused.
+"""(retired to a shim) Source-pattern guard for the r5 wedge classes.
 
-1. ``jax.devices()`` outside ``escapable_call``: probing the device
-   transport in-process with no timeout turns one wedged transport into
-   a hung driver (the r5 grading outage). Every call site must either
-   go through ``common/escapable.escapable_call`` (which the pattern
-   does not match — it passes ``jax.devices`` uncalled) or be an
-   allowlisted in-mesh site that only runs after the backend is
-   established.
+The three regex rules that lived here — unescapable ``jax.devices()``
+probes, unbounded blocking queue puts, and data-plane blocking queue
+gets — are now REAL AST rules R1–R3 of the edlint analyzer
+(``elasticdl_tpu/tools/edlint``, docs/static_analysis.md), which scopes
+them to actual call-sites and actual ``queue.Queue`` receivers instead
+of line patterns. The allowlists migrated, with their reasons, into
+``elasticdl_tpu/tools/edlint/ratchet.py`` — two entries (odps_io put,
+task_data_service put) dropped outright because the AST pass can prove
+those queues are constructed unbounded.
 
-2. Unbounded blocking ``queue.put``: a producer putting into a bounded
-   queue with no timeout+cancel loop blocks forever once its consumer
-   is abandoned (the prefetch leak fixed in data/dataset.py). Every
-   ``.put(`` on a queue must carry ``timeout=`` inside a cancel loop,
-   be ``put_nowait``, or be an allowlisted put into an UNBOUNDED queue
-   (which never blocks).
+This shim keeps the historical entry point (and tests/test_greps_guard)
+working: it delegates to edlint restricted to R1–R3 with the same exit
+contract (0 clean, 1 with a per-violation report).
 
-3. Unbounded blocking ``queue.get`` in the DATA PLANE (data/ and the
-   task data service): a consumer getting with no timeout and no
-   sentinel discipline blocks forever once its producer dies or the
-   round is abandoned — the input-pipeline twin of rule 2
-   (docs/input_pipeline.md). Every queue-ish ``.get(`` there must carry
-   ``timeout=`` inside a cancel loop, be ``get_nowait``, or be an
-   allowlisted get whose producer is guaranteed to deliver a terminal
-   sentinel/exception (the prefetch _END protocol).
-
-The allowlists are ratchets: per-file maximum occurrence counts. New
-code that trips a rule must adopt the safe pattern or consciously
-extend the allowlist here, with a reason, in the same review.
-
-Run: ``python scripts/greps_guard.py [--root REPO_ROOT]``; exit 0 on
-clean, 1 with a per-violation report otherwise. Wired into tier-1 via
-tests/test_greps_guard.py.
+Run: ``python scripts/greps_guard.py [--root REPO_ROOT]``.
 """
 
-import argparse
 import os
-import re
 import sys
-
-# file (repo-relative, posix) -> max allowed occurrences, with why.
-ALLOWED_DEVICES = {
-    # in-mesh sites: run strictly after establish()/backend init, where
-    # a wedge would already have surfaced through the escapable probe
-    "elasticdl_tpu/parallel/elastic.py": 1,
-    "elasticdl_tpu/parallel/mesh.py": 1,
-    "elasticdl_tpu/worker/allreduce_worker.py": 1,
-    # post-probe sites: __graft_entry__ calls these only after the
-    # escapable_call device probe has already verified the transport
-    "__graft_entry__.py": 2,
-    # bench device sections run in subprocesses under section timeouts
-    "bench.py": 3,
-}
-
-ALLOWED_PUTS = {
-    # unbounded queue.Queue(): put never blocks
-    "elasticdl_tpu/common/async_checkpoint.py": 2,
-    "elasticdl_tpu/data/odps_io.py": 1,
-    # Queue(maxsize=1) with exactly one put per producer thread
-    "elasticdl_tpu/common/escapable.py": 2,
-    # _TaskFetcher._offer: unbounded queue (depth bounded by the slot
-    # semaphore the consumer releases), put under the offer lock so no
-    # item can land after shutdown's final drain
-    "elasticdl_tpu/worker/task_data_service.py": 1,
-}
-
-# data-plane files rule 3 applies to
-GET_SCOPE_PREFIXES = ("elasticdl_tpu/data/",)
-GET_SCOPE_FILES = ("elasticdl_tpu/worker/task_data_service.py",)
-
-ALLOWED_GETS = {
-    # prefetch's consumer get: the producer ALWAYS delivers a terminal
-    # _END or exception sentinel through put_or_cancel, so the get
-    # cannot outlive its producer (two sites: plain + stats-timed)
-    "elasticdl_tpu/data/dataset.py": 2,
-}
-
-DEVICES_RE = re.compile(r"\b_?jax\.devices\(\)")
-PUT_RE = re.compile(r"(?:\b(?P<recv>[A-Za-z_][A-Za-z0-9_]*))?\.put\(")
-GET_RE = re.compile(r"\b(?P<recv>[A-Za-z_][A-Za-z0-9_]*)\.get\(")
-
-
-def _queue_ish(recv):
-    """Receiver names that read as a queue (not a dict/cache .get)."""
-    low = recv.lower()
-    return low == "q" or low.endswith("_q") or "queue" in low
-
-
-def iter_source_files(root):
-    yield from (
-        os.path.join(root, name)
-        for name in ("__graft_entry__.py", "bench.py")
-        if os.path.exists(os.path.join(root, name))
-    )
-    pkg = os.path.join(root, "elasticdl_tpu")
-    for dirpath, _, names in os.walk(pkg):
-        if "__pycache__" in dirpath:
-            continue
-        for name in sorted(names):
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def scan_file(path, root):
-    rel = os.path.relpath(path, root).replace(os.sep, "/")
-    with open(path, encoding="utf-8") as f:
-        lines = f.read().splitlines()
-    devices_hits = []
-    put_hits = []
-    get_hits = []
-    in_get_scope = rel in GET_SCOPE_FILES or any(
-        rel.startswith(p) for p in GET_SCOPE_PREFIXES
-    )
-    for i, line in enumerate(lines):
-        m = DEVICES_RE.search(line)
-        if (
-            m
-            and not line.lstrip().startswith("#")
-            # prose mentions in docstrings/comments quote the call in
-            # backticks; only bare code occurrences count
-            and not line[: m.start()].endswith("`")
-        ):
-            devices_hits.append((rel, i + 1, line.strip()))
-        for m in PUT_RE.finditer(line):
-            recv = m.group("recv") or ""
-            if "cache" in recv.lower():
-                continue  # HotRowCache.put and kin: not a queue
-            # the call may wrap: look at this line plus the next two
-            # for the bounding timeout
-            window = " ".join(lines[i : i + 3])
-            if "timeout=" in window:
-                continue
-            put_hits.append((rel, i + 1, line.strip()))
-        if in_get_scope:
-            for m in GET_RE.finditer(line):
-                if not _queue_ish(m.group("recv")):
-                    continue  # dict/kwargs/cache .get, not a queue
-                window = " ".join(lines[i : i + 3])
-                if "timeout=" in window:
-                    continue
-                get_hits.append((rel, i + 1, line.strip()))
-    return devices_hits, put_hits, get_hits
-
-
-def check(root):
-    violations = []
-    devices_counts = {}
-    put_counts = {}
-    get_counts = {}
-    for path in iter_source_files(root):
-        devices_hits, put_hits, get_hits = scan_file(path, root)
-        for rel, lineno, text in devices_hits:
-            devices_counts[rel] = devices_counts.get(rel, 0) + 1
-            if devices_counts[rel] > ALLOWED_DEVICES.get(rel, 0):
-                violations.append(
-                    "%s:%d: jax.devices() outside escapable_call "
-                    "(wedged-transport hang risk): %s"
-                    % (rel, lineno, text)
-                )
-        for rel, lineno, text in put_hits:
-            put_counts[rel] = put_counts.get(rel, 0) + 1
-            if put_counts[rel] > ALLOWED_PUTS.get(rel, 0):
-                violations.append(
-                    "%s:%d: blocking queue put without timeout+cancel "
-                    "(abandoned-consumer leak risk): %s"
-                    % (rel, lineno, text)
-                )
-        for rel, lineno, text in get_hits:
-            get_counts[rel] = get_counts.get(rel, 0) + 1
-            if get_counts[rel] > ALLOWED_GETS.get(rel, 0):
-                violations.append(
-                    "%s:%d: data-plane blocking queue get without "
-                    "timeout/sentinel discipline (dead-producer hang "
-                    "risk): %s" % (rel, lineno, text)
-                )
-    return violations
 
 
 def main(argv=None):
-    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument(
-        "--root",
-        default=os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))
-        ),
-        help="repo root to scan (default: this script's repo)",
-    )
-    args = parser.parse_args(argv)
-    violations = check(args.root)
-    if violations:
-        print("greps_guard: %d violation(s)" % len(violations))
-        for v in violations:
-            print("  " + v)
-        print(
-            "Fix: route device probes through "
-            "common/escapable.escapable_call; bound queue puts with "
-            "timeout= in a cancel loop (see data/dataset.py "
-            "put_or_cancel); bound data-plane queue gets with timeout= "
-            "in a cancel loop (see task_data_service._TaskFetcher."
-            "next_item) or a guaranteed terminal sentinel. Deliberate "
-            "exceptions extend the allowlists in scripts/greps_guard.py "
-            "with a reason."
-        )
-        return 1
-    return 0
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from elasticdl_tpu.tools.edlint.core import main as edlint_main
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    return edlint_main(["--rules", "R1,R2,R3"] + args)
 
 
 if __name__ == "__main__":

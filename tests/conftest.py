@@ -39,3 +39,60 @@ if _n < 8:
         "test bootstrap expected >=8 virtual CPU devices, got %d; a JAX "
         "backend was initialized before conftest could apply XLA_FLAGS" % _n
     )
+
+
+# ---------------------------------------------------------------------------
+# EDL_LOCKTRACE=1: runtime lock-order sanitizer + thread-leak guard
+# ---------------------------------------------------------------------------
+# The data-plane suites opt into the lockdep-style sanitizer
+# (elasticdl_tpu/tools/locktrace.py): every threading.Lock/RLock their
+# code creates joins a global acquisition graph and an ABBA inversion
+# raises LockOrderError at acquire time instead of deadlocking the run.
+# Additionally, EVERY test in a locktraced run asserts that no
+# non-daemon thread it started is still alive at teardown — the
+# leaked-helper-thread class edlint R4 polices statically.
+# scripts/check.sh runs the data-plane suites this way as one gate.
+
+import threading as _conftest_threading
+
+import pytest
+
+_LOCKTRACE_SUITES = {
+    "test_input_pipeline",
+    "test_ps_overlap",
+    "test_async_concurrency",
+    "test_elastic_pipeline",
+    "test_locktrace",
+}
+
+
+@pytest.fixture(autouse=True)
+def _edl_locktrace_and_thread_leak_guard(request):
+    if os.environ.get("EDL_LOCKTRACE") != "1":
+        yield
+        return
+    from elasticdl_tpu.tools import locktrace
+
+    module = request.module.__name__.rsplit(".", 1)[-1]
+    traced = module in _LOCKTRACE_SUITES
+    if traced:
+        locktrace.install()
+    before = set(_conftest_threading.enumerate())
+    try:
+        yield
+    finally:
+        if traced:
+            locktrace.uninstall()
+        leaked = [
+            t
+            for t in _conftest_threading.enumerate()
+            if t not in before and t.is_alive() and not t.daemon
+        ]
+        for t in leaked:
+            t.join(timeout=2.0)
+        leaked = [t.name for t in leaked if t.is_alive()]
+        assert not leaked, (
+            "non-daemon thread(s) leaked out of this test: %s "
+            "(daemonize, join, or shut the owner down — edlint R4)"
+            % ", ".join(leaked)
+        )

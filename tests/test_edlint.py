@@ -1,0 +1,595 @@
+"""Tier-1 wiring + self-tests for the edlint analyzer
+(elasticdl_tpu/tools/edlint, docs/static_analysis.md).
+
+Three layers:
+
+- the tree gate: ``python -m elasticdl_tpu.tools.edlint`` must exit 0
+  over this repo with ALL seven rules active, and every allowlist
+  ratchet entry must carry a reason (the acceptance bar);
+- known-bad fixtures per rule R1–R7, each paired with the safe idiom
+  the rule must NOT flag — the R4/R5/R6 bad fixtures are the REAL
+  pre-fix violations this PR fixed (k8s_client's stop-less watcher,
+  task_data_service's ack RPC reached through two calls under the
+  ledger lock, worker/main's silent leave_comm_world swallow),
+  pinned so the rules keep catching regressions of exactly those
+  shapes;
+- engine mechanics: the ratchet counts per (rule, file) and the
+  ``--stale`` only-shrinks check.
+"""
+
+import os
+import subprocess
+import sys
+
+from elasticdl_tpu.tools.edlint.core import (
+    apply_ratchet,
+    run,
+    scan,
+    stale_entries,
+)
+from elasticdl_tpu.tools.edlint.ratchet import ALLOW
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+_case = [0]
+
+
+def _lint(tmp_path, source, relpath="elasticdl_tpu/fixture.py"):
+    """Rule ids found in ``source`` planted at ``relpath`` of a FRESH
+    scratch tree (one per call, so fixtures never see each other; the
+    ratchet keys on repo paths, so scratch files never hit allowlist
+    budgets)."""
+    _case[0] += 1
+    root = tmp_path / ("case%d" % _case[0])
+    target = root / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(source)
+    findings, broken = scan(str(root))
+    assert not broken, broken
+    violations, _, _ = apply_ratchet(findings)
+    return violations
+
+
+def _rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# the tree gate
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean_under_all_seven_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.tools.edlint", "--stale"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, (
+        "edlint tripped on the tree:\n" + proc.stdout + proc.stderr
+    )
+
+
+def test_every_ratchet_entry_carries_a_reason():
+    assert ALLOW, "ratchet exists"
+    for rule_id, files in ALLOW.items():
+        for path, entry in files.items():
+            assert entry.get("max", 0) > 0, (rule_id, path)
+            reason = entry.get("reason", "")
+            assert isinstance(reason, str) and len(reason) > 20, (
+                "allowlist entry without a substantive reason: "
+                "%s %s" % (rule_id, path)
+            )
+
+
+def test_greps_guard_shim_message_compat(tmp_path):
+    """The retired regex guard's report vocabulary survives in R1/R2
+    (tests/test_greps_guard.py pins the subprocess contract)."""
+    violations = _lint(
+        tmp_path,
+        "import jax\nimport queue\n"
+        "def probe():\n"
+        "    return jax.devices()\n"
+        "def feed(q, item):\n"
+        "    q.put(item)\n",
+    )
+    messages = "\n".join(v.message for v in violations)
+    assert "jax.devices() outside escapable_call" in messages
+    assert "queue put without timeout+cancel" in messages
+
+
+# ---------------------------------------------------------------------------
+# R1 — device probe
+# ---------------------------------------------------------------------------
+
+
+def test_r1_flags_calls_but_not_the_escapable_passthrough(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import jax\n"
+        "def probe():\n"
+        "    return len(jax.devices())\n",
+    )
+    assert _rules_of(bad) == ["R1"]
+    good = _lint(
+        tmp_path,
+        "import jax\n"
+        "from elasticdl_tpu.common.escapable import escapable_call\n"
+        "def probe():\n"
+        "    # jax.devices passes UNCALLED: the safe idiom the old\n"
+        "    # regex needed a backtick heuristic to avoid flagging\n"
+        "    return escapable_call(jax.devices, timeout=30)\n",
+    )
+    assert not good
+
+
+# ---------------------------------------------------------------------------
+# R2 — queue put discipline
+# ---------------------------------------------------------------------------
+
+
+def test_r2_receiver_typing_and_boundedness(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import queue\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._jobs = queue.Queue(maxsize=4)\n"
+        "    def feed(self, item):\n"
+        "        self._jobs.put(item)\n",
+    )
+    assert _rules_of(bad) == ["R2"], bad
+    good = _lint(
+        tmp_path,
+        "import queue\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        # unbounded: put never blocks — safe BY CONSTRUCTION,\n"
+        "        # no allowlist entry needed (the regex guard had to\n"
+        "        # ratchet exactly this shape by hand)\n"
+        "        self._jobs = queue.Queue()\n"
+        "    def feed(self, item, cancel, q):\n"
+        "        self._jobs.put(item)\n"
+        "        while not cancel.is_set():\n"
+        "            try:\n"
+        "                q.put(item, timeout=0.5)\n"
+        "                return True\n"
+        "            except queue.Full:\n"
+        "                continue\n"
+        "        return False\n"
+        "    def cache_fill(self, cache, k, v):\n"
+        "        cache.put(k, v)\n",
+    )
+    assert not good
+
+
+# ---------------------------------------------------------------------------
+# R3 — data-plane queue get discipline
+# ---------------------------------------------------------------------------
+
+
+def test_r3_scoped_to_data_plane_with_receiver_typing(tmp_path):
+    src = (
+        "import queue\n"
+        "def consume(opts):\n"
+        "    q = queue.Queue(maxsize=1)\n"
+        "    item = q.get()\n"
+        "    mode = opts.get('mode')\n"  # dict .get: not a queue
+        "    return item, mode\n"
+    )
+    bad = _lint(tmp_path, src, relpath="elasticdl_tpu/data/fixture.py")
+    assert _rules_of(bad) == ["R3"], bad
+    assert len(bad) == 1  # the dict .get did not count
+    # identical code OUTSIDE the data plane is out of R3's scope
+    assert not _lint(
+        tmp_path, src, relpath="elasticdl_tpu/master/fixture.py"
+    )
+    good = _lint(
+        tmp_path,
+        "import queue\n"
+        "def consume(cancel):\n"
+        "    q = queue.Queue(maxsize=1)\n"
+        "    while not cancel.is_set():\n"
+        "        try:\n"
+        "            return q.get(timeout=0.2)\n"
+        "        except queue.Empty:\n"
+        "            continue\n"
+        "    return q.get_nowait()\n",
+        relpath="elasticdl_tpu/data/fixture.py",
+    )
+    assert not good
+
+
+# ---------------------------------------------------------------------------
+# R4 — thread lifecycle (real pre-fix violation: k8s_client's watcher)
+# ---------------------------------------------------------------------------
+
+R4_PREFIX_VIOLATION = """
+import threading
+
+class Client:
+    # pre-fix common/k8s_client.py: fire-and-forget daemon watcher,
+    # no stop/close path anywhere on the owning class — the stream
+    # thread could only be abandoned, never collected
+    def __init__(self, event_cb):
+        self._event_cb = event_cb
+        threading.Thread(
+            target=self._watch, name="event_watcher", daemon=True
+        ).start()
+
+    def _watch(self):
+        while True:
+            self._event_cb()
+"""
+
+R4_FIXED = """
+import threading
+
+class Client:
+    # the fix that shipped: the thread is held, and close() gives the
+    # class a shutdown path
+    def __init__(self, event_cb):
+        self._event_cb = event_cb
+        self._watch_thread = threading.Thread(
+            target=self._watch, name="event_watcher", daemon=True
+        )
+        self._watch_thread.start()
+
+    def _watch(self):
+        while True:
+            self._event_cb()
+
+    def close(self):
+        self._watch_thread.join(timeout=5.0)
+"""
+
+
+def test_r4_pins_the_prefix_k8s_watcher_violation(tmp_path):
+    assert _rules_of(_lint(tmp_path, R4_PREFIX_VIOLATION)) == ["R4"]
+    assert not _lint(tmp_path, R4_FIXED)
+
+
+def test_r4_non_daemon_thread_must_be_joined(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import threading\n"
+        "def fire(fn):\n"
+        "    threading.Thread(target=fn).start()\n",
+    )
+    assert _rules_of(bad) == ["R4"]
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "def run(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+        "    t.join()\n",
+    )
+    assert not good
+
+
+def test_r4_cancel_event_counts_as_shutdown_path(tmp_path):
+    # the Dataset.prefetch idiom: generator finally sets the
+    # producer's cancel event — a cancel path without a method name
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "class D:\n"
+        "    def stream(self):\n"
+        "        cancel = threading.Event()\n"
+        "        def produce():\n"
+        "            while not cancel.is_set():\n"
+        "                pass\n"
+        "        t = threading.Thread(target=produce, daemon=True)\n"
+        "        t.start()\n"
+        "        try:\n"
+        "            yield 1\n"
+        "        finally:\n"
+        "            cancel.set()\n",
+    )
+    assert not good
+
+
+def test_r4_executor_must_be_shut_down(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._pool = ThreadPoolExecutor(max_workers=2)\n",
+    )
+    assert _rules_of(bad) == ["R4"]
+    good = _lint(
+        tmp_path,
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._pool = ThreadPoolExecutor(max_workers=2)\n"
+        "    def close(self):\n"
+        "        self._pool.shutdown(wait=True)\n",
+    )
+    assert not good
+
+
+# ---------------------------------------------------------------------------
+# R5 — blocking under lock (real pre-fix violation: the ack RPC chain)
+# ---------------------------------------------------------------------------
+
+R5_PREFIX_VIOLATION = """
+import threading
+
+class TaskDataService:
+    # pre-fix worker/task_data_service.py: report_record_done held the
+    # ledger lock across _drain_acknowledged -> _acknowledge -> the
+    # report_task_result MASTER RPC — a full round trip serializing
+    # the fetcher's round checks and any concurrent spare-park requeue.
+    # Lexically the RPC is two calls deep: only the transitive pass
+    # sees it.
+    def __init__(self, worker):
+        self._worker = worker
+        self._ledger_lock = threading.Lock()
+        self._inflight = []
+
+    def report_record_done(self, count):
+        with self._ledger_lock:
+            self._drain_acknowledged()
+
+    def _drain_acknowledged(self):
+        while self._inflight:
+            self._acknowledge(self._inflight.pop())
+
+    def _acknowledge(self, task):
+        self._worker.report_task_result(task, "")
+"""
+
+R5_FIXED = """
+import threading
+
+class TaskDataService:
+    # the fix that shipped: snapshot under the lock, send after release
+    def __init__(self, worker):
+        self._worker = worker
+        self._ledger_lock = threading.Lock()
+        self._inflight = []
+
+    def report_record_done(self, count):
+        outbox = []
+        with self._ledger_lock:
+            self._drain_acknowledged(outbox)
+        for task in outbox:
+            self._worker.report_task_result(task, "")
+
+    def _drain_acknowledged(self, outbox):
+        while self._inflight:
+            outbox.append(self._inflight.pop())
+"""
+
+
+def test_r5_pins_the_prefix_ack_rpc_chain(tmp_path):
+    bad = _lint(tmp_path, R5_PREFIX_VIOLATION)
+    assert _rules_of(bad) == ["R5"]
+    assert "report_task_result" in bad[0].message  # names the sink
+    assert not _lint(tmp_path, R5_FIXED)
+
+
+def test_r5_direct_blocking_forms(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import threading, time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n",
+    )
+    assert _rules_of(bad) == ["R5"]
+
+
+def test_r5_sees_acquire_try_finally_release_regions(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import threading, time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def step(self):\n"
+        "        self._lock.acquire()\n"
+        "        try:\n"
+        "            time.sleep(0.5)\n"
+        "        finally:\n"
+        "            self._lock.release()\n",
+    )
+    assert _rules_of(bad) == ["R5"]
+
+
+def test_r5_condition_wait_under_its_own_lock_is_fine(tmp_path):
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def step(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait(timeout=1.0)\n",
+    )
+    assert not good
+
+
+# ---------------------------------------------------------------------------
+# R6 — silent broad except (real pre-fix violation: worker/main's
+# swallowed leave announcement)
+# ---------------------------------------------------------------------------
+
+R6_PREFIX_VIOLATION = """
+def announce_leave(stub, worker_id):
+    # pre-fix worker/main.py: a missed leave announcement vanished —
+    # nothing in any log tied a later spurious reform to this miss
+    try:
+        if stub is not None:
+            stub.leave_comm_world(worker_id)
+    except Exception:
+        pass
+"""
+
+R6_FIXED = """
+import logging
+logger = logging.getLogger(__name__)
+
+def announce_leave(stub, worker_id):
+    try:
+        if stub is not None:
+            stub.leave_comm_world(worker_id)
+    except Exception:
+        logger.debug("leave announcement missed", exc_info=True)
+"""
+
+
+def test_r6_pins_the_prefix_silent_swallow(tmp_path):
+    assert _rules_of(_lint(tmp_path, R6_PREFIX_VIOLATION)) == ["R6"]
+    assert not _lint(tmp_path, R6_FIXED)
+
+
+def test_r6_narrowed_types_pass(tmp_path):
+    good = _lint(
+        tmp_path,
+        "def load_native():\n"
+        "    try:\n"
+        "        import ctypes\n"
+        "        return ctypes\n"
+        "    except (ImportError, OSError):\n"
+        "        pass\n"
+        "    return None\n",
+    )
+    assert not good
+
+
+def test_r6_reraise_and_real_work_pass(tmp_path):
+    good = _lint(
+        tmp_path,
+        "def f(x):\n"
+        "    try:\n"
+        "        return 1 / x\n"
+        "    except Exception:\n"
+        "        raise RuntimeError('bad x') from None\n"
+        "def g(x, fallback):\n"
+        "    try:\n"
+        "        return 1 / x\n"
+        "    except Exception:\n"
+        "        return fallback(x)\n",
+    )
+    assert not good
+
+
+# ---------------------------------------------------------------------------
+# R7 — jit purity
+# ---------------------------------------------------------------------------
+
+R7_BAD = """
+import jax
+
+class Trainer:
+    def make_step(self, opt):
+        def step(ts, batch):
+            # host side effects inside traced code: the print fires
+            # once per TRACE (then silently never again), and the
+            # self-mutation records only the tracer's abstract value
+            print("step", ts.version)
+            self.last_batch = batch
+            return opt.update(ts, batch)
+        return jax.jit(step, donate_argnums=(0,))
+"""
+
+R7_GOOD = """
+import jax
+import jax.numpy as jnp
+
+def make_step(opt):
+    def step(ts, batch):
+        jax.debug.print("step {v}", v=ts.version)  # trace-aware: fine
+        loss = jnp.sum(batch)
+        return opt.update(ts, batch), loss
+    return jax.jit(step, donate_argnums=(0,))
+
+@jax.jit
+def fwd(params, x):
+    return params @ x
+"""
+
+
+def test_r7_flags_host_effects_in_traced_functions(tmp_path):
+    bad = _lint(tmp_path, R7_BAD)
+    assert _rules_of(bad) == ["R7"]
+    assert not _lint(tmp_path, R7_GOOD)
+
+
+def test_r7_sees_decorator_and_shard_map_forms(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import jax, functools, logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(ts, batch):\n"
+        "    logger.info('stepping %s', ts)\n"
+        "    return ts\n"
+        "def build(mesh, shard_map):\n"
+        "    def body(tree):\n"
+        "        global _seen\n"
+        "        _seen = tree\n"
+        "        return tree\n"
+        "    return jax.jit(shard_map(body, mesh=mesh))\n",
+    )
+    assert _rules_of(bad) == ["R7"]
+    assert len(bad) == 2
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_ratchet_counts_per_rule_and_file(tmp_path):
+    (tmp_path / "elasticdl_tpu").mkdir()
+    (tmp_path / "elasticdl_tpu" / "two.py").write_text(
+        "import jax\n"
+        "def a():\n"
+        "    return jax.devices()\n"
+        "def b():\n"
+        "    return jax.devices()\n"
+    )
+    findings, _ = scan(str(tmp_path))
+    allow = {
+        "R1": {
+            "elasticdl_tpu/two.py": {"max": 1, "reason": "test budget"}
+        }
+    }
+    violations, counts, allowed = apply_ratchet(findings, allow=allow)
+    assert counts[("R1", "elasticdl_tpu/two.py")] == 2
+    assert len(allowed) == 1 and len(violations) == 1
+    # the ratchet suppresses in line order: the SECOND site is the
+    # violation, so a new site past the budget always surfaces
+    assert violations[0].lineno > allowed[0].lineno
+
+
+def test_stale_entries_enforce_only_shrinks(tmp_path):
+    (tmp_path / "elasticdl_tpu").mkdir()
+    (tmp_path / "elasticdl_tpu" / "one.py").write_text(
+        "import jax\n"
+        "def a():\n"
+        "    return jax.devices()\n"
+    )
+    allow = {
+        "R1": {
+            "elasticdl_tpu/one.py": {"max": 3, "reason": "too wide"},
+            "elasticdl_tpu/gone.py": {"max": 1, "reason": "deleted"},
+        }
+    }
+    _, counts, _ = run(str(tmp_path), allow=allow)
+    stale = stale_entries(counts, allow=allow)
+    assert ("R1", "elasticdl_tpu/one.py", 1, 3) in stale
+    assert ("R1", "elasticdl_tpu/gone.py", 0, 1) in stale

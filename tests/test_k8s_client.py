@@ -62,6 +62,9 @@ class FakeCoreV1Api:
     def delete_namespaced_pod(self, name, namespace, body=None):
         self.pods.pop(name, None)
 
+    def list_namespaced_pod(self, namespace, label_selector=None):
+        return SimpleNamespace(items=list(self.pods.values()))
+
 
 _CORE = [None]
 
@@ -92,6 +95,41 @@ def _client(**kw):
     kw.setdefault("namespace", "default")
     kw.setdefault("job_name", "job1")
     return Client(**kw)
+
+
+def test_watch_stream_stops_and_joins_on_close(fake_kube):
+    """close() must stop the pod-event Watch and collect its thread —
+    the R4 fix for the previously stop-less fire-and-forget watcher
+    (k8s_instance_manager.stop_relaunch_and_remove_all_pods calls it)."""
+    import time
+
+    created = []
+
+    class _FakeWatch:
+        def __init__(self):
+            self.stopped = False
+            created.append(self)
+
+        def stream(self, fn, namespace, label_selector=None):
+            yield {"type": "ADDED"}
+            while not self.stopped:
+                time.sleep(0.01)
+
+        def stop(self):
+            self.stopped = True
+
+    sys.modules["kubernetes.watch"].Watch = _FakeWatch
+    events = []
+    c = _client(event_callback=events.append)
+    deadline = time.time() + 5.0
+    while not events and time.time() < deadline:
+        time.sleep(0.01)
+    assert events == [{"type": "ADDED"}]
+    thread = c._watch_thread
+    c.close()
+    assert created and created[0].stopped
+    assert thread is not None and not thread.is_alive()
+    c.close()  # idempotent
 
 
 def test_worker_pod_labels_resources_and_tpu_mapping(fake_kube):

@@ -27,6 +27,12 @@ class FakeK8sClient:
         self.deleted = []
         self.services = []
         self.labels = {}
+        self.closed = False
+
+    def close(self):
+        # mirrors K8sClient.close(): stop_relaunch_and_remove_all_pods
+        # shuts the pod-event watch down once relaunch is off
+        self.closed = True
 
     def _pod(self, name):
         return SimpleNamespace(

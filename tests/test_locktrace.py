@@ -1,0 +1,196 @@
+"""The runtime lock-order sanitizer (elasticdl_tpu/tools/locktrace.py).
+
+The load-bearing pair: the ABBA interleaving is a REAL deadlock with
+raw locks (both arms time out acquiring their second lock), and the
+SAME interleaving under the sanitizer becomes exactly one
+deterministic :class:`LockOrderError` raised at acquire time — no
+thread left blocked. Plus: a three-lock cycle built sequentially by a
+single thread (potential deadlocks are flagged, not just realized
+ones), the reentrant-RLock false-positive guard, and the
+Condition-protocol compatibility of the traced RLock.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.tools import locktrace
+from elasticdl_tpu.tools.locktrace import LockOrderError
+
+
+@pytest.fixture
+def traced():
+    """Tracing on for the test body, always restored."""
+    locktrace.install()
+    try:
+        yield
+    finally:
+        locktrace.uninstall()
+
+
+def _run_abba(lock_a, lock_b, second_timeout=None, join_timeout=10.0):
+    """Drive the canonical ABBA interleaving to its crossing point.
+
+    Each arm takes its first lock, proves it via an event, waits for
+    the OTHER arm's proof, then goes for its second lock — so both
+    arms are guaranteed to be holding one lock and wanting the other
+    at the same moment. Returns (second-acquire outcomes, order
+    errors, threads)."""
+    e1, e2 = threading.Event(), threading.Event()
+    results, errors = [], []
+
+    def arm(first, second, mine, theirs, label):
+        try:
+            with first:
+                mine.set()
+                theirs.wait(5.0)
+                if second_timeout is not None:
+                    got = second.acquire(timeout=second_timeout)
+                    if got:
+                        second.release()
+                    results.append((label, got))
+                else:
+                    with second:
+                        results.append((label, True))
+        except LockOrderError as err:
+            errors.append((label, err))
+
+    threads = [
+        threading.Thread(
+            target=arm, args=(lock_a, lock_b, e1, e2, "t1"), daemon=True
+        ),
+        threading.Thread(
+            target=arm, args=(lock_b, lock_a, e2, e1, "t2"), daemon=True
+        ),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(join_timeout)
+    return results, errors, threads
+
+
+def test_abba_repro_deadlocks_raw_but_raises_under_locktrace():
+    """THE acceptance repro, driven through plain ``threading.Lock()``.
+
+    Without ``EDL_LOCKTRACE=1`` the interleaving is a genuine deadlock
+    — both arms sit holding one lock wanting the other until the
+    bounded second acquire gives up (remove the timeout and the test
+    hangs forever). With ``EDL_LOCKTRACE=1`` the conftest fixture has
+    installed the sanitizer for this suite, so the SAME code — no
+    edits — gets exactly one deterministic LockOrderError at acquire
+    time and the other arm completes."""
+    t0 = time.monotonic()
+    results, errors, threads = _run_abba(
+        threading.Lock(), threading.Lock(), second_timeout=1.0
+    )
+    assert not any(t.is_alive() for t in threads)
+    if locktrace.enabled():
+        assert len(errors) == 1, errors
+        assert [got for _, got in results] == [True]
+        assert "lock-order inversion" in str(errors[0][1])
+    else:
+        assert not errors
+        assert sorted(results) == [("t1", False), ("t2", False)], (
+            "expected both arms to time out on their second lock "
+            "(the ABBA deadlock), got %r" % (results,)
+        )
+        assert time.monotonic() - t0 >= 1.0  # they truly waited it out
+
+
+def test_abba_becomes_one_deterministic_raise(traced):
+    """Same interleaving, traced locks, UNBOUNDED second acquire: the
+    second thread to cross gets LockOrderError before blocking, its
+    first lock releases on unwind, and the other arm completes — no
+    deadlock, no timeout discipline needed."""
+    results, errors, threads = _run_abba(
+        locktrace.Lock("A"), locktrace.Lock("B")
+    )
+    assert not any(t.is_alive() for t in threads), (
+        "sanitized ABBA must not hang"
+    )
+    assert len(errors) == 1, errors
+    assert len(results) == 1, results
+    msg = str(errors[0][1])
+    assert "lock-order inversion" in msg
+    assert "A" in msg and "B" in msg
+
+
+def test_three_lock_cycle_is_flagged_sequentially(traced):
+    """A -> B, B -> C, then C -> A closes the cycle. One thread, never
+    actually blocked: the sanitizer flags POTENTIAL deadlocks from the
+    cumulative graph, not just realized interleavings."""
+    a, b, c = (
+        locktrace.Lock("A"),
+        locktrace.Lock("B"),
+        locktrace.Lock("C"),
+    )
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(LockOrderError) as err:
+            with a:
+                pass
+    assert "A -> B -> C" in str(err.value)
+
+
+def test_reentrant_rlock_is_not_a_false_positive(traced):
+    r = locktrace.RLock("R")
+    with r:
+        with r:
+            with r:
+                pass
+    # and a repeated consistent order stays silent
+    m = locktrace.Lock("M")
+    for _ in range(2):
+        with r:
+            with m:
+                pass
+
+
+def test_traced_rlock_supports_condition_protocol(traced):
+    cond = threading.Condition(locktrace.RLock("cond-lock"))
+    box = []
+
+    def consumer():
+        with cond:
+            while not box:
+                if not cond.wait(timeout=5.0):
+                    return
+            box.append("seen")
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        box.append("item")
+        cond.notify()
+    t.join(5.0)
+    assert not t.is_alive()
+    assert box == ["item", "seen"]
+
+
+def test_basic_lock_semantics_preserved(traced):
+    lk = locktrace.Lock("plain")
+    assert lk.acquire(timeout=1.0)
+    assert lk.locked()
+    lk.release()
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+    # non-blocking try-acquire bypasses the graph (cannot deadlock)
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_uninstall_restores_real_constructors():
+    locktrace.install()
+    locktrace.uninstall()
+    assert threading.Lock is not None
+    lk = threading.Lock()
+    assert not isinstance(lk, locktrace.TracedLock)

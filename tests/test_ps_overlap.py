@@ -82,10 +82,18 @@ def test_fanout_error_is_deterministic_lowest_shard():
         BoomPS(inners[2]),  # shard 2: Boom
     ]
     client = PSClient(stubs, fanout=True)
-    with pytest.raises(ShardKilledError):
-        client.pull_embedding_vectors("emb", np.arange(9, dtype=np.int64))
-    # shard 0's leg completed even though the call failed overall
-    assert len(stubs[0].calls) == 1
+    try:
+        with pytest.raises(ShardKilledError):
+            client.pull_embedding_vectors(
+                "emb", np.arange(9, dtype=np.int64)
+            )
+        # shard 0's leg completed even though the call failed overall
+        assert len(stubs[0].calls) == 1
+    finally:
+        # the captured exception pins this frame (and the client) via
+        # its traceback, so pool GC can't collect the fan-out threads —
+        # the locktrace leak guard rightly flags that without close()
+        client.close()
 
 
 def test_push_gradient_combines_all_shards_not_last():
@@ -170,13 +178,16 @@ def test_async_push_surfaces_shard_death_at_reap():
     inners = [TablePS()]
     stubs = [FaultyPS(inners[0], kill_after=1)]
     client = PSClient(stubs, push_inflight=1)
-    client.push_gradient({"w": np.ones((1,), np.float32)}, [], 0)  # ok
-    client.drain()
-    client.push_gradient({"w": np.ones((1,), np.float32)}, [], 1)
-    with pytest.raises(ShardKilledError):
+    try:
+        client.push_gradient({"w": np.ones((1,), np.float32)}, [], 0)
         client.drain()
-    # a later drain is clean: the failed push left the window
-    assert client.drain() == (True, 1)
+        client.push_gradient({"w": np.ones((1,), np.float32)}, [], 1)
+        with pytest.raises(ShardKilledError):
+            client.drain()
+        # a later drain is clean: the failed push left the window
+        assert client.drain() == (True, 1)
+    finally:
+        client.close()  # see test_fanout_error: traceback pins the pool
 
 
 def test_async_push_reports_late_rejection_on_drain():
