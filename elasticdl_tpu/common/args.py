@@ -408,6 +408,17 @@ def add_common_args_between_master_and_worker(parser):
         "overflow). Failure acks always flush immediately. 0 restores "
         "synchronous per-task acks",
     )
+    add_bool_param(
+        parser,
+        "--speculative_compile",
+        False,
+        "Elastic allreduce plane: AOT-compile the train step for likely "
+        "next world sizes (current±1 and membership-service hints) on a "
+        "background thread during steady-state training, so a resize to "
+        "a pre-compiled size pays state re-placement only; pair with "
+        "EDL_COMPILE_CACHE_DIR so relaunched processes skip XLA "
+        "compiles too (docs/compile_plane.md)",
+    )
     parser.add_argument(
         "--loss_log_steps",
         type=non_neg_int,

@@ -528,4 +528,11 @@ class MembershipService:
                 "process_id": ids.index(worker_id),
                 "members": ids,
                 "dead": sorted(self._dead),
+                # size hint for the workers' speculative compile plane:
+                # the head count the next growth bump would form (live
+                # members + lobby joiners). The epoch itself still
+                # governs membership — this is advisory only, and a
+                # hinted size that never materializes costs one dropped
+                # background compile (docs/compile_plane.md).
+                "live": len(self._live) + len(self._lobby),
             }

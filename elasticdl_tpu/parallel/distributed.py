@@ -71,9 +71,24 @@ def world_init_timeout():
 
 _active_spec = None
 
+# Monotonic count of backend teardowns in this process. Any compiled
+# executable (or cached jitted callable bound to concrete devices) minted
+# before the latest bump holds dead device handles; the compile plane's
+# ExecutableCache keys on this so stale entries are evicted, never reused.
+_backend_epoch = 0
+
 
 def current_spec():
     return _active_spec
+
+
+def backend_epoch():
+    return _backend_epoch
+
+
+def _bump_backend_epoch():
+    global _backend_epoch
+    _backend_epoch += 1
 
 
 def _configure_platform():
@@ -122,6 +137,7 @@ def _clear_backends():
         clear_backends = getattr(jax, "clear_backends", None)
     if clear_backends is not None:
         clear_backends()
+    _bump_backend_epoch()
 
 
 def ensure_world(spec, init_timeout=None):
@@ -141,6 +157,16 @@ def ensure_world(spec, init_timeout=None):
     import jax
 
     _configure_platform()
+    # persistent compile cache (EDL_COMPILE_CACHE_DIR): re-formed worlds
+    # drop every backend, so each world's first compile of an
+    # already-seen step otherwise pays full XLA compile again; the
+    # disk cache is keyed on the HLO and survives both re-forms and
+    # process relaunches (docs/compile_plane.md)
+    from elasticdl_tpu.parallel.compile_plane import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
     if init_timeout is None:
         # short by design: members only enter the barrier after the
         # master's two-phase confirm (everyone alive and polling), so a

@@ -58,6 +58,20 @@ class AllReduceTrainer:
         self._seed = seed
         self._param_specs = param_specs
         self._sharded_paths = {}
+        # the persistent compile cache covers this plane too: a
+        # restarted local job re-jits the identical step HLO, which the
+        # disk cache (EDL_COMPILE_CACHE_DIR) satisfies without an XLA
+        # compile (docs/compile_plane.md)
+        from elasticdl_tpu.parallel.compile_plane import (
+            enable_persistent_cache,
+        )
+
+        # probe_backend: this single-process trainer touches the backend
+        # at construction anyway (create_mesh below), so asking it
+        # directly catches an accelerator-less box jax lands on CPU
+        # implicitly — where a cache-reloaded donated executable would
+        # crash (see enable_persistent_cache)
+        enable_persistent_cache(probe_backend=True)
         self._step_fn = make_train_step(
             module,
             loss_fn,
@@ -177,6 +191,8 @@ class AllReduceTrainer:
         device-to-device (ICI/DMA) where it can, instead of a forced
         full HBM -> host -> HBM round trip of every parameter.
         """
+        from elasticdl_tpu.utils import profiling
+
         old_ts = self._ts
         self._mesh = create_mesh(devices=devices)
         logger.info(
@@ -185,7 +201,13 @@ class AllReduceTrainer:
         )
         if old_ts is not None:
             self._sharded_paths = self._collect_sharded_paths()
-            self._ts = self._place(old_ts)
+            # the step fn object is reused across resizes, so stepping
+            # again at a previously-seen device set hits jax's aval
+            # cache (no retrace/recompile); only the state re-placement
+            # below is per-resize work — annotated so it separates in
+            # traces
+            with profiling.annotate("allreduce/resize/replace"):
+                self._ts = self._place(old_ts)
 
     def get_host_state(self):
         """Pull the train state to host memory (for checkpointing)."""

@@ -12,6 +12,10 @@ them on without plumbing:
   (pre/post optimization) for compiler-level inspection.
 - :func:`step_timer` — lightweight wall-clock step statistics when a full
   trace is too heavy (the bench uses it for its profile line).
+- :data:`counters` — a process-wide named-counter registry
+  (:class:`Counters`); the compile plane threads its cache hit/miss and
+  compile-time numbers through it so workers, bench sections, and tests
+  all read one surface.
 
 Env toggles (read by workers at startup): ``EDL_PROFILE_DIR`` enables
 tracing into that directory; ``EDL_XLA_DUMP_DIR`` enables HLO dumps.
@@ -19,6 +23,7 @@ tracing into that directory; ``EDL_XLA_DUMP_DIR`` enables HLO dumps.
 
 import contextlib
 import os
+import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
@@ -112,6 +117,49 @@ def maybe_start_trace():
 
 def maybe_stop_trace():
     _stop()
+
+
+class Counters:
+    """Process-wide named counters (int or float accumulators).
+
+    Cheap enough for hot-path increments (one small lock, no device
+    interaction); consumers read a consistent copy via
+    :meth:`snapshot`. Namespacing is by convention:
+    ``"compile_plane/hits"``, ``"compile_plane/aot_compile_s"``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def inc(self, name, value=1):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + value
+
+    def get(self, name, default=0):
+        with self._lock:
+            return self._counts.get(name, default)
+
+    def snapshot(self, prefix=None):
+        with self._lock:
+            if prefix is None:
+                return dict(self._counts)
+            return {
+                k: v
+                for k, v in self._counts.items()
+                if k.startswith(prefix)
+            }
+
+    def reset(self, prefix=None):
+        with self._lock:
+            if prefix is None:
+                self._counts.clear()
+            else:
+                for k in [k for k in self._counts if k.startswith(prefix)]:
+                    del self._counts[k]
+
+
+counters = Counters()
 
 
 class step_timer:

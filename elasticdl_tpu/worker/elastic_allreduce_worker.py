@@ -53,6 +53,10 @@ from elasticdl_tpu.parallel.distributed import WorldSpec, WorldBroken
 from elasticdl_tpu.parallel.elastic import ElasticDPTrainer
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
+# distinguishes "no batch peeked ahead" from "peeked the stream's None
+# WAIT signal" in the H2D-overlap lookahead
+_NO_PEEK = object()
+
 
 class ElasticAllReduceWorker:
     def __init__(
@@ -83,6 +87,7 @@ class ElasticAllReduceWorker:
         remat="",
         replica_refresh_steps=8,
         task_prefetch=0,
+        speculative_compile=False,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -271,6 +276,20 @@ class ElasticAllReduceWorker:
         # 0 disables. The flag reaches every rank identically via the
         # arg relay, which the collective refresh relies on.
         self.trainer.mirror_steps = max(0, int(replica_refresh_steps))
+        # compile-plane fast path (docs/compile_plane.md): the fixed
+        # minibatch lets speculative AOT compiles derive the exact batch
+        # shapes a future establish will step with; the persistent
+        # compile cache (EDL_COMPILE_CACHE_DIR) makes relaunched
+        # processes and re-formed worlds skip XLA compiles they have
+        # paid before
+        self.trainer.default_minibatch_size = minibatch_size
+        self.trainer.speculative_compile = bool(speculative_compile)
+        from elasticdl_tpu.parallel.compile_plane import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache()
+        self._last_size_hint = 0
         # escapable sync waits: a peer death can wedge this rank's fetch
         # forever (gloo listener-side hang); the trainer polls this hook
         # while waiting so a wedged rank notices the master has moved
@@ -319,6 +338,10 @@ class ElasticAllReduceWorker:
         self._last_ckpt_version = 0
         self._batch_gen = None
         self._retry_batch = None
+        # one-batch lookahead for the H2D overlap: _NO_PEEK means
+        # nothing peeked (a peeked None is the stream's WAIT signal and
+        # must be delivered, not re-pulled)
+        self._staged_peek = _NO_PEEK
         self._unreported = []  # counts of consumed-but-unvalidated steps
         self._drained = False
         self._forward_fn = None
@@ -472,6 +495,11 @@ class ElasticAllReduceWorker:
         if self._retry_batch is not None:
             batch, self._retry_batch = self._retry_batch, None
             return batch
+        if self._staged_peek is not _NO_PEEK:
+            # the H2D-overlap lookahead already pulled this item (and
+            # its placement may be staging on the feeder thread)
+            batch, self._staged_peek = self._staged_peek, _NO_PEEK
+            return batch
         if self._drained:
             return None
         try:
@@ -480,6 +508,33 @@ class ElasticAllReduceWorker:
             self._drained = True
             return None
         return batch
+
+    def _peek_and_stage_next(self):
+        """Pull batch N+1 and hand it to the trainer's feeder thread so
+        its H2D placement overlaps the sync-point cadence work
+        (checkpoint save, eval rounds, mirror refresh) and the next
+        step's dispatch. Called ONLY after _flush_unreported has settled
+        the ledger: a round boundary crossed here then sees every
+        consumed record reported — the same state the unpeeked loop's
+        next _next_batch call would cross it with. The peeked item (a
+        None WAIT signal included) is delivered by the next _next_batch
+        call, so the stream's semantics are byte-identical."""
+        if (
+            self._staged_peek is not _NO_PEEK
+            or self._retry_batch is not None
+            or self._drained
+        ):
+            return
+        try:
+            peek = next(self._batch_gen)
+        except StopIteration:
+            self._drained = True
+            return
+        self._staged_peek = peek
+        if peek is not None:
+            self.trainer.stage_next(
+                peek[0], peek[1], self._minibatch_size
+            )
 
     # -- membership ---------------------------------------------------------
 
@@ -538,6 +593,8 @@ class ElasticAllReduceWorker:
         )
         msg = "parked as spare (world size rounding)"
         self._retry_batch = None
+        # a peeked batch belongs to a task being requeued wholesale
+        self._staged_peek = _NO_PEEK
         # settle any stepped-but-unreported window first (normally empty
         # — the reform pause flushed it); its cursor advance must land
         # before the ledger is requeued wholesale
@@ -580,6 +637,9 @@ class ElasticAllReduceWorker:
             # advanced the cadence — dropping them here would lose up
             # to checkpoint_steps of durable progress)
             self._drain_ckpt()
+            # compile-plane helper threads (speculative compiler, H2D
+            # feeder) must not outlive the worker
+            self.trainer.close()
 
     def _run(self):
         if self._job_type == JobType.EVALUATION_ONLY:
@@ -820,12 +880,17 @@ class ElasticAllReduceWorker:
         for count in pending:
             self._task_data_service.report_record_done(count, err_msg)
 
-    def _settle_and_leave(self, verdict, validate=True):
+    def _settle_and_leave(self, verdict, validate=True, losses=None):
         """The leave epilogue every pause path shares: settle the sync
         window (validated steps report done, a failed window
         fail-reports + requeues), checkpoint the sharded plane, close
-        any open trace, and leave the world."""
+        any open trace, and leave the world. A validated window's
+        deferred (collect-later) losses drain into ``losses`` — leave()
+        drops the pending scalars, so without this the pause paths
+        would silently lose up to sync_every-1 recorded steps."""
         ok = self.trainer.validate() if validate else False
+        if ok and losses is not None:
+            losses.extend(self.trainer.drain_metrics())
         self._flush_unreported(
             "" if ok else "collective failed before validation"
         )
@@ -876,7 +941,7 @@ class ElasticAllReduceWorker:
                 # the announcement never landed (master unreachable?):
                 # settle what we can and leave anyway — survivors take
                 # the failure-recovery path, same as a hard kill
-                return self._settle_and_leave("preempted")
+                return self._settle_and_leave("preempted", losses=losses)
             if (
                 self._job_type == JobType.TRAINING_WITH_EVALUATION
                 and not self.trainer.is_sharded
@@ -889,6 +954,17 @@ class ElasticAllReduceWorker:
             w = self._stub.get_comm_world(
                 self._worker_id, self._host, awaiting=False
             )
+            # membership-service size hint: the live+lobby head count is
+            # the world the next growth bump would form — feed it to the
+            # speculative compiler so that establish finds its
+            # executable already built (docs/compile_plane.md)
+            hint = int(w.get("live", 0) or 0)
+            if hint and hint != self._last_size_hint:
+                self._last_size_hint = hint
+                per_proc = self.trainer.mesh.devices.size // max(
+                    1, world.num_processes
+                )
+                self.trainer.hint_world_sizes([hint * per_proc])
             if self._drain_announced and w["epoch"] != world.epoch:
                 # the drain bump IS visible: the consensus pause will
                 # land within one sync window — disarm the hard-leave
@@ -927,6 +1003,7 @@ class ElasticAllReduceWorker:
                         sync=True,
                         epoch_hint=w["epoch"],
                     )
+                    losses.extend(self.trainer.drain_metrics())
                 else:
                     features, labels = batch
                     loss, n_active, count = self.trainer.train_step(
@@ -937,6 +1014,9 @@ class ElasticAllReduceWorker:
                         epoch_hint=w["epoch"],
                     )
                     if loss is not None:
+                        # collect-later losses of the unsynced window
+                        # land first, keeping the list chronological
+                        losses.extend(self.trainer.drain_metrics())
                         losses.append(loss)
             except Exception:
                 logger.exception("collective step failed")
@@ -962,6 +1042,17 @@ class ElasticAllReduceWorker:
               # validated and flushed, so no accounting is lost
               try:
                 self._flush_unreported()
+                if batch is not None:
+                    # step overlap: pull batch N+1 now — its H2D
+                    # placement runs on the feeder thread while the
+                    # cadence work below (checkpoint save, eval rounds,
+                    # mirror refresh) runs here. Strictly AFTER the
+                    # flush: every consumed record is reported, so a
+                    # round boundary this peek crosses sees the same
+                    # settled ledger the unpeeked loop's next
+                    # _next_batch would — get_dataset never refuses
+                    # over records this very iteration consumed.
+                    self._peek_and_stage_next()
                 self._alarm_on_embedding_overflow()
                 consensus = self.trainer.epoch_consensus
                 if (
@@ -993,7 +1084,7 @@ class ElasticAllReduceWorker:
                                 "or checkpoints",
                                 exc_info=True,
                             )
-                    return self._settle_and_leave("reform")
+                    return self._settle_and_leave("reform", losses=losses)
                 if (
                     self._ckpt is not None
                     and (

@@ -103,6 +103,9 @@ def _run(args):
             remat=args.remat,
             replica_refresh_steps=args.replica_refresh_steps,
             task_prefetch=getattr(args, "task_prefetch", 1),
+            speculative_compile=getattr(
+                args, "speculative_compile", False
+            ),
         )
         if getattr(args, "standby", False):
             # pre-warmed spare: the cold start (jax/flax import chain

@@ -62,6 +62,7 @@ _LOCKTRACE_SUITES = {
     "test_ps_overlap",
     "test_async_concurrency",
     "test_elastic_pipeline",
+    "test_compile_plane",
     "test_locktrace",
 }
 
