@@ -286,6 +286,9 @@ def _run_local_job(args):
                 "prediction_outputs_processor",
                 "PredictionOutputsProcessor",
             ),
+            telemetry_report_secs=getattr(
+                args, "telemetry_report_secs", 5.0
+            ),
         )
         from elasticdl_tpu.common.args import warn_accum_unsupported
 

@@ -420,6 +420,16 @@ def add_common_args_between_master_and_worker(parser):
         "compiles too (docs/compile_plane.md)",
     )
     parser.add_argument(
+        "--telemetry_report_secs",
+        type=float,
+        default=5.0,
+        help="Workers piggyback a compact telemetry snapshot "
+        "(step/examples rates, input-plane counters, pending events) "
+        "on the master channel at most every this many seconds "
+        "(docs/observability.md); 0 disables worker telemetry "
+        "reporting. EDL_METRICS=0 disables ALL telemetry recording",
+    )
+    parser.add_argument(
         "--loss_log_steps",
         type=non_neg_int,
         default=20,
@@ -440,6 +450,23 @@ def parse_master_args(master_args=None):
     parser.add_argument(
         "--prediction_outputs_processor",
         default="PredictionOutputsProcessor",
+    )
+    parser.add_argument(
+        "--telemetry_port",
+        type=non_neg_int,
+        default=None,
+        help="Serve the job telemetry registry as Prometheus text on "
+        "http://master:PORT/metrics (plus /events as JSONL); 0 binds "
+        "an ephemeral port (exposed as Master.telemetry_port); unset "
+        "disables the endpoint (aggregation still runs)",
+    )
+    parser.add_argument(
+        "--telemetry_events_path",
+        default="",
+        help="Append the master's structured job-event log (resize, "
+        "task requeue/timeline, worker join/leave, PS shard failure) "
+        "as JSON lines to this file; empty disables the file sink "
+        "(the in-memory tail still serves /events)",
     )
     parser.add_argument(
         "--comm_base_port",

@@ -152,6 +152,19 @@ class Master:
             strategy == DistributionStrategy.PARAMETER_SERVER
             and getattr(args, "num_ps_pods", 0) <= 0
         ) or strategy == DistributionStrategy.LOCAL
+        # job-wide telemetry plane (docs/observability.md): fleet
+        # aggregation is always on (it is scrape/report cadence, not a
+        # hot path); the HTTP endpoint and JSONL sink are opt-in flags
+        from elasticdl_tpu.master.telemetry import JobTelemetry
+        from elasticdl_tpu.utils import profiling
+
+        self.telemetry = JobTelemetry(task_dispatcher=self.task_d)
+        events_path = getattr(args, "telemetry_events_path", "")
+        self._owns_event_sink = bool(events_path)
+        if events_path:
+            profiling.events.attach_file(events_path)
+        self._telemetry_http = None
+        self._telemetry_tb = None
         self.master_servicer = MasterServicer(
             args.grads_to_wait,
             args.minibatch_size,
@@ -168,6 +181,7 @@ class Master:
             ),
             use_async=getattr(args, "use_async", False),
             coordinates_only=(strategy == DistributionStrategy.ALLREDUCE),
+            telemetry=self.telemetry,
         )
         # membership epochs for the elastic allreduce plane (the PS plane
         # needs no inter-worker world)
@@ -399,6 +413,26 @@ class Master:
         )
         self.port = self._server._edl_port
         logger.info("Master RPC server started on port %d", self.port)
+        telemetry_port = getattr(self.args, "telemetry_port", None)
+        if telemetry_port is not None and telemetry_port >= 0:
+            from elasticdl_tpu.master.telemetry import (
+                TelemetryHTTPServer,
+            )
+
+            self._telemetry_http = TelemetryHTTPServer(
+                self.telemetry, port=telemetry_port
+            )
+            self.telemetry_port = self._telemetry_http.port
+        logdir = getattr(self.args, "tensorboard_log_dir", "")
+        if logdir:
+            from elasticdl_tpu.master.telemetry import (
+                TelemetryTBExporter,
+            )
+
+            self._telemetry_tb = TelemetryTBExporter(
+                logdir,
+                step_fn=self.master_servicer.get_model_version,
+            )
         if self.instance_manager:
             self.instance_manager.start_all_ps()
             self.instance_manager.start_workers()
@@ -426,6 +460,22 @@ class Master:
             self.evaluation_service.stop()
         if self.tb_service:
             self.tb_service.close()
+        if self._telemetry_tb:
+            self._telemetry_tb.close()
+            self._telemetry_tb = None
+        if self._telemetry_http:
+            self._telemetry_http.close()
+            self._telemetry_http = None
+        if self.telemetry:
+            self.telemetry.close()
+        if self._owns_event_sink:
+            # detach the JSONL sink this master attached in __init__ —
+            # the EventLog is process-global, so a later in-process job
+            # must not keep appending to this job's file
+            from elasticdl_tpu.utils import profiling
+
+            profiling.events.close_file()
+            self._owns_event_sink = False
         if self.instance_manager:
             self.instance_manager.stop_relaunch_and_remove_all_pods()
         if self._server:

@@ -288,21 +288,40 @@ class MembershipService:
         )
 
     def register(self, worker_id, host="localhost"):
+        # join/leave events are emitted AFTER the lock releases: the
+        # sink write in EventLog.emit is disk I/O, and holding the
+        # membership lock across it would stall every concurrent
+        # get_comm_world/register RPC (same discipline as the
+        # dispatcher's report path)
+        join_event = self._register_locked(worker_id, host)
+        if join_event is not None:
+            from elasticdl_tpu.utils import profiling
+
+            profiling.events.emit("worker_join", _ship=False, **join_event)
+
+    def _register_locked(self, worker_id, host):
+        """The state transition; returns worker_join event fields when
+        a genuinely NEW (or re-hosted) member was added, else None."""
         with self._lock:
             if worker_id in self._departing:
                 # a draining member keeps polling get_comm_world while it
                 # waits to observe its own departure bump; re-registering
                 # it (or parking it in the lobby) would re-grow the world
                 # it is leaving
-                return
+                return None
             self._dead.pop(worker_id, None)  # evidently alive
             if (
                 self._live.get(worker_id) == host
                 or self._lobby.get(worker_id) == host
             ):
-                return
+                return None
             if self._first_register_time is None:
                 self._first_register_time = time.time()
+            # this point is only reached for a genuinely NEW (or
+            # re-hosted) member — repeats returned above
+            join_event = dict(
+                worker_id=worker_id, host=host, epoch=self._epoch
+            )
             if not self._formed_initial:
                 self._live[worker_id] = host
                 if len(self._live) >= self._expected:
@@ -323,6 +342,7 @@ class MembershipService:
             else:
                 self._live[worker_id] = host
                 self._bump_locked()
+            return join_event
 
     # process exit codes whose *announced* exits are protocol-clean:
     # 0 = completion after global quiescence, 75 = graceful drain
@@ -374,6 +394,20 @@ class MembershipService:
         ``_live`` (and listed ``dead``) NOW, so survivors' wedge-escape
         probes still fire instantly; a second death, the replacement's
         register, or the deadline ends the deferral."""
+        leave_event = self._remove_locked(
+            worker_id, departing, defer_bump_secs, exit_code
+        )
+        if leave_event is not None:
+            # emitted outside the lock — see register()
+            from elasticdl_tpu.utils import profiling
+
+            profiling.events.emit(
+                "worker_leave", _ship=False, **leave_event
+            )
+
+    def _remove_locked(
+        self, worker_id, departing, defer_bump_secs, exit_code
+    ):
         with self._lock:
             if departing:
                 self._departing[worker_id] = self._epoch
@@ -386,8 +420,14 @@ class MembershipService:
                 self._dead[worker_id] = self._epoch
             self._lobby.pop(worker_id, None)
             if worker_id not in self._live:
-                return
+                return None
             del self._live[worker_id]
+            leave_event = dict(
+                worker_id=worker_id,
+                departing=departing,
+                exit_code=exit_code,
+                epoch=self._epoch,
+            )
             if self._formed_initial:
                 if (
                     defer_bump_secs > 0
@@ -402,12 +442,13 @@ class MembershipService:
                         worker_id,
                         defer_bump_secs,
                     )
-                    return
+                    return leave_event
                 # push-based: deaths re-form immediately — survivors in
                 # the broken collective fail fast and re-poll, so the
                 # job never waits out a detection window
                 self._pending_bump_deadline = None
                 self._bump_locked()
+            return leave_event
 
     def get_world(self, worker_id, host="localhost", awaiting=True):
         """Poll-and-register in one verb (workers call this in a loop).

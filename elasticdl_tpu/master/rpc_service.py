@@ -85,6 +85,10 @@ class MasterRpcService:
         )
         return {}
 
+    def report_telemetry(self, req):
+        self._s.report_telemetry(req.get("snapshot") or {})
+        return {}
+
     def report_evaluation_metrics(self, req):
         outputs = {t.name: t.values for t in req.get("model_outputs", [])}
         accepted, version = self._s.report_evaluation_metrics(
@@ -146,19 +150,30 @@ class MasterRpcService:
         }
 
     def rpc_methods(self):
-        return {
-            "get_task": self.get_task,
-            "get_comm_world": self.get_comm_world,
-            "leave_comm_world": self.leave_comm_world,
-            "standby_poll": self.standby_poll,
-            "get_model": self.get_model,
-            "report_variable": self.report_variable,
-            "report_gradient": self.report_gradient,
-            "report_task_result": self.report_task_result,
-            "report_evaluation_metrics": self.report_evaluation_metrics,
-            "push_embedding_info": self.push_embedding_info,
-            "pull_embedding_vectors": self.pull_embedding_vectors,
-        }
+        from elasticdl_tpu.utils.profiling import (
+            instrument_service_methods,
+        )
+
+        # one wrap instruments every transport (gRPC serve AND direct
+        # in-process calls through this dict): per-method service-time
+        # histograms under edl_rpc_server_latency_seconds{role="master"}
+        return instrument_service_methods(
+            {
+                "get_task": self.get_task,
+                "get_comm_world": self.get_comm_world,
+                "leave_comm_world": self.leave_comm_world,
+                "standby_poll": self.standby_poll,
+                "get_model": self.get_model,
+                "report_variable": self.report_variable,
+                "report_gradient": self.report_gradient,
+                "report_task_result": self.report_task_result,
+                "report_telemetry": self.report_telemetry,
+                "report_evaluation_metrics": self.report_evaluation_metrics,
+                "push_embedding_info": self.push_embedding_info,
+                "pull_embedding_vectors": self.pull_embedding_vectors,
+            },
+            role="master",
+        )
 
 
 class MasterClient:
@@ -225,6 +240,9 @@ class MasterClient:
             err_message=err_message,
             exec_counters=exec_counters,
         )
+
+    def report_telemetry(self, snapshot):
+        self._client.call("report_telemetry", snapshot=snapshot)
 
     def report_evaluation_metrics(
         self, model_version, model_outputs, labels, scored_version=None

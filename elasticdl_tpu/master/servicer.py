@@ -80,6 +80,7 @@ class MasterServicer:
         use_async=False,
         embedding_gradient_applier=None,
         coordinates_only=False,
+        telemetry=None,
     ):
         """``optimizer`` is an optax GradientTransformation (or None for
         pure task-dispatch mode, e.g. ALLREDUCE jobs where the master only
@@ -87,6 +88,9 @@ class MasterServicer:
         gradients of elastic embedding layers whose tables do not live in
         ``self._model`` (the OptimizerWrapper role)."""
         self._task_d = task_d
+        # master-side fleet aggregator (master/telemetry.JobTelemetry);
+        # None keeps report_telemetry a no-op for bare test fixtures
+        self.telemetry = telemetry
         self._lock = threading.Lock()
         self._gradient_sum = {}
         self._gradient_sum_indexed = {}
@@ -389,9 +393,15 @@ class MasterServicer:
                 )
         if err_message:
             logger.warning("Worker reported error: " + err_message)
-            self._task_d.report(task_id, False)
+            self._task_d.report(task_id, False, exec_counters=exec_counters)
         else:
-            self._task_d.report(task_id, True)
+            self._task_d.report(task_id, True, exec_counters=exec_counters)
+
+    def report_telemetry(self, snapshot):
+        """Low-frequency worker telemetry snapshot (docs/observability.md);
+        ignored unless a JobTelemetry aggregator is attached."""
+        if self.telemetry is not None:
+            self.telemetry.ingest(snapshot)
 
     def report_evaluation_metrics(
         self, model_version, model_outputs, labels, scored_version=None

@@ -248,6 +248,11 @@ class ExecutableCache:
             if entry is not None and entry.speculative:
                 entry.speculative = False  # first hit claims the win
                 self.stats.inc("speculative_hits")
+                # a background AOT compile just saved a resize pause —
+                # worth a fleet-visible event (docs/observability.md)
+                profiling.events.emit(
+                    "speculative_compile_hit", key=str(key)
+                )
         return entry
 
     def put(self, key, step_fn, speculative=False):

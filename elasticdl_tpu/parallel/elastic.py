@@ -1011,6 +1011,12 @@ class ElasticDPTrainer:
         # abandoned safely)
         self._shutdown_compile_helpers()
         t0 = _time.time()
+        profiling.events.emit(
+            "resize_begin",
+            epoch=spec.epoch,
+            rank=spec.process_id,
+            world_size=spec.num_processes,
+        )
         distributed.ensure_world(spec)
         t_world = _time.time()
         self._spec = spec
@@ -1080,6 +1086,17 @@ class ElasticDPTrainer:
             t_place - t_init,
             t_compile - t_place,
             "cache hit" if cache_hit else "cache miss",
+        )
+        profiling.events.emit(
+            "resize_end",
+            epoch=spec.epoch,
+            rank=spec.process_id,
+            world_size=spec.num_processes,
+            world_s=round(t_world - t0, 3),
+            init_s=round(t_init - t_world, 3),
+            place_s=round(t_place - t_init, 3),
+            compile_s=round(t_compile - t_place, 3),
+            compile_phase="cache_hit" if cache_hit else "cache_miss",
         )
         self._start_speculative_compiler()
         if self.mirror_enabled():

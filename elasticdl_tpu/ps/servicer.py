@@ -201,11 +201,21 @@ class PserverServicer:
     # -- rpc.core wiring ----------------------------------------------------
 
     def rpc_methods(self):
-        """{method_name: fn} map for rpc.core.serve."""
-        return {
-            "pull_variable": self.pull_variable,
-            "pull_embedding_vector": self.pull_embedding_vector,
-            "push_model": self.push_model,
-            "push_embedding_info": self.push_embedding_info,
-            "push_gradient": self.push_gradient,
-        }
+        """{method_name: fn} map for rpc.core.serve, instrumented with
+        per-method service-time histograms
+        (edl_rpc_server_latency_seconds{role="ps"}) — push-window reaps
+        and fan-out tails become visible without touching callers."""
+        from elasticdl_tpu.utils.profiling import (
+            instrument_service_methods,
+        )
+
+        return instrument_service_methods(
+            {
+                "pull_variable": self.pull_variable,
+                "pull_embedding_vector": self.pull_embedding_vector,
+                "push_model": self.push_model,
+                "push_embedding_info": self.push_embedding_info,
+                "push_gradient": self.push_gradient,
+            },
+            role="ps",
+        )

@@ -25,8 +25,34 @@ from elasticdl_tpu.common.tensor import (
     deserialize_tensor,
     serialize_tensor,
 )
+from elasticdl_tpu.utils import profiling
 
 _SERVICE = "elasticdl_tpu.Rpc"
+
+# Client-side telemetry, one family each shared by every Client in the
+# process (docs/observability.md). The registry's get-or-create is
+# idempotent and thread-safe, so each Client just asks for the
+# families at init — nothing happens at import time.
+def _client_metrics():
+    return (
+        profiling.metrics.histogram(
+            "edl_rpc_client_latency_seconds",
+            "Client-observed RPC latency by method "
+            "(per attempt, successes only)",
+            labels=("method",),
+        ),
+        profiling.metrics.counter(
+            "edl_rpc_client_errors_total",
+            "Client-observed RPC failures by method and "
+            "gRPC status code (per attempt)",
+            labels=("method", "code"),
+        ),
+        profiling.metrics.counter(
+            "edl_rpc_client_retries_total",
+            "UNAVAILABLE retries by method",
+            labels=("method",),
+        ),
+    )
 
 
 def pack_message(msg):
@@ -172,6 +198,7 @@ class Client:
         self._deadline_s = deadline_s if deadline_s else None
         self._retries = retries
         self._backoff_s = backoff_s
+        self._latency, self._errors, self._retried = _client_metrics()
         self._sleep = time.sleep  # injectable for tests
         self._channel = grpc.insecure_channel(
             addr,
@@ -206,12 +233,24 @@ class Client:
         request = pack_message(fields)
         attempt = 0
         while True:
+            t0 = time.perf_counter()
             try:
-                return unpack_message(
-                    stub(request, timeout=self._deadline_s)
+                reply = stub(request, timeout=self._deadline_s)
+                # latency covers the wire + service time of a SUCCESSFUL
+                # attempt; failures count in the errors family instead
+                # (mixing error turnaround into the latency histogram
+                # would poison the tail percentiles a fleet dashboard
+                # alerts on)
+                self._latency.observe(
+                    time.perf_counter() - t0, method=rpc_name
                 )
+                return unpack_message(reply)
             except self._grpc.RpcError as err:
                 code = err.code() if callable(getattr(err, "code", None)) else None
+                self._errors.inc(
+                    method=rpc_name,
+                    code=code.name if code is not None else "UNKNOWN",
+                )
                 retriable = (
                     _retriable
                     and code == self._grpc.StatusCode.UNAVAILABLE
@@ -219,6 +258,7 @@ class Client:
                 )
                 if not retriable:
                     raise
+                self._retried.inc(method=rpc_name)
                 self._sleep(self._backoff_s * (2 ** attempt))
                 attempt += 1
 

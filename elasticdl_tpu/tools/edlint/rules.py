@@ -657,6 +657,13 @@ _LOG_METHODS = (
     "critical",
     "log",
 )
+# telemetry-plane receivers (utils/profiling: the Counters shim, the
+# metrics registry and its Counter/Gauge/Histogram objects, the event
+# log) and their record methods — a registry call inside traced code
+# fires once per TRACE, not per step, so the counter silently stops
+# counting after compilation (docs/observability.md)
+_TELEMETRY_RECEIVERS = ("counters", "metrics", "events", "profiling")
+_TELEMETRY_METHODS = ("inc", "observe", "set", "emit", "count", "add")
 
 
 class JitPurityRule(Rule):
@@ -741,6 +748,16 @@ class JitPurityRule(Rule):
                 return "touches %s" % d
             if d == "open":
                 return "opens a file"
+            parts = d.split(".")
+            if (
+                tail in _TELEMETRY_METHODS
+                and len(parts) >= 2
+                and any(p in _TELEMETRY_RECEIVERS for p in parts[:-1])
+            ):
+                return (
+                    "records telemetry (%s) — registry/event calls in "
+                    "traced code fire per trace, not per step" % d
+                )
         return None
 
     def check(self, ctx):

@@ -16,15 +16,32 @@ them on without plumbing:
   (:class:`Counters`); the compile plane threads its cache hit/miss and
   compile-time numbers through it so workers, bench sections, and tests
   all read one surface.
+- :data:`metrics` — the process-wide :class:`MetricsRegistry`: labeled
+  counters, gauges, and fixed-bucket histograms, exportable in
+  Prometheus text format (docs/observability.md). The RPC layer and
+  the worker/master telemetry plane record through it; ``Counters``
+  stays as a compatible shim whose values surface in the exposition
+  via a registry collector.
+- :data:`events` — the process-wide :class:`EventLog`: structured job
+  events (resize, task requeue, PS shard failure, ...) with monotonic
+  ids, an optional JSONL file sink, and a bounded pending buffer that
+  workers drain into their telemetry snapshots so the master's log
+  aggregates the whole fleet.
 
 Env toggles (read by workers at startup): ``EDL_PROFILE_DIR`` enables
-tracing into that directory; ``EDL_XLA_DUMP_DIR`` enables HLO dumps.
+tracing into that directory; ``EDL_XLA_DUMP_DIR`` enables HLO dumps;
+``EDL_METRICS=0`` turns the telemetry instrumentation into no-ops (the
+bench's overhead A/B arm).
 """
 
+import bisect
 import contextlib
+import json
 import os
+import re
 import threading
 import time
+from collections import deque
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 
@@ -119,6 +136,515 @@ def maybe_stop_trace():
     _stop()
 
 
+# ---------------------------------------------------------------------------
+# telemetry switch
+# ---------------------------------------------------------------------------
+
+_metrics_on = os.environ.get("EDL_METRICS", "1") != "0"
+
+
+def metrics_enabled():
+    """False disables every telemetry write (EDL_METRICS=0; the bench's
+    instrumented-off A/B arm). Metric objects still exist — their
+    record methods just return immediately."""
+    return _metrics_on
+
+
+def set_metrics_enabled(on):
+    global _metrics_on
+    _metrics_on = bool(on)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry: labeled counters / gauges / fixed-bucket histograms
+# ---------------------------------------------------------------------------
+
+# Prometheus-standard latency buckets, seconds. Fixed at histogram
+# creation: the hot path does one bisect + two list increments, never a
+# rebucket.
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name):
+    out = _NAME_SANITIZE.sub("_", name)
+    return "_" + out if out[:1].isdigit() else out
+
+
+def _prom_label_value(value):
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+class _Metric:
+    """One metric family: name + label names + a series per distinct
+    label-value tuple. One small lock per family; series creation is
+    rare, series updates are a dict hit + an increment.
+
+    Label cardinality is bounded: past ``max_series`` distinct label
+    tuples, further new tuples collapse into one ``(overflow)`` series
+    so a runaway label (e.g. a task id used as a label) cannot grow
+    memory without bound. The bound is per family, counted once —
+    crossing it is a telemetry bug worth logging, not crashing on."""
+
+    OVERFLOW = "(overflow)"
+
+    def __init__(self, name, help_text, label_names, max_series):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._max_series = max_series
+        self._lock = threading.Lock()
+        self._series = {}
+        self._overflowed = False
+
+    def _key(self, labels):
+        if not self.label_names:
+            return ()
+        return tuple(str(labels.get(n, "")) for n in self.label_names)
+
+    def _series_for(self, key):
+        """Locate/create the series slot for ``key`` (lock held)."""
+        slot = self._series.get(key)
+        if slot is None:
+            if len(self._series) >= self._max_series:
+                if not self._overflowed:
+                    self._overflowed = True
+                    logger.warning(
+                        "metric %s exceeded %d label series; further "
+                        "new label values collapse into %s",
+                        self.name,
+                        self._max_series,
+                        self.OVERFLOW,
+                    )
+                key = tuple(self.OVERFLOW for _ in key)
+                slot = self._series.get(key)
+                if slot is not None:
+                    return slot
+            slot = self._new_series()
+            self._series[key] = slot
+        return slot
+
+    def series_count(self):
+        with self._lock:
+            return len(self._series)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_series(self):
+        return [0.0]
+
+    def inc(self, value=1, **labels):
+        if not _metrics_on:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._series_for(key)[0] += value
+
+    def value(self, **labels):
+        with self._lock:
+            slot = self._series.get(self._key(labels))
+            return slot[0] if slot else 0.0
+
+    def _samples(self):
+        for key, slot in self._series.items():
+            yield self.name, key, slot[0]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_series(self):
+        return [0.0]
+
+    def set(self, value, **labels):
+        if not _metrics_on:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._series_for(key)[0] = value
+
+    def inc(self, value=1, **labels):
+        if not _metrics_on:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._series_for(key)[0] += value
+
+    def value(self, **labels):
+        with self._lock:
+            slot = self._series.get(self._key(labels))
+            return slot[0] if slot else 0.0
+
+    def _samples(self):
+        for key, slot in self._series.items():
+            yield self.name, key, slot[0]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (Prometheus ``le`` semantics: a bucket
+    counts observations <= its upper edge; +Inf is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names, max_series, buckets):
+        buckets = tuple(sorted(float(b) for b in buckets))
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket edge")
+        self.buckets = buckets
+        super().__init__(name, help_text, label_names, max_series)
+
+    def _new_series(self):
+        # [bucket_counts..., +Inf count] + [sum, count]
+        return [[0] * (len(self.buckets) + 1), 0.0, 0]
+
+    def observe(self, value, **labels):
+        if not _metrics_on:
+            return
+        idx = bisect.bisect_left(self.buckets, value)
+        key = self._key(labels)
+        with self._lock:
+            slot = self._series_for(key)
+            slot[0][idx] += 1
+            slot[1] += value
+            slot[2] += 1
+
+    def data(self, **labels):
+        """(bucket_counts, sum, count) — copies, for tests/export."""
+        with self._lock:
+            slot = self._series.get(self._key(labels))
+            if slot is None:
+                return None
+            return list(slot[0]), slot[1], slot[2]
+
+    def _samples(self):
+        for key, slot in self._series.items():
+            cum = 0
+            for i, edge in enumerate(self.buckets):
+                cum += slot[0][i]
+                yield "%s_bucket" % self.name, key + (
+                    ("le", "%g" % edge),
+                ), cum
+            cum += slot[0][-1]
+            yield "%s_bucket" % self.name, key + (("le", "+Inf"),), cum
+            yield "%s_sum" % self.name, key, slot[1]
+            yield "%s_count" % self.name, key, slot[2]
+
+
+class MetricsRegistry:
+    """Process-wide named metric families with Prometheus exposition.
+
+    ``counter``/``gauge``/``histogram`` get-or-create a family; callers
+    hold the returned object so the hot path never takes the registry
+    lock. ``register_collector(fn)`` adds a scrape-time callable
+    returning ``[(name, {label: value}, number)]`` — how live state
+    (task-queue depth, the legacy ``Counters`` shim) joins the
+    exposition without being written through the registry."""
+
+    # per-family bound: generous enough for per-worker x per-stage
+    # families on a large fleet (6 input stages x 100+ workers), small
+    # enough to stop a runaway unbounded label (task ids, hostnames)
+    MAX_SERIES = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+        self._collectors = []
+
+    def _get_or_create(self, cls, name, help_text, labels, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.label_names != tuple(labels):
+                    raise ValueError(
+                        "metric %r re-registered with a different "
+                        "type/labels" % name
+                    )
+                return m
+            m = cls(name, help_text, tuple(labels), self.MAX_SERIES, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help_text="", labels=()):
+        return self._get_or_create(Counter, name, help_text, labels)
+
+    def gauge(self, name, help_text="", labels=()):
+        return self._get_or_create(Gauge, name, help_text, labels)
+
+    def histogram(
+        self, name, help_text="", labels=(),
+        buckets=DEFAULT_LATENCY_BUCKETS,
+    ):
+        return self._get_or_create(
+            Histogram, name, help_text, labels, buckets=buckets
+        )
+
+    def register_collector(self, fn):
+        with self._lock:
+            self._collectors.append(fn)
+
+    def unregister_collector(self, fn):
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def reset(self):
+        """Drop every family and collector (tests)."""
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+
+    def snapshot(self):
+        """{name: {label_tuple: value-or-(buckets, sum, count)}}."""
+        with self._lock:
+            families = list(self._metrics.values())
+        out = {}
+        for m in families:
+            with m._lock:
+                if isinstance(m, Histogram):
+                    out[m.name] = {
+                        k: (list(s[0]), s[1], s[2])
+                        for k, s in m._series.items()
+                    }
+                else:
+                    out[m.name] = {
+                        k: s[0] for k, s in m._series.items()
+                    }
+        return out
+
+    def prometheus_text(self):
+        """The registry in Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            families = sorted(
+                self._metrics.values(), key=lambda m: m.name
+            )
+            collectors = list(self._collectors)
+        lines = []
+        for m in families:
+            pname = _prom_name(m.name)
+            if m.help:
+                lines.append("# HELP %s %s" % (pname, m.help))
+            lines.append("# TYPE %s %s" % (pname, m.kind))
+            with m._lock:
+                samples = list(m._samples())
+            for sample_name, key, value in samples:
+                label_pairs = []
+                for i, v in enumerate(key):
+                    if isinstance(v, tuple):  # histogram ("le", edge)
+                        label_pairs.append(v)
+                    else:
+                        label_pairs.append((m.label_names[i], v))
+                lines.append(
+                    _format_sample(sample_name, label_pairs, value)
+                )
+        for fn in collectors:
+            try:
+                extra = list(fn())
+            except Exception:
+                logger.warning(
+                    "metrics collector failed; skipped", exc_info=True
+                )
+                continue
+            for name, labels, value in extra:
+                lines.append(
+                    _format_sample(
+                        name, sorted((labels or {}).items()), value
+                    )
+                )
+        return "\n".join(lines) + "\n"
+
+
+def _format_sample(name, label_pairs, value):
+    body = ",".join(
+        '%s="%s"' % (_prom_name(k), _prom_label_value(v))
+        for k, v in label_pairs
+    )
+    if isinstance(value, float) and value == int(value):
+        value = int(value)
+    return "%s%s %s" % (
+        _prom_name(name), "{%s}" % body if body else "", value
+    )
+
+
+metrics = MetricsRegistry()
+
+
+def instrument_service_methods(methods, role, registry=None):
+    """Wrap an rpc_methods() dict so every handler records its service
+    time into ``edl_rpc_server_latency_seconds{role, method}``.
+
+    One wrap point covers every transport: rpc.core.serve and the
+    in-process direct-call path both go through the returned dict, so
+    master get_task latency and PS push/pull service time become
+    visible without touching any call site."""
+    hist = (registry or metrics).histogram(
+        "edl_rpc_server_latency_seconds",
+        "RPC service time by servicer role and method",
+        labels=("role", "method"),
+    )
+    errors = (registry or metrics).counter(
+        "edl_rpc_server_errors_total",
+        "RPC handler exceptions by servicer role and method",
+        labels=("role", "method"),
+    )
+
+    def wrap(name, fn):
+        def handler(*args, **kwargs):
+            if not _metrics_on:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                errors.inc(role=role, method=name)
+                raise
+            finally:
+                hist.observe(
+                    time.perf_counter() - t0, role=role, method=name
+                )
+
+        return handler
+
+    return {name: wrap(name, fn) for name, fn in methods.items()}
+
+
+# ---------------------------------------------------------------------------
+# structured job events
+# ---------------------------------------------------------------------------
+
+
+class EventLog:
+    """Process-wide structured event log with monotonic ids.
+
+    ``emit`` assigns the next id, appends to a bounded in-memory ring
+    (``tail`` reads it), writes one JSON line to the attached file sink
+    if any, and parks a copy on the bounded *pending* buffer that
+    :meth:`drain_pending` empties — the worker telemetry snapshot ships
+    pending events to the master, whose JobTelemetry re-logs them via
+    :meth:`ingest` (ship=False, so aggregated events never re-enter a
+    pending buffer and bounce forever in the in-process local mode
+    where master and worker share this object)."""
+
+    def __init__(self, capacity=2048, pending_capacity=256):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._ring = deque(maxlen=capacity)
+        self._pending = deque(maxlen=pending_capacity)
+        self._sink = None
+        self._sink_path = None
+
+    def attach_file(self, path):
+        """Append JSON lines to ``path`` from now on (master-side)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # file IO outside the lock (edlint R5); swap under it
+        sink = open(path, "a", encoding="utf-8")
+        with self._lock:
+            old, self._sink = self._sink, sink
+            self._sink_path = path
+        if old is not None:
+            old.close()
+
+    def close_file(self):
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+                self._sink_path = None
+
+    def emit(self, kind, _ship=True, **fields):
+        """Record one event; returns the event dict (with its id)."""
+        if not _metrics_on:
+            return None
+        event = {"kind": str(kind)}
+        event.update(fields)
+        with self._lock:
+            self._next_id += 1
+            event["id"] = self._next_id
+            event["ts"] = round(time.time(), 6)
+            self._ring.append(event)
+            if _ship:
+                self._pending.append(event)
+            if self._sink is not None:
+                try:
+                    self._sink.write(
+                        json.dumps(event, default=str) + "\n"
+                    )
+                    self._sink.flush()
+                except OSError:
+                    logger.warning(
+                        "event sink write failed; detaching %s",
+                        self._sink_path,
+                    )
+                    try:
+                        self._sink.close()
+                    except OSError:
+                        pass
+                    self._sink = None
+        return event
+
+    def ingest(self, shipped_events, **extra):
+        """Re-log events shipped from another process (new monotonic
+        ids here; the origin's id/ts ride along as src_id/src_ts)."""
+        for e in shipped_events or ():
+            fields = {
+                k: v
+                for k, v in dict(e).items()
+                if k not in ("id", "ts", "kind")
+            }
+            fields.update(extra)
+            fields["src_id"] = e.get("id")
+            fields["src_ts"] = e.get("ts")
+            self.emit(e.get("kind", "unknown"), _ship=False, **fields)
+
+    def drain_pending(self, max_n=64):
+        """Pop up to ``max_n`` un-shipped events (worker piggyback)."""
+        out = []
+        with self._lock:
+            while self._pending and len(out) < max_n:
+                out.append(self._pending.popleft())
+        return out
+
+    def requeue(self, drained_events):
+        """Put drained-but-unshipped events back at the head of the
+        pending buffer — a failed report_telemetry must not lose them.
+        If the buffer refilled meanwhile, the bounded deque sheds from
+        the newest end; the requeued (older) events keep their slot."""
+        if not drained_events:
+            return
+        with self._lock:
+            self._pending.extendleft(reversed(list(drained_events)))
+
+    def tail(self, n=100):
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def reset(self):
+        """Tests only: drop state, detach the sink, restart ids."""
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+            self._sink = None
+            self._sink_path = None
+            self._ring.clear()
+            self._pending.clear()
+            self._next_id = 0
+
+
+events = EventLog()
+
+
 class Counters:
     """Process-wide named counters (int or float accumulators).
 
@@ -126,6 +652,11 @@ class Counters:
     interaction); consumers read a consistent copy via
     :meth:`snapshot`. Namespacing is by convention:
     ``"compile_plane/hits"``, ``"compile_plane/aot_compile_s"``.
+
+    Kept as a compatible shim over the telemetry plane: the registry
+    exposes every named counter as ``edl_counter{name="..."}`` via a
+    collector (see module bottom), so legacy callers keep this API and
+    still land in ``/metrics``.
     """
 
     def __init__(self):
@@ -162,6 +693,28 @@ class Counters:
 counters = Counters()
 
 
+def _counters_collector():
+    """Bridge the legacy Counters shim into the exposition."""
+    return [
+        ("edl_counter", {"name": name}, value)
+        for name, value in sorted(counters.snapshot().items())
+    ]
+
+
+metrics.register_collector(_counters_collector)
+
+
+def _nearest_rank(xs, pct):
+    """Nearest-rank percentile (ceil indexing) over SORTED ``xs``.
+
+    ``xs[ceil(pct/100 * n) - 1]`` — the textbook definition; the old
+    ``xs[n // 2]`` / ``xs[int(n * 0.99)]`` indices were biased high for
+    small n (for n=2 they returned the max as the median)."""
+    n = len(xs)
+    rank = -(-pct * n // 100)  # ceil(pct*n/100) without floats
+    return xs[max(0, min(n - 1, int(rank) - 1))]
+
+
 class step_timer:
     """Rolling wall-clock stats for the hot loop (mean/p50/p99 ms)."""
 
@@ -186,6 +739,8 @@ class step_timer:
         return {
             "steps": n,
             "mean_ms": 1e3 * sum(xs) / n,
-            "p50_ms": 1e3 * xs[n // 2],
-            "p99_ms": 1e3 * xs[min(n - 1, int(n * 0.99))],
+            "p50_ms": 1e3 * _nearest_rank(xs, 50),
+            "p90_ms": 1e3 * _nearest_rank(xs, 90),
+            "p99_ms": 1e3 * _nearest_rank(xs, 99),
+            "max_ms": 1e3 * xs[-1],
         }

@@ -88,6 +88,7 @@ class ElasticAllReduceWorker:
         replica_refresh_steps=8,
         task_prefetch=0,
         speculative_compile=False,
+        telemetry_report_secs=5.0,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -307,6 +308,15 @@ class ElasticAllReduceWorker:
             data_reader_params=data_reader_params,
             task_prefetch=task_prefetch,
         )
+        # job telemetry: step/examples rates + resize / compile-plane
+        # events ride the task-report channel (docs/observability.md)
+        from elasticdl_tpu.worker.telemetry import WorkerTelemetry
+
+        self._telemetry = WorkerTelemetry(
+            worker_id,
+            stats=self._task_data_service.stats,
+            interval_s=telemetry_report_secs,
+        )
         self._ckpt = None
         if checkpoint_dir and checkpoint_steps:
             from elasticdl_tpu.common.sharded_checkpoint import (
@@ -452,9 +462,13 @@ class ElasticAllReduceWorker:
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
         from elasticdl_tpu.worker.reporting import with_model_version
 
-        return self._stub.report_task_result(
+        result = self._stub.report_task_result(
             task_id, err_msg, with_model_version(self.trainer, exec_counters)
         )
+        # piggyback the (rate-limited) telemetry snapshot — resize and
+        # speculative-compile events reach the master's event log here
+        self._telemetry.ship(self._stub)
+        return result
 
     # -- data ---------------------------------------------------------------
 
@@ -629,6 +643,11 @@ class ElasticAllReduceWorker:
         try:
             return self._run()
         finally:
+            # final telemetry flush (PS-mode Worker.run does the same):
+            # a job shorter than the report interval, and any events
+            # emitted after the last interval-gated ack, still land one
+            # snapshot. Best-effort — the master may already be gone.
+            self._telemetry.ship(self._stub, force=True)
             # flush any open trace even on the exception path — the run
             # that crashed is the one whose profile matters most
             maybe_stop_trace()
@@ -1034,6 +1053,7 @@ class ElasticAllReduceWorker:
                 return "reform"
             if batch is not None:
                 self._unreported.append(count)
+                self._telemetry.on_batch(count)
             if sync:
               # a peer death can surface here as WorldBroken from the
               # escapable waits inside the cadence fetches / the pause

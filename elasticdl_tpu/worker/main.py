@@ -106,6 +106,9 @@ def _run(args):
             speculative_compile=getattr(
                 args, "speculative_compile", False
             ),
+            telemetry_report_secs=getattr(
+                args, "telemetry_report_secs", 5.0
+            ),
         )
         if getattr(args, "standby", False):
             # pre-warmed spare: the cold start (jax/flax import chain
@@ -218,6 +221,9 @@ def _run(args):
         task_prefetch=getattr(args, "task_prefetch", 1),
         task_ack_queue=getattr(args, "task_ack_queue", 8),
         loss_log_steps=getattr(args, "loss_log_steps", 20),
+        telemetry_report_secs=getattr(
+            args, "telemetry_report_secs", 5.0
+        ),
     )
     try:
         worker.run()

@@ -601,6 +601,8 @@ class BoundPS:
         def call(req):
             import grpc
 
+            from elasticdl_tpu.utils import profiling
+
             try:
                 return self._client.call(
                     method,
@@ -608,6 +610,15 @@ class BoundPS:
                     **req
                 )
             except grpc.RpcError as err:
-                raise PSRpcError(self._addr, method, err) from err
+                wrapped = PSRpcError(self._addr, method, err)
+                # fleet-visible event: rides the worker's next telemetry
+                # snapshot into the master's job log
+                profiling.events.emit(
+                    "ps_shard_failure",
+                    addr=self._addr,
+                    method=method,
+                    code=getattr(wrapped.code, "name", None),
+                )
+                raise wrapped from err
 
         return call

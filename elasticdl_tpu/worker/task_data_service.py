@@ -318,6 +318,13 @@ class TaskDataService:
             if self._bad_records
             else None
         )
+        t0 = getattr(task, "_edl_consume_t0", None)
+        if t0 is not None:
+            # worker-side half of the task timeline: first-ledger-append
+            # to ack wall time rides the exec counters so the master's
+            # task_done event carries both clocks
+            counters = dict(counters or {})
+            counters["consume_s"] = round(time.perf_counter() - t0, 6)
         if err_msg:
             logger.warning(
                 "task %d finished with %d/%d bad records; last error: %s",
@@ -516,7 +523,17 @@ class TaskDataService:
             warm = self._prefetch_warm_records
         it = iter(self.data_reader.read_records(task))
         head = []
-        with self.stats.timed("read_s"):
+        # the dispatcher's trace id labels the prefetch-warm span, so a
+        # profiler timeline joins this read to the same task's train
+        # span on the consumer thread (docs/observability.md)
+        from elasticdl_tpu.utils.profiling import annotate
+
+        trace_id = (getattr(task, "extended_config", None) or {}).get(
+            "trace_id", "untraced"
+        )
+        with annotate("edl/task/%s/warm" % trace_id), self.stats.timed(
+            "read_s"
+        ):
             for _ in range(max(0, warm)):
                 rec = next(it, _SENTINEL)
                 if rec is _SENTINEL:
@@ -537,6 +554,7 @@ class TaskDataService:
         with self._ledger_lock:
             stale = self._round_id != gen_id
             if not stale:
+                task._edl_consume_t0 = time.perf_counter()
                 self._inflight.append(task)
         if stale:
             self._worker.report_task_result(task.task_id, _ABANDON_MSG)

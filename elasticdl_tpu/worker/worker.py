@@ -60,6 +60,7 @@ from elasticdl_tpu.training.step import (
     make_forward_fn,
     make_grad_fn,
 )
+from elasticdl_tpu.utils.profiling import annotate
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
 
@@ -88,6 +89,7 @@ class Worker:
         task_prefetch=1,
         task_ack_queue=8,
         loss_log_steps=20,
+        telemetry_report_secs=5.0,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -165,6 +167,16 @@ class Worker:
             task_prefetch=task_prefetch,
             ack_queue_size=task_ack_queue,
         )
+        # job telemetry: per-batch rate accounting + low-frequency
+        # snapshots shipped behind task reports (docs/observability.md)
+        from elasticdl_tpu.worker.telemetry import WorkerTelemetry
+
+        self._telemetry = WorkerTelemetry(
+            worker_id,
+            stats=self._task_data_service.stats,
+            interval_s=telemetry_report_secs,
+            ps_client=ps_client,
+        )
 
     # -- master RPC surface -------------------------------------------------
 
@@ -172,7 +184,13 @@ class Worker:
         return self._stub.get_task(self._worker_id, task_type)
 
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
-        return self._stub.report_task_result(task_id, err_msg, exec_counters)
+        result = self._stub.report_task_result(
+            task_id, err_msg, exec_counters
+        )
+        # the piggyback point: a task ack already cost a master round
+        # trip, so the (rate-limited) telemetry snapshot rides here
+        self._telemetry.ship(self._stub)
+        return result
 
     def get_model(self, version, method=GetModelMethod.MINIMUM):
         """Pull parameters >= ``version`` (MINIMUM) or exactly (FIXED).
@@ -746,12 +764,20 @@ class Worker:
                     train_with_local_model = True
 
                 batch_count = self._batch_count(dataset_batch)
-                err_msg = self._process_minibatch_and_report(
-                    dataset_batch,
-                    task.type,
-                    task.model_version,
-                    train_with_local_model,
+                # the dispatcher's task trace id labels the train span,
+                # so profiler timelines join pull/prefetch/decode/train
+                # across processes (docs/observability.md)
+                trace_id = (task.extended_config or {}).get(
+                    "trace_id", "untraced"
                 )
+                with annotate("edl/task/%s/train" % trace_id):
+                    err_msg = self._process_minibatch_and_report(
+                        dataset_batch,
+                        task.type,
+                        task.model_version,
+                        train_with_local_model,
+                    )
+                self._telemetry.on_batch(batch_count)
                 local_update_count += 1
                 if err_msg:
                     last_training_minibatch_failed = True
@@ -803,6 +829,7 @@ class Worker:
                 err_msg = self._process_minibatch_and_report(
                     dataset_batch, task.type, task.model_version
                 )
+                self._telemetry.on_batch(batch_count)
                 self._task_data_service.report_record_done(
                     batch_count, err_msg
                 )
@@ -830,3 +857,5 @@ class Worker:
         # nothing may stay queued when the worker exits: the master's
         # doing-set must drain for the job to finish
         self._task_data_service.drain_acks()
+        # final telemetry flush so short jobs still land one snapshot
+        self._telemetry.ship(self._stub, force=True)

@@ -24,6 +24,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_async_concurrency.py \
     tests/test_elastic_pipeline.py \
     tests/test_compile_plane.py \
+    tests/test_telemetry.py \
     tests/test_locktrace.py \
     tests/test_edlint.py \
     -q -m 'not slow' -p no:cacheprovider "$@"

@@ -64,6 +64,7 @@ _LOCKTRACE_SUITES = {
     "test_elastic_pipeline",
     "test_compile_plane",
     "test_locktrace",
+    "test_telemetry",
 }
 
 

@@ -42,6 +42,9 @@ class InProcessMaster:
     def report_task_result(self, task_id, err_msg="", exec_counters=None):
         return self._m.report_task_result(task_id, err_msg, exec_counters)
 
+    def report_telemetry(self, snapshot):
+        return self._m.report_telemetry(snapshot)
+
     def report_evaluation_metrics(
         self, model_version, model_outputs, labels, scored_version=None
     ):

@@ -528,6 +528,33 @@ def test_r7_flags_host_effects_in_traced_functions(tmp_path):
     assert not _lint(tmp_path, R7_GOOD)
 
 
+def test_r7_flags_telemetry_registry_calls_in_traced_code(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "import jax\n"
+        "from elasticdl_tpu.utils import profiling\n"
+        "def step(ts, batch):\n"
+        "    profiling.counters.inc('step/hits')\n"
+        "    return ts\n"
+        "jax.jit(step)\n",
+    )
+    assert _rules_of(bad) == ["R7"]
+    assert "records telemetry" in bad[0].message
+    # the same call OUTSIDE traced code is the intended idiom
+    good = _lint(
+        tmp_path,
+        "import jax\n"
+        "from elasticdl_tpu.utils import profiling\n"
+        "def step(ts, batch):\n"
+        "    return ts\n"
+        "def drive(ts, batch):\n"
+        "    profiling.counters.inc('step/hits')\n"
+        "    profiling.events.emit('resize_begin')\n"
+        "    return jax.jit(step)(ts, batch)\n",
+    )
+    assert not good
+
+
 def test_r7_sees_decorator_and_shard_map_forms(tmp_path):
     bad = _lint(
         tmp_path,

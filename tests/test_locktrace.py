@@ -35,20 +35,32 @@ def _run_abba(lock_a, lock_b, second_timeout=None, join_timeout=10.0):
     Each arm takes its first lock, proves it via an event, waits for
     the OTHER arm's proof, then goes for its second lock — so both
     arms are guaranteed to be holding one lock and wanting the other
-    at the same moment. Returns (second-acquire outcomes, order
-    errors, threads)."""
+    at the same moment. On the bounded path each arm additionally
+    announces when its second acquire has CONCLUDED and waits for the
+    other's announcement before releasing its first lock — without
+    that, the two ~second_timeout windows race and a photo-finish
+    release lets one arm sneak its second acquire in. Returns
+    (second-acquire outcomes, order errors, threads)."""
     e1, e2 = threading.Event(), threading.Event()
+    d1, d2 = threading.Event(), threading.Event()
     results, errors = [], []
 
-    def arm(first, second, mine, theirs, label):
+    def arm(first, second, mine, theirs, my_done, their_done, label):
         try:
             with first:
                 mine.set()
                 theirs.wait(5.0)
                 if second_timeout is not None:
-                    got = second.acquire(timeout=second_timeout)
+                    try:
+                        got = second.acquire(timeout=second_timeout)
+                    finally:
+                        # set even when the sanitizer raises, so the
+                        # other arm never waits out its full guard
+                        my_done.set()
                     if got:
                         second.release()
+                    else:
+                        their_done.wait(5.0)
                     results.append((label, got))
                 else:
                     with second:
@@ -58,10 +70,14 @@ def _run_abba(lock_a, lock_b, second_timeout=None, join_timeout=10.0):
 
     threads = [
         threading.Thread(
-            target=arm, args=(lock_a, lock_b, e1, e2, "t1"), daemon=True
+            target=arm,
+            args=(lock_a, lock_b, e1, e2, d1, d2, "t1"),
+            daemon=True,
         ),
         threading.Thread(
-            target=arm, args=(lock_b, lock_a, e2, e1, "t2"), daemon=True
+            target=arm,
+            args=(lock_b, lock_a, e2, e1, d2, d1, "t2"),
+            daemon=True,
         ),
     ]
     for t in threads:
