@@ -436,8 +436,17 @@ class ShardedCheckpointManager:
             glob.glob(os.path.join(directory, _MANIFEST_PREFIX + "*.json"))
         )
 
-    def _evict(self):
+    def _evict(self, expected_writers):
         """Ring retention (process 0 only), restorability-gated.
+
+        ``expected_writers`` is passed by the caller rather than read
+        off ``self`` because the async-io path runs this on the
+        checkpoint writer thread: an elastic resize between submit and
+        write would otherwise have the in-flight eviction judge OLD
+        versions' manifests against the NEW world's writer count and
+        possibly delete the last restorable state (edlint R8 caught the
+        unlocked cross-thread read; the value now travels with the
+        snapshot it describes).
 
         A version is only evicted once some NEWER version is at least as
         complete — otherwise rank 0 could delete the last fully-written
@@ -463,11 +472,11 @@ class ShardedCheckpointManager:
             counts = {
                 v: self._manifest_count(self._dir_for(v)) for v in kept
             }
-            if self._expected_writers:
+            if expected_writers:
                 # after a world GROW, a newer version is only restorable
                 # once every CURRENT rank's manifest landed — the
                 # victim's (smaller) count must not lower the bar
-                need = self._expected_writers
+                need = expected_writers
             else:
                 need = max(jax.process_count(), *counts.values())
             if not any(counts[v] >= need for v in kept[1:]):
@@ -483,7 +492,10 @@ class ShardedCheckpointManager:
     def save(self, tree, version):
         directory = self._dir_for(version)
         pid = jax.process_index()
+        # snapshot per-world config at submit time: the async write may
+        # land after an elastic resize rewrote these for the NEXT world
         logical = self._logical_dim0
+        expected = self._expected_writers
         if self._async is not None:
             snap = snapshot_tree(tree)
 
@@ -496,7 +508,7 @@ class ShardedCheckpointManager:
                     logical_dim0=logical,
                 )
                 if self._keep_max and pid == 0:
-                    self._evict()
+                    self._evict(expected)
 
             self._async.submit(_write, label="ckpt_v%d" % version)
             return directory
@@ -504,7 +516,7 @@ class ShardedCheckpointManager:
             directory, tree, version=version, logical_dim0=logical
         )
         if self._keep_max and pid == 0:
-            self._evict()
+            self._evict(expected)
         return directory
 
     def wait(self):

@@ -60,13 +60,32 @@ class RecordIODataReader(AbstractDataReader):
         # consumer); an unsynchronized check-then-insert would build
         # duplicate readers and leak the loser's mmap/fd
         self._readers_lock = threading.Lock()
+        self._closed = False
 
     def _reader(self, path):
         with self._readers_lock:
-            if path not in self._readers:
-                # C++ mmap reader when built; Python fallback otherwise
-                self._readers[path] = open_recordio(path)
-            return self._readers[path]
+            if self._closed:
+                raise RuntimeError("RecordIODataReader is closed")
+            reader = self._readers.get(path)
+        if reader is not None:
+            return reader
+        # cold open (C++ mmap reader when built; Python fallback) runs
+        # OUTSIDE the lock so parallel warm reads of distinct shards
+        # don't serialize on one another's mmap/open; a raced duplicate
+        # loses the setdefault and closes itself — no fd leak
+        reader = open_recordio(path)
+        with self._readers_lock:
+            # a cold open racing close() must not resurrect the reader
+            # table: close() already drained it, so an insert here would
+            # leave this mmap/fd open forever (nothing closes it again)
+            winner = None if self._closed else (
+                self._readers.setdefault(path, reader)
+            )
+        if winner is not reader:
+            reader.close()
+        if winner is None:
+            raise RuntimeError("RecordIODataReader is closed")
+        return winner
 
     def read_records(self, task):
         yield from self._reader(task.shard_name).read_range(
@@ -83,9 +102,12 @@ class RecordIODataReader(AbstractDataReader):
         return shards
 
     def close(self):
-        for r in self._readers.values():
+        with self._readers_lock:
+            self._closed = True
+            readers = list(self._readers.values())
+            self._readers.clear()
+        for r in readers:
             r.close()
-        self._readers.clear()
 
 
 class ODPSDataReader(AbstractDataReader):

@@ -82,13 +82,16 @@ class LocalInstanceManager:
             out.close()  # the child holds its own fd
         else:
             proc = subprocess.Popen(argv, env=self._env)
-        with self._lock:
-            self._procs[key] = proc
         watcher = threading.Thread(
             target=self._watch, args=(key, proc), daemon=True
         )
+        # _spawn runs on the owner thread AND on watcher threads (the
+        # relaunch path), so the watcher list rides the same lock as
+        # the proc table (edlint R8)
+        with self._lock:
+            self._procs[key] = proc
+            self._watchers.append(watcher)
         watcher.start()
-        self._watchers.append(watcher)
         return proc
 
     def start_all_ps(self):
@@ -188,6 +191,8 @@ class LocalInstanceManager:
                 # with a warmed standby about to be promoted, defer the
                 # bump briefly: one combined formation instead of a
                 # shrink re-form chased by a growth pause
+                with self._lock:
+                    budget_left = self._relaunches < self._max_relaunches
                 will_promote = (
                     returncode not in (0,)
                     and self._restart_policy != "Never"
@@ -195,10 +200,7 @@ class LocalInstanceManager:
                     # exit 75 (drain) skips the budget; crashes consume
                     # it — deferring for a promotion the budget forbids
                     # would stall survivors 6 s for nothing
-                    and (
-                        returncode == 75
-                        or self._relaunches < self._max_relaunches
-                    )
+                    and (returncode == 75 or budget_left)
                 )
                 from elasticdl_tpu.master.membership_service import (
                     DEATH_BUMP_DEFER_SECS,
@@ -243,11 +245,16 @@ class LocalInstanceManager:
                 instance_id,
                 returncode,
             )
-            if (
-                self._restart_policy != "Never"
-                and self._relaunches < self._max_relaunches
-            ):
-                self._relaunches += 1
+            # check-and-spend atomically: two watcher threads racing
+            # here would both pass an unlocked budget check and
+            # over-relaunch past max_relaunches (edlint R8)
+            spend = False
+            if self._restart_policy != "Never":
+                with self._lock:
+                    if self._relaunches < self._max_relaunches:
+                        self._relaunches += 1
+                        spend = True
+            if spend:
                 new_id = self._promote_standby()
                 if new_id is not None:
                     logger.info(
@@ -262,8 +269,15 @@ class LocalInstanceManager:
                 instance_id,
                 returncode,
             )
-            if not self._stopping and self._relaunches < self._max_relaunches:
-                self._relaunches += 1
+            spend = False
+            with self._lock:
+                if (
+                    not self._stopping
+                    and self._relaunches < self._max_relaunches
+                ):
+                    self._relaunches += 1
+                    spend = True
+            if spend:
                 self._spawn(key, self._ps_command(instance_id))
 
     # -- control ------------------------------------------------------------

@@ -266,6 +266,11 @@ class TelemetryTBExporter:
             logdir, filename_suffix=".telemetry"
         )
         self._flushes = 0
+        # the exporter thread and close()'s final flush both run
+        # flush(); without this the _flushes bump is a lost-update and
+        # two flushes can interleave add_scalars at the same step
+        # (edlint R8)
+        self._flush_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="edl-telemetry-tb"
@@ -282,6 +287,10 @@ class TelemetryTBExporter:
                 )
 
     def flush(self):
+        with self._flush_lock:
+            self._do_flush()
+
+    def _do_flush(self):
         snap = self._registry.snapshot()
         scalars = []
         for name, series in sorted(snap.items()):

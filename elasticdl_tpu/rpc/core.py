@@ -229,7 +229,11 @@ class Client:
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b,
             )
-            self._stubs[rpc_name] = stub
+            # one Client is shared by every fan-out leg of a
+            # multi-table pull: setdefault keeps the cache coherent
+            # when two legs race the first call of a method (the loser
+            # stub is garbage, never a torn entry)
+            stub = self._stubs.setdefault(rpc_name, stub)
         request = pack_message(fields)
         attempt = 0
         while True:

@@ -1,13 +1,17 @@
-"""edlint — AST-based concurrency & jit-purity analyzer.
+"""edlint — whole-program concurrency & jit-purity analyzer.
 
-Successor to the regex ratchet ``scripts/greps_guard.py`` (now a thin
-shim over rules R1–R3): a real ``ast`` pass with a rule registry,
-per-rule allowlist ratchets (every entry carries a reason), and a
-findings report. Rule catalog and extension guide:
-``docs/static_analysis.md``.
+A real ``ast`` pass (successor to the retired regex ratchet
+``scripts/greps_guard.py``) with a rule registry, per-rule allowlist
+ratchets (every entry carries a reason), and a findings report — plus a
+whole-program layer (``project.py``): an mtime-keyed AST cache, a
+cross-file call graph with thread-root discovery, interprocedural
+blocking chains for R5, the R8 static lockset race detector, and R9
+RPC retry-safety. Rule catalog, root/lockset model and soundness
+caveats: ``docs/static_analysis.md``.
 
 Run: ``python -m elasticdl_tpu.tools.edlint`` (exit 0 clean / 1 with a
-per-violation report), or the ``edlint`` console entry point.
+per-violation report; ``--json`` for machine output, ``--no-cache`` to
+bypass the AST cache), or the ``edlint`` console entry point.
 """
 
 from elasticdl_tpu.tools.edlint.core import Finding, main, run  # noqa: F401

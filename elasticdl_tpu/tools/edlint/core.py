@@ -17,6 +17,7 @@ ratchet with a reason in the same review; entries only ever shrink
 
 import argparse
 import ast
+import json
 import os
 import sys
 from collections import namedtuple
@@ -79,11 +80,18 @@ _LOCK_CTORS = ("Lock", "RLock")
 class FileContext:
     """One parsed source file plus the binding tables rules share."""
 
-    def __init__(self, path, source):
+    def __init__(self, path, source, tree=None):
         self.path = path  # repo-relative, posix
         self.source = source
         self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=path)
+        # ``tree`` lets the project layer's mtime-keyed AST cache skip
+        # the re-parse (elasticdl_tpu/tools/edlint/project.py)
+        self.tree = tree if tree is not None else ast.parse(
+            source, filename=path
+        )
+        # whole-program context; scan() attaches the Project so rules
+        # R5/R8/R9 can resolve across files (None for standalone use)
+        self.project = None
         self.parent = {}
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
@@ -96,8 +104,11 @@ class FileContext:
         self._collect_bindings()
 
     def line(self, node):
+        return self.line_at(node.lineno)
+
+    def line_at(self, lineno):
         try:
-            return self.lines[node.lineno - 1].strip()
+            return self.lines[lineno - 1].strip()
         except IndexError:
             return ""
 
@@ -158,30 +169,36 @@ def iter_source_files(root):
     for pkg in ("elasticdl_tpu", "model_zoo", "scripts"):
         top = os.path.join(root, pkg)
         for dirpath, dirnames, names in os.walk(top):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
             for name in sorted(names):
                 if name.endswith(".py"):
                     yield os.path.join(dirpath, name)
 
 
-def scan(root, rule_ids=None):
+def scan(root, rule_ids=None, use_cache=True):
     """All raw findings over ``root`` (before the ratchet), in
-    (path, lineno) order, plus files that failed to parse."""
+    (path, lineno) order, plus files that failed to parse.
+
+    Every scan is whole-program: the modules parse once (through the
+    mtime-keyed AST cache unless ``use_cache=False``), a Project is
+    built over all of them, and each rule sees per-file contexts that
+    carry the cross-file call graph (``ctx.project``)."""
+    from elasticdl_tpu.tools.edlint.project import Project, load_contexts
     from elasticdl_tpu.tools.edlint.rules import RULES
 
     rules = [
         r for r in RULES if rule_ids is None or r.id in rule_ids
     ]
+    contexts, broken, _stats = load_contexts(
+        root, iter_source_files(root), use_cache=use_cache
+    )
+    project = Project(contexts)
     findings = []
-    broken = []
-    for path in iter_source_files(root):
-        rel = os.path.relpath(path, root).replace(os.sep, "/")
-        try:
-            with open(path, encoding="utf-8") as f:
-                ctx = FileContext(rel, f.read())
-        except SyntaxError as err:
-            broken.append((rel, str(err)))
-            continue
+    for rel in sorted(contexts):
+        ctx = contexts[rel]
+        ctx.project = project
         for rule in rules:
             findings.extend(rule.check(ctx))
     findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
@@ -231,9 +248,9 @@ def stale_entries(counts, allow=None):
     return stale
 
 
-def run(root, rule_ids=None, allow=None):
+def run(root, rule_ids=None, allow=None, use_cache=True):
     """(violations, counts, broken) for ``root`` after the ratchet."""
-    findings, broken = scan(root, rule_ids=rule_ids)
+    findings, broken = scan(root, rule_ids=rule_ids, use_cache=use_cache)
     violations, counts, _ = apply_ratchet(findings, allow=allow)
     return violations, counts, broken
 
@@ -272,6 +289,20 @@ def main(argv=None):
         help="also report ratchet entries wider than current use "
         "(the ratchet only shrinks)",
     )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="machine-readable findings on stdout "
+        "(file/line/rule/message/ratchet-state; exit code unchanged)",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the mtime-keyed AST cache "
+        "(~/.cache/edlint/ast-<root-hash>.pkl): re-parse every file "
+        "and do not write the cache back",
+    )
     args = parser.parse_args(argv)
     if args.list_rules:
         for rule in RULES:
@@ -282,15 +313,61 @@ def main(argv=None):
         if args.rules
         else None
     )
-    violations, counts, broken = run(args.root, rule_ids=rule_ids)
-    rc = 0
+    findings, broken = scan(
+        args.root, rule_ids=rule_ids, use_cache=not args.no_cache
+    )
+    violations, counts, allowed = apply_ratchet(findings)
+    # scope the stale check to the rules that actually ran: a subset
+    # run (--rules R1,R2,R3) has zero counts for every other rule and
+    # must not read their budgets as slack
+    stale = (
+        [
+            s
+            for s in stale_entries(counts)
+            if rule_ids is None or s[0] in rule_ids
+        ]
+        if args.stale
+        else []
+    )
+    rc = 1 if (broken or violations or stale) else 0
+    if args.as_json:
+        doc = {
+            "root": args.root,
+            "rc": rc,
+            "findings": [
+                {
+                    "file": f.path,
+                    "line": f.lineno,
+                    "rule": f.rule,
+                    "message": f.message,
+                    "text": f.text,
+                    "ratchet_state": state,
+                }
+                for state, group in (
+                    ("violation", violations),
+                    ("allowed", allowed),
+                )
+                for f in group
+            ],
+            "stale": [
+                {"rule": r, "file": p, "used": u, "budget": b}
+                for r, p, u, b in stale
+            ],
+            "broken": [
+                {"file": rel, "error": err} for rel, err in broken
+            ],
+            "counts": [
+                {"rule": r, "file": p, "count": c}
+                for (r, p), c in sorted(counts.items())
+            ],
+        }
+        print(json.dumps(doc, indent=1))
+        return rc
     if broken:
-        rc = 1
         print("edlint: %d unparseable file(s)" % len(broken))
         for rel, err in broken:
             print("  %s: %s" % (rel, err))
     if violations:
-        rc = 1
         print("edlint: %d violation(s)" % len(violations))
         for f in violations:
             print(
@@ -303,23 +380,13 @@ def main(argv=None):
             "elasticdl_tpu/tools/edlint/ratchet.py with a reason, in "
             "the same review."
         )
-    if args.stale:
-        # scope the stale check to the rules that actually ran: a
-        # subset run (the greps_guard shim's R1-R3) has zero counts
-        # for every other rule and must not read their budgets as slack
-        stale = [
-            s
-            for s in stale_entries(counts)
-            if rule_ids is None or s[0] in rule_ids
-        ]
-        if stale:
-            rc = 1
-            print("edlint: %d stale ratchet entr(ies)" % len(stale))
-            for rule_id, path, used, budget in stale:
-                print(
-                    "  %s %s: budget %d, used %d — shrink it"
-                    % (rule_id, path, budget, used)
-                )
+    if stale:
+        print("edlint: %d stale ratchet entr(ies)" % len(stale))
+        for rule_id, path, used, budget in stale:
+            print(
+                "  %s %s: budget %d, used %d — shrink it"
+                % (rule_id, path, budget, used)
+            )
     return rc
 
 

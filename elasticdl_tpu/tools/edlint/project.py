@@ -1,0 +1,1236 @@
+"""edlint whole-program layer: cached module IR, cross-file call graph,
+thread-root discovery, and the lockset machinery behind R8/R9 and the
+interprocedural lift of R5 (docs/static_analysis.md).
+
+The per-file :class:`~elasticdl_tpu.tools.edlint.core.FileContext` sees
+one module; this layer sees all of them at once:
+
+- **parse cache** — every module's AST is pickled under the user cache
+  dir (``$XDG_CACHE_HOME/edlint/ast-<root-hash>.pkl``) keyed by
+  (mtime_ns, size), so a repeated ``check.sh`` run re-parses only the
+  files that changed (``--no-cache`` bypasses both read and write);
+  the cache deliberately lives *outside* the scanned tree — it is
+  loaded with :mod:`pickle`, and a crafted cache file committed into a
+  checkout would otherwise execute code the moment anyone lints it;
+- **resolution** — imports (including the lazy function-body imports
+  this codebase favors), classes with best-effort MRO, module-level
+  functions, ``self._field = ClassName(...)`` attribute typing, and
+  local ``x = ClassName(...)`` typing, combined into a cross-file call
+  graph;
+- **thread roots** — ``threading.Thread(target=...)`` targets,
+  ``executor.submit(fn)`` arguments, gRPC servicer methods (everything
+  a ``rpc_methods()`` dict exposes runs on the server's 64-thread
+  pool), and the *owner* surface of any class that spawns one of the
+  above (its public methods run on whichever thread holds the object);
+- **lockset walk** — per-function summaries record every shared-state
+  access and every call together with the set of locks lexically held;
+  a per-root DFS composes them into absolute locksets, which is what
+  R8 intersects.
+
+Soundness caveats (also in docs/static_analysis.md): dynamic dispatch
+through ``getattr``/callables-in-variables is invisible, locks are
+identified lexically (an aliased ``lock = self._lock`` loses identity),
+and fields are keyed by the class that *defines* the accessing method,
+so base/subclass splits of one attribute are not unified. The analyzer
+over-reports rather than silently skipping: benign races it cannot
+prove safe are ratcheted with reasons, not suppressed in code.
+"""
+
+import ast
+import hashlib
+import logging
+import os
+import pickle
+import sys
+from collections import namedtuple
+
+from elasticdl_tpu.tools.edlint.core import (
+    FileContext,
+    binding_of,
+    call_kwarg,
+    dotted,
+)
+
+logger = logging.getLogger(__name__)
+
+CACHE_VERSION = 2
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_FUNC_LIKE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# field ctors whose instances are internally synchronized — loads and
+# method calls on such a field are not shared-state accesses
+_THREADSAFE_CTORS = frozenset(
+    (
+        "Lock",
+        "RLock",
+        "Condition",
+        "Event",
+        "Semaphore",
+        "BoundedSemaphore",
+        "Barrier",
+        "Queue",
+        "LifoQueue",
+        "PriorityQueue",
+        "SimpleQueue",
+        "deque",
+        "local",
+    )
+)
+
+# container-mutator method names: a call like ``self._pending.append(x)``
+# mutates the field even though the AST shows only a Load of ``_pending``
+_MUTATORS = frozenset(
+    (
+        "append",
+        "extend",
+        "insert",
+        "remove",
+        "pop",
+        "popitem",
+        "clear",
+        "update",
+        "setdefault",
+        "add",
+        "discard",
+        "appendleft",
+        "popleft",
+        "sort",
+        "reverse",
+    )
+)
+
+
+# ---------------------------------------------------------------------------
+# parse cache
+# ---------------------------------------------------------------------------
+
+
+def _cache_path(root):
+    # NOT inside ``root``: the cache is unpickled, so its location must
+    # be one the scanned tree cannot write to — a .pkl committed into a
+    # checkout would run arbitrary code inside every lint of that tree
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    # the interpreter version joins the key: pickled ast nodes rebuilt
+    # under a different Python's ast classes (changed slice shapes,
+    # added end_lineno, ...) crash mid-rule or silently misanalyze
+    digest = hashlib.sha256(
+        ("%s\0%d.%d" % (os.path.realpath(root), *sys.version_info[:2]))
+        .encode("utf-8")
+    ).hexdigest()[:16]
+    return os.path.join(base, "edlint", "ast-%s.pkl" % digest)
+
+
+def _load_cache(root):
+    try:
+        with open(_cache_path(root), "rb") as f:
+            payload = pickle.load(f)
+    except (OSError, EOFError, pickle.PickleError, AttributeError, ValueError):
+        return {}
+    if not isinstance(payload, dict) or payload.get("version") != CACHE_VERSION:
+        return {}
+    return payload.get("files", {})
+
+
+def _save_cache(root, entries):
+    path = _cache_path(root)
+    tmp = path + ".tmp.%d" % os.getpid()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump({"version": CACHE_VERSION, "files": entries}, f)
+        os.replace(tmp, path)
+    except (OSError, pickle.PickleError):
+        # a read-only checkout just re-parses next run
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_contexts(root, paths, use_cache=True):
+    """Parse ``paths`` into ``{relpath: FileContext}`` + broken list,
+    reusing the on-disk AST cache for files whose (mtime_ns, size) is
+    unchanged. Returns ``(contexts, broken, cache_stats)`` where
+    ``cache_stats`` is ``{"hits": n, "misses": n}``."""
+    cache = _load_cache(root) if use_cache else {}
+    contexts = {}
+    broken = []
+    fresh = {}
+    stats = {"hits": 0, "misses": 0}
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            st = os.stat(path)
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError as err:
+            broken.append((rel, str(err)))
+            continue
+        entry = cache.get(rel)
+        if entry is not None and entry.get("key") == key:
+            contexts[rel] = FileContext(
+                rel, entry["source"], tree=entry["tree"]
+            )
+            fresh[rel] = entry
+            stats["hits"] += 1
+            continue
+        stats["misses"] += 1
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctx = FileContext(rel, source)
+        except (SyntaxError, OSError, UnicodeDecodeError) as err:
+            broken.append((rel, str(err)))
+            continue
+        contexts[rel] = ctx
+        fresh[rel] = {"key": key, "source": source, "tree": ctx.tree}
+    if use_cache and (stats["misses"] or set(fresh) != set(cache)):
+        _save_cache(root, fresh)
+    return contexts, broken, stats
+
+
+# ---------------------------------------------------------------------------
+# module naming / imports
+# ---------------------------------------------------------------------------
+
+
+def module_name(rel):
+    """'elasticdl_tpu/worker/worker.py' -> 'elasticdl_tpu.worker.worker'."""
+    p = rel[:-3] if rel.endswith(".py") else rel
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+ClassInfo = namedtuple(
+    "ClassInfo", "key node ctx base_dotted methods attr_ctors safe_attrs"
+)
+
+Root = namedtuple("Root", "kind fn label")
+
+Access = namedtuple("Access", "kind target locks lineno const")
+# kind: 'r' | 'w'; target: ('f', class_key, attr) | ('g', mod, name);
+# const: True when a write stores a bare Constant (flag-publish shape)
+
+RaceFinding = namedtuple(
+    "RaceFinding", "target path lineno message"
+)
+
+
+class _Summary:
+    __slots__ = ("accesses", "calls", "blocking", "is_init")
+
+    def __init__(self):
+        self.accesses = []  # [Access]
+        self.calls = []  # [(call node, rel-lockset frozenset, lineno)]
+        self.blocking = []  # [(kind str, rel-lockset, lineno)]
+        self.is_init = False
+
+
+class Project:
+    """Cross-file resolution + the analyses R5/R8/R9 share."""
+
+    def __init__(self, contexts):
+        self.contexts = contexts  # {rel: FileContext}
+        self.modules = {}  # modname -> rel
+        self.functions = {}  # (mod, name) -> fn node
+        self.classes = {}  # (mod, cls) -> ClassInfo
+        self.imports = {}  # mod -> {local name: absolute dotted}
+        self.fn_home = {}  # id(fn) -> (ctx, class_key|None, qualname)
+        self.module_globals = {}  # mod -> set of module-level names
+        self.written_globals = set()  # (mod, name) rebound via `global`
+        self._summaries = {}
+        self._chains = {}
+        self._chain_state = {}
+        self._roots = None
+        self._races = None
+        self._resolved_calls = {}
+        for rel in sorted(contexts):
+            self._index_module(rel, contexts[rel])
+
+    # -- indexing -------------------------------------------------------
+
+    def _index_module(self, rel, ctx):
+        mod = module_name(rel)
+        self.modules[mod] = rel
+        imp = self.imports.setdefault(mod, {})
+        pkg = mod.rsplit(".", 1)[0] if "." in mod else ""
+        is_pkg = rel.endswith("/__init__.py")
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".", 1)[0]
+                    target = alias.name if alias.asname else local
+                    imp.setdefault(local, target)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative import: anchor at the enclosing package
+                    anchor = mod if is_pkg else pkg
+                    for _ in range(node.level - 1):
+                        anchor = (
+                            anchor.rsplit(".", 1)[0] if "." in anchor else ""
+                        )
+                    base = (
+                        "%s.%s" % (anchor, base) if base else anchor
+                    )
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    imp.setdefault(local, "%s.%s" % (base, alias.name))
+        mod_names = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        mod_names.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                mod_names.add(stmt.target.id)
+        self.module_globals[mod] = mod_names
+        for node in ctx.tree.body:
+            if isinstance(node, _FUNC_DEFS):
+                self.functions[(mod, node.name)] = node
+                self.fn_home[id(node)] = (ctx, None, node.name)
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(mod, ctx, node)
+        # `global NAME` rebinding anywhere in the module marks NAME as a
+        # written global program-wide (R8 only tracks globals someone
+        # actually writes)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, _FUNC_DEFS):
+                continue
+            declared = set()
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Global):
+                    declared.update(n.names)
+            if not declared:
+                continue
+            for n in ast.walk(fn):
+                if (
+                    isinstance(n, (ast.Assign, ast.AugAssign))
+                    or isinstance(n, ast.Delete)
+                ):
+                    targets = (
+                        n.targets
+                        if isinstance(n, (ast.Assign, ast.Delete))
+                        else [n.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name) and t.id in declared:
+                            self.written_globals.add((mod, t.id))
+
+    def _index_class(self, mod, ctx, node):
+        key = (mod, node.name)
+        methods = {}
+        attr_ctors = {}
+        safe_attrs = set()
+        for stmt in node.body:
+            if isinstance(stmt, _FUNC_DEFS):
+                methods[stmt.name] = stmt
+                self.fn_home[id(stmt)] = (
+                    ctx,
+                    key,
+                    "%s.%s" % (node.name, stmt.name),
+                )
+        for m in methods.values():
+            for n in ast.walk(m):
+                if not isinstance(n, ast.Assign):
+                    continue
+                if not isinstance(n.value, ast.Call):
+                    continue
+                ctor = dotted(n.value.func)
+                if not ctor:
+                    continue
+                tail = ctor.rsplit(".", 1)[-1]
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attr_ctors.setdefault(t.attr, set()).add(ctor)
+                        if tail in _THREADSAFE_CTORS:
+                            safe_attrs.add(t.attr)
+        bases = [dotted(b) for b in node.bases]
+        self.classes[key] = ClassInfo(
+            key, node, ctx, [b for b in bases if b], methods, attr_ctors,
+            safe_attrs,
+        )
+
+    # -- resolution -----------------------------------------------------
+
+    def expand(self, mod, d):
+        """Import-expand a dotted name used in ``mod`` to its absolute
+        dotted form ('Client' -> 'elasticdl_tpu.rpc.core.Client')."""
+        if not d:
+            return d
+        head, _, rest = d.partition(".")
+        target = self.imports.get(mod, {}).get(head)
+        if target is None:
+            return d
+        return "%s.%s" % (target, rest) if rest else target
+
+    def resolve_absolute(self, full, depth=0):
+        """('fn', node) | ('cls', ClassInfo) | None for an absolute
+        dotted name, following one re-export hop per segment."""
+        if depth > 4 or not full:
+            return None
+        parts = full.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            m = ".".join(parts[:i])
+            if m not in self.modules:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                fn = self.functions.get((m, rest[0]))
+                if fn is not None:
+                    return ("fn", fn)
+                ci = self.classes.get((m, rest[0]))
+                if ci is not None:
+                    return ("cls", ci)
+                reexport = self.imports.get(m, {}).get(rest[0])
+                if reexport is not None and reexport != full:
+                    return self.resolve_absolute(reexport, depth + 1)
+            elif len(rest) == 2:
+                ci = self.classes.get((m, rest[0]))
+                if ci is not None:
+                    meth = self.lookup_method((m, rest[0]), rest[1])
+                    if meth is not None:
+                        return ("fn", meth)
+            return None
+        return None
+
+    def resolve_dotted(self, mod, d):
+        """Resolve a dotted name as used inside ``mod``."""
+        if not d:
+            return None
+        if "." not in d:
+            fn = self.functions.get((mod, d))
+            if fn is not None:
+                return ("fn", fn)
+            ci = self.classes.get((mod, d))
+            if ci is not None:
+                return ("cls", ci)
+        return self.resolve_absolute(self.expand(mod, d))
+
+    def lookup_method(self, class_key, name, _seen=None):
+        """Method ``name`` on ``class_key`` or its resolvable bases."""
+        if _seen is None:
+            _seen = set()
+        if class_key in _seen:
+            return None
+        _seen.add(class_key)
+        ci = self.classes.get(class_key)
+        if ci is None:
+            return None
+        fn = ci.methods.get(name)
+        if fn is not None:
+            return fn
+        for base in ci.base_dotted:
+            r = self.resolve_dotted(class_key[0], base)
+            if r is not None and r[0] == "cls":
+                fn = self.lookup_method(r[1].key, name, _seen)
+                if fn is not None:
+                    return fn
+        return None
+
+    def class_of(self, fn):
+        home = self.fn_home.get(id(fn))
+        return home[1] if home else None
+
+    def module_of_ctx(self, ctx):
+        return module_name(ctx.path)
+
+    def attr_classes(self, class_key, attr, _seen=None):
+        """ClassInfos that ``self.<attr>`` of ``class_key`` may hold,
+        from ``self.attr = ClassName(...)`` assignments (bases too)."""
+        if _seen is None:
+            _seen = set()
+        if class_key in _seen:
+            return []
+        _seen.add(class_key)
+        ci = self.classes.get(class_key)
+        if ci is None:
+            return []
+        out = []
+        for ctor in sorted(ci.attr_ctors.get(attr, ())):
+            r = self.resolve_dotted(class_key[0], ctor)
+            if r is not None and r[0] == "cls":
+                out.append(r[1])
+        if not out:
+            for base in ci.base_dotted:
+                r = self.resolve_dotted(class_key[0], base)
+                if r is not None and r[0] == "cls":
+                    out.extend(
+                        self.attr_classes(r[1].key, attr, _seen)
+                    )
+        return out
+
+    def _local_types(self, fn, ctx, class_key):
+        """{local name: [ClassInfo]} from ``x = ClassName(...)``."""
+        mod = self.module_of_ctx(ctx)
+        out = {}
+        for n in ctx.walk_shallow(fn, stop=_FUNC_LIKE):
+            if not isinstance(n, ast.Assign):
+                continue
+            if not isinstance(n.value, ast.Call):
+                continue
+            d = dotted(n.value.func)
+            if not d:
+                continue
+            r = self.resolve_dotted(mod, d)
+            if r is None or r[0] != "cls":
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(r[1])
+        return out
+
+    def _nested_def(self, enclosing_fn, name):
+        """A def named ``name`` nested anywhere inside ``enclosing_fn``."""
+        if enclosing_fn is None:
+            return None
+        for n in ast.walk(enclosing_fn):
+            if isinstance(n, _FUNC_DEFS) and n.name == name and n is not (
+                enclosing_fn
+            ):
+                return n
+        return None
+
+    def resolve_call_at(self, ctx, call, enclosing_fn=None, class_key=None):
+        """Callee fn/lambda nodes a call expression may reach (cached).
+
+        Best-effort and deliberately narrow: names and dotted paths
+        through the import table, ``self.method`` through the MRO,
+        ``self._field.method`` / ``local.method`` through constructor
+        typing. Unresolvable calls return [] (soundness caveat)."""
+        cached = self._resolved_calls.get(id(call))
+        if cached is not None:
+            return cached
+        if enclosing_fn is None:
+            enclosing_fn = ctx.enclosing(call, _FUNC_DEFS)
+        if class_key is None and enclosing_fn is not None:
+            class_key = self.class_of(enclosing_fn)
+            if class_key is None:
+                cls_node = ctx.enclosing(call, ast.ClassDef)
+                if cls_node is not None:
+                    class_key = (self.module_of_ctx(ctx), cls_node.name)
+        mod = self.module_of_ctx(ctx)
+        out = []
+        f = call.func
+        if isinstance(f, ast.Name):
+            nested = self._nested_def(enclosing_fn, f.id)
+            if nested is not None:
+                out = [nested]
+            else:
+                r = self.resolve_dotted(mod, f.id)
+                if r is not None and r[0] == "fn":
+                    out = [r[1]]
+                elif r is not None and r[0] == "cls":
+                    init = self.lookup_method(r[1].key, "__init__")
+                    if init is not None:
+                        out = [init]
+        elif isinstance(f, ast.Attribute):
+            if (
+                isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and class_key is not None
+            ):
+                m = self.lookup_method(class_key, f.attr)
+                if m is not None:
+                    out = [m]
+            if not out:
+                d = dotted(f)
+                if d:
+                    r = self.resolve_dotted(mod, d)
+                    if r is not None and r[0] == "fn":
+                        out = [r[1]]
+            if not out and class_key is not None and (
+                isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+            ):
+                for ci in self.attr_classes(class_key, f.value.attr):
+                    m = self.lookup_method(ci.key, f.attr)
+                    if m is not None:
+                        out.append(m)
+            if not out and isinstance(f.value, ast.Name) and (
+                enclosing_fn is not None
+            ):
+                for ci in self._local_types(
+                    enclosing_fn, ctx, class_key
+                ).get(f.value.id, ()):
+                    m = self.lookup_method(ci.key, f.attr)
+                    if m is not None:
+                        out.append(m)
+        self._resolved_calls[id(call)] = out
+        return out
+
+    # -- lock identity --------------------------------------------------
+
+    def _is_lock_acquire(self, ctx, expr):
+        """Lockset membership is broader than R5's lockish test: holding
+        a Condition's underlying lock DOES protect state."""
+        b = binding_of(expr)
+        if b is None:
+            return False
+        if b in ctx.lock_bindings or b in ctx.condition_bindings:
+            return True
+        low = b[1].lower()
+        return (
+            "lock" in low
+            or low == "_mu"
+            or low.endswith("_mu")
+            or "cond" in low
+        )
+
+    def lock_id(self, ctx, class_key, expr):
+        """Stable identity for a held lock. ``self._x`` locks key on the
+        defining class; module-level locks on the module; anything else
+        falls back to the attribute/dotted text (lexical identity —
+        aliasing is a documented soundness caveat)."""
+        mod = self.module_of_ctx(ctx)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and class_key is not None
+        ):
+            return ("f", class_key, expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_globals.get(mod, ()):
+                return ("g", mod, expr.id)
+            return ("x", expr.id)
+        d = dotted(expr)
+        if isinstance(expr, ast.Attribute):
+            return ("x", expr.attr)
+        return ("x", d or "anon@%d" % getattr(expr, "lineno", 0))
+
+    # -- per-function summaries ----------------------------------------
+
+    def summary(self, fn):
+        s = self._summaries.get(id(fn))
+        if s is None:
+            s = self._summarize(fn)
+            self._summaries[id(fn)] = s
+        return s
+
+    def _summarize(self, fn):
+        home = self.fn_home.get(id(fn))
+        if home is None:
+            # lambda / nested def discovered as a thread target: walk it
+            # in the context of its defining file if we can find one
+            ctx = self._ctx_containing(fn)
+            class_key = None
+            name = getattr(fn, "name", "<lambda>")
+        else:
+            ctx, class_key, name = home
+        s = _Summary()
+        if ctx is None:
+            return s
+        s.is_init = getattr(fn, "name", "") in ("__init__", "__del__")
+        r5 = _blocking_rule()
+        mod = self.module_of_ctx(ctx)
+        ci = self.classes.get(class_key) if class_key else None
+        method_names = set(ci.methods) if ci else set()
+        safe_attrs = ci.safe_attrs if ci else set()
+        declared_global = set()
+        local_names = set()
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        args = fn.args
+        for a in (
+            args.posonlyargs + args.args + args.kwonlyargs
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            local_names.add(a.arg)
+        for n in ctx.walk_shallow(fn, stop=_FUNC_LIKE):
+            if isinstance(n, ast.Global):
+                declared_global.update(n.names)
+            elif isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)
+            ):
+                local_names.add(n.id)
+        mod_globals = self.module_globals.get(mod, set())
+
+        def record_field(kind, attr, held, lineno, const=False):
+            if attr in safe_attrs:
+                return
+            if kind == "r" and attr in method_names:
+                return
+            if class_key is None:
+                return
+            s.accesses.append(
+                Access(
+                    kind, ("f", class_key, attr), frozenset(held), lineno,
+                    const,
+                )
+            )
+
+        def record_global(kind, gname, held, lineno, const=False):
+            if (mod, gname) not in self.written_globals:
+                return
+            s.accesses.append(
+                Access(
+                    kind, ("g", mod, gname), frozenset(held), lineno, const
+                )
+            )
+
+        def record_store(t, held, const=False):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    record_store(e, held, const)
+                return
+            if isinstance(t, ast.Starred):
+                record_store(t.value, held, const)
+                return
+            if isinstance(t, ast.Name):
+                if t.id in declared_global or (
+                    t.id not in local_names and t.id in mod_globals
+                ):
+                    record_global("w", t.id, held, t.lineno, const)
+                return
+            if isinstance(t, ast.Attribute):
+                if isinstance(t.value, ast.Name) and t.value.id == "self":
+                    record_field("w", t.attr, held, t.lineno, const)
+                else:
+                    visit(t.value, held)
+                return
+            if isinstance(t, ast.Subscript):
+                # ``self._d[k] = v`` mutates _d even though _d is a Load
+                base = t.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    record_field("w", base.attr, held, t.lineno)
+                elif isinstance(base, ast.Name):
+                    if base.id in declared_global or (
+                        base.id not in local_names and base.id in mod_globals
+                    ):
+                        record_global("w", base.id, held, t.lineno)
+                else:
+                    visit(base, held)
+                visit(t.slice, held)
+                return
+
+        def try_finally_lock(node):
+            """Lock id when a Try's finally releases one (the
+            acquire/try/finally-release region R5 already models)."""
+            for fin in node.finalbody:
+                for n in ast.walk(fin):
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "release"
+                        and self._is_lock_acquire(ctx, n.func.value)
+                    ):
+                        return self.lock_id(ctx, class_key, n.func.value)
+            return None
+
+        def visit(node, held):
+            if node is None or isinstance(node, _FUNC_LIKE):
+                return
+            if isinstance(node, ast.With):
+                acquired = set()
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    if self._is_lock_acquire(ctx, item.context_expr):
+                        acquired.add(
+                            self.lock_id(ctx, class_key, item.context_expr)
+                        )
+                inner = held | acquired if acquired else held
+                for st in node.body:
+                    visit(st, inner)
+                return
+            if isinstance(node, ast.Try):
+                lid = try_finally_lock(node)
+                inner = held | {lid} if lid else held
+                for st in node.body:
+                    visit(st, inner)
+                for h in node.handlers:
+                    for st in h.body:
+                        visit(st, held)
+                for st in node.orelse:
+                    visit(st, inner if lid else held)
+                for st in node.finalbody:
+                    visit(st, held)
+                return
+            if isinstance(node, ast.Assign):
+                visit(node.value, held)
+                const = isinstance(node.value, ast.Constant)
+                for t in node.targets:
+                    record_store(t, held, const)
+                return
+            if isinstance(node, ast.AugAssign):
+                visit(node.value, held)
+                # += reads AND writes: record both, never const
+                t = node.target
+                if isinstance(t, ast.Attribute) and (
+                    isinstance(t.value, ast.Name) and t.value.id == "self"
+                ):
+                    record_field("r", t.attr, held, t.lineno)
+                elif isinstance(t, ast.Name):
+                    if t.id in declared_global or (
+                        t.id not in local_names and t.id in mod_globals
+                    ):
+                        record_global("r", t.id, held, t.lineno)
+                record_store(t, held)
+                return
+            if isinstance(node, (ast.AnnAssign,)):
+                visit(node.value, held)
+                if node.value is not None:
+                    record_store(
+                        node.target, held,
+                        isinstance(node.value, ast.Constant),
+                    )
+                return
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    record_store(t, held)
+                return
+            if isinstance(node, ast.Call):
+                kind = r5._blocking_kind(ctx, node)
+                if kind:
+                    s.blocking.append((kind, frozenset(held), node.lineno))
+                s.calls.append((node, frozenset(held), node.lineno))
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    recv = f.value
+                    if (
+                        f.attr in _MUTATORS
+                        and isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                        # a mutator NAME on a field typed to an
+                        # in-project class (self._membership.remove)
+                        # is a method call — the call graph follows
+                        # into it and analyzes its own locking
+                        and not (
+                            class_key is not None
+                            and self.attr_classes(class_key, recv.attr)
+                        )
+                    ):
+                        record_field("w", recv.attr, held, node.lineno)
+                    elif (
+                        f.attr in _MUTATORS
+                        and isinstance(recv, ast.Name)
+                        and (
+                            recv.id in declared_global
+                            or (
+                                recv.id not in local_names
+                                and recv.id in mod_globals
+                            )
+                        )
+                    ):
+                        record_global("w", recv.id, held, node.lineno)
+                    else:
+                        visit(recv, held)
+                for a in node.args:
+                    visit(a, held)
+                for kw in node.keywords:
+                    visit(kw.value, held)
+                return
+            if isinstance(node, ast.Attribute):
+                if (
+                    isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    record_field("r", node.attr, held, node.lineno)
+                    return
+                visit(node.value, held)
+                return
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load) and (
+                    node.id not in local_names
+                ):
+                    record_global("r", node.id, held, node.lineno)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for st in body:
+            visit(st, frozenset())
+        return s
+
+    def _ctx_containing(self, node):
+        for ctx in self.contexts.values():
+            if node in ctx.parent or node is ctx.tree:
+                return ctx
+        return None
+
+    # -- thread roots ---------------------------------------------------
+
+    THREAD_CTORS = ("threading.Thread", "_threading.Thread", "Thread")
+
+    def roots(self):
+        if self._roots is None:
+            self._roots = self._discover_roots()
+        return self._roots
+
+    def _discover_roots(self):
+        roots = []
+        rooted = {}  # id(fn) -> kind
+        concurrent_classes = set()
+        spawn_targets = set()
+
+        def add(kind, fn, label):
+            if fn is None:
+                return
+            prev = rooted.get(id(fn))
+            if prev is not None:
+                return
+            rooted[id(fn)] = kind
+            roots.append(Root(kind, fn, label))
+
+        def resolve_target(ctx, class_key, enclosing_fn, expr):
+            if expr is None:
+                return []
+            if isinstance(expr, ast.Lambda):
+                return [expr]
+            if isinstance(expr, ast.Call):
+                tail = dotted(expr.func).rsplit(".", 1)[-1]
+                if tail == "partial" and expr.args:
+                    return resolve_target(
+                        ctx, class_key, enclosing_fn, expr.args[0]
+                    )
+                return []
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and class_key is not None
+            ):
+                m = self.lookup_method(class_key, expr.attr)
+                return [m] if m is not None else []
+            if isinstance(expr, ast.Name):
+                nested = self._nested_def(enclosing_fn, expr.id)
+                if nested is not None:
+                    return [nested]
+                # a local bound to a lambda / nested def
+                if enclosing_fn is not None:
+                    for n in ast.walk(enclosing_fn):
+                        if (
+                            isinstance(n, ast.Assign)
+                            and len(n.targets) == 1
+                            and isinstance(n.targets[0], ast.Name)
+                            and n.targets[0].id == expr.id
+                            and isinstance(n.value, ast.Lambda)
+                        ):
+                            return [n.value]
+                r = self.resolve_dotted(
+                    self.module_of_ctx(ctx), expr.id
+                )
+                if r is not None and r[0] == "fn":
+                    return [r[1]]
+            return []
+
+        for rel in sorted(self.contexts):
+            ctx = self.contexts[rel]
+            mod = module_name(rel)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                enclosing_fn = ctx.enclosing(node, _FUNC_DEFS)
+                cls_node = ctx.enclosing(node, ast.ClassDef)
+                class_key = (mod, cls_node.name) if cls_node else None
+                d = dotted(node.func)
+                if d in self.THREAD_CTORS:
+                    tgt = call_kwarg(node, "target")
+                    for fn in resolve_target(
+                        ctx, class_key, enclosing_fn, tgt
+                    ):
+                        add(
+                            "thread",
+                            fn,
+                            "thread:%s:%d" % (rel, node.lineno),
+                        )
+                        spawn_targets.add(id(fn))
+                        home = self.fn_home.get(id(fn))
+                        if home is not None and home[1] is not None:
+                            concurrent_classes.add(home[1])
+                    if class_key is not None:
+                        concurrent_classes.add(class_key)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit"
+                    and node.args
+                ):
+                    for fn in resolve_target(
+                        ctx, class_key, enclosing_fn, node.args[0]
+                    ):
+                        add(
+                            "submit",
+                            fn,
+                            "submit:%s:%d" % (rel, node.lineno),
+                        )
+                        spawn_targets.add(id(fn))
+                        home = self.fn_home.get(id(fn))
+                        if home is not None and home[1] is not None:
+                            concurrent_classes.add(home[1])
+                    if class_key is not None:
+                        concurrent_classes.add(class_key)
+
+        # gRPC servicer surface: everything rpc_methods() exposes runs
+        # on the server pool (64 threads), concurrently with itself
+        for key in sorted(self.classes):
+            ci = self.classes[key]
+            rm = ci.methods.get("rpc_methods")
+            if rm is None:
+                continue
+            concurrent_classes.add(key)
+            for n in ast.walk(rm):
+                if (
+                    isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and n.attr in ci.methods
+                    and n.attr != "rpc_methods"
+                ):
+                    add(
+                        "servicer",
+                        ci.methods[n.attr],
+                        "servicer:%s.%s" % (key[1], n.attr),
+                    )
+
+        # owner surface: the public methods of every concurrent class
+        # run on whichever thread holds the object
+        for key in sorted(concurrent_classes):
+            ci = self.classes.get(key)
+            if ci is None:
+                continue
+            for name in sorted(ci.methods):
+                if name.startswith("_"):
+                    continue
+                fn = ci.methods[name]
+                if id(fn) in spawn_targets or id(fn) in rooted:
+                    continue
+                add("owner", fn, "owner:%s.%s" % (key[1], name))
+        return roots
+
+    # -- reachability + lockset composition ----------------------------
+
+    _MAX_VISITS_PER_ROOT = 4000
+
+    def _collect_root_accesses(self):
+        """{target: [(root_idx, Access, path, qualname, is_init)]}."""
+        by_target = {}
+        roots = self.roots()
+        for idx, root in enumerate(roots):
+            stack = [(root.fn, frozenset())]
+            seen = set()
+            visits = 0
+            while stack:
+                fn, held = stack.pop()
+                key = (id(fn), held)
+                if key in seen:
+                    continue
+                seen.add(key)
+                visits += 1
+                if visits > self._MAX_VISITS_PER_ROOT:
+                    # a truncated DFS can hide the unlocked half of a
+                    # racing pair — make the hole diagnosable instead
+                    # of letting the tree gate stay silently green
+                    logger.warning(
+                        "edlint R8: thread root %s exceeded %d visited "
+                        "(fn, lockset) states; accesses beyond the cap "
+                        "were NOT analyzed — races past it are missed",
+                        root.label,
+                        self._MAX_VISITS_PER_ROOT,
+                    )
+                    break
+                summ = self.summary(fn)
+                home = self.fn_home.get(id(fn))
+                ctx = home[0] if home else self._ctx_containing(fn)
+                if ctx is None:
+                    continue
+                qual = (
+                    home[2]
+                    if home
+                    else getattr(fn, "name", "<lambda>")
+                )
+                for acc in summ.accesses:
+                    merged = acc._replace(locks=acc.locks | held)
+                    by_target.setdefault(acc.target, []).append(
+                        (idx, merged, ctx.path, qual, summ.is_init)
+                    )
+                for call, locks, _lineno in summ.calls:
+                    for callee in self.resolve_call_at(ctx, call):
+                        stack.append((callee, held | locks))
+        for items in by_target.values():
+            items.sort(key=lambda it: (it[2], it[1].lineno, it[0]))
+        return by_target
+
+    @staticmethod
+    def _concurrent(root_a, root_b, same_root):
+        if same_root:
+            # a servicer method races itself (64-thread pool); a pool
+            # submit target races its sibling submissions; a Thread
+            # target races itself whenever the spawn site can execute
+            # more than once (per-worker watchers, per-shard pumps) —
+            # single-spawn is unprovable statically, so assume many
+            return root_a.kind in ("servicer", "submit", "thread")
+        if root_a.kind == "owner" and root_b.kind == "owner":
+            return False
+        return True
+
+    def races(self):
+        """Program-wide R8 findings (cached): shared targets with a
+        write outside ``__init__`` and a concurrent access pair whose
+        locksets do not intersect."""
+        if self._races is not None:
+            return self._races
+        out = []
+        roots = self.roots()
+        by_target = self._collect_root_accesses()
+        for target in sorted(by_target):
+            items = by_target[target]
+            if len(items) > 400:
+                logger.warning(
+                    "edlint R8: shared target %r has %d access records; "
+                    "only the first 400 (by file/line) were paired — a "
+                    "race whose only unlocked access sits in the tail "
+                    "is missed",
+                    target[-1],
+                    len(items),
+                )
+                items = items[:400]
+            writes = [
+                it for it in items if it[1].kind == "w" and not it[4]
+            ]
+            if not writes:
+                continue
+            # flag-publish exemption: every non-init write stores a bare
+            # constant (GIL-atomic cancel/None-out flags)
+            if all(it[1].const for it in writes):
+                continue
+            hit = None
+            for w in writes:
+                for o in items:
+                    if o is w:
+                        continue
+                    if o[4]:
+                        continue
+                    if not self._concurrent(
+                        roots[w[0]], roots[o[0]], w[0] == o[0]
+                    ):
+                        continue
+                    if w[1].locks & o[1].locks:
+                        continue
+                    hit = (w, o)
+                    break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            w, o = hit
+            if target[0] == "f":
+                tgt_desc = "%s.%s" % (target[1][1], target[2])
+            else:
+                tgt_desc = "%s:%s" % (target[1], target[2])
+            msg = (
+                "unsynchronized shared state %s: write in %s (%s:%d, "
+                "root %s, locks %s) can race %s in %s (%s:%d, root %s, "
+                "locks %s) — no common lock on any path"
+                % (
+                    tgt_desc,
+                    w[3],
+                    w[2],
+                    w[1].lineno,
+                    roots[w[0]].label,
+                    _lockset_desc(w[1].locks),
+                    "write" if o[1].kind == "w" else "read",
+                    o[3],
+                    o[2],
+                    o[1].lineno,
+                    roots[o[0]].label,
+                    _lockset_desc(o[1].locks),
+                )
+            )
+            out.append(RaceFinding(target, w[2], w[1].lineno, msg))
+        out.sort(key=lambda r: (r.path, r.lineno))
+        self._races = out
+        return out
+
+    # -- interprocedural blocking chains (R5 lift) ----------------------
+
+    def blocking_chain(self, fn):
+        """('name -> ... [sink]', lineno) when ``fn`` transitively
+        reaches a blocking call through the cross-file graph."""
+        key = id(fn)
+        state = self._chain_state.get(key)
+        if state == "done":
+            return self._chains.get(key)
+        if state == "visiting":
+            return None  # recursion: break the cycle
+        self._chain_state[key] = "visiting"
+        result = None
+        # a None computed while a cycle member sat on the DFS stack is
+        # not a proof of non-blocking (that member's other branches were
+        # invisible) — cacheing it as "done" would make R5 findings
+        # depend on which file happened to be scanned first
+        poisoned = False
+        summ = self.summary(fn)
+        name = getattr(fn, "name", "<lambda>")
+        if summ.blocking:
+            kind, _locks, lineno = min(
+                summ.blocking, key=lambda b: b[2]
+            )
+            result = ("%s [%s]" % (name, kind), lineno)
+        else:
+            home = self.fn_home.get(id(fn))
+            ctx = home[0] if home else self._ctx_containing(fn)
+            if ctx is not None:
+                for call, _locks, _lineno in summ.calls:
+                    for callee in self.resolve_call_at(ctx, call):
+                        ck = id(callee)
+                        if self._chain_state.get(ck) == "visiting":
+                            poisoned = True
+                            continue
+                        sub = self.blocking_chain(callee)
+                        if sub is not None:
+                            result = (
+                                "%s -> %s" % (name, sub[0]),
+                                sub[1],
+                            )
+                            break
+                        if self._chain_state.get(ck) != "done":
+                            poisoned = True  # callee's None was, too
+                    if result:
+                        break
+        if result is None and poisoned:
+            # unreliable negative: recompute on the next query, once
+            # the cycle members that hid branches have settled
+            del self._chain_state[key]
+            return None
+        self._chain_state[key] = "done"
+        if result is not None:
+            self._chains[key] = result
+        return result
+
+
+def _lockset_desc(locks):
+    if not locks:
+        return "{}"
+    names = sorted(
+        lid[2] if lid[0] == "f" else lid[-1] for lid in locks
+    )
+    return "{%s}" % ", ".join(names)
+
+
+_BLOCKING_RULE = []
+
+
+def _blocking_rule():
+    if not _BLOCKING_RULE:
+        from elasticdl_tpu.tools.edlint.rules import BlockingUnderLockRule
+
+        _BLOCKING_RULE.append(BlockingUnderLockRule())
+    return _BLOCKING_RULE[0]

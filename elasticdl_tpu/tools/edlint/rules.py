@@ -378,8 +378,10 @@ class BlockingUnderLockRule(Rule):
         "no RPC, blocking queue op, sleep, join/wait/result, or file/"
         "checkpoint IO lexically inside a `with lock:` body (or an "
         "acquire/try/finally-release region) — snapshot under the lock, "
-        "do the slow thing after release; one-file call chains through "
-        "same-class methods are followed"
+        "do the slow thing after release; call chains are followed "
+        "through same-class methods AND, via the whole-program call "
+        "graph, across module boundaries (imported functions, "
+        "self._field.method() with constructor-typed fields)"
     )
 
     def _lockish(self, ctx, expr):
@@ -570,6 +572,8 @@ class BlockingUnderLockRule(Rule):
                             chain = summaries.get((cls.name, f.attr))
                     elif isinstance(f, ast.Name):
                         chain = summaries.get((None, f.id))
+                    if chain is None:
+                        chain = self._project_chain(ctx, node)
                     if chain:
                         seen.add(id(node))
                         out.append(
@@ -581,6 +585,22 @@ class BlockingUnderLockRule(Rule):
                             )
                         )
         return out
+
+    def _project_chain(self, ctx, call):
+        """Cross-file lift: when the one-file summaries cannot resolve
+        the call, ask the whole-program graph whether any resolvable
+        callee transitively blocks (an imported helper, another
+        module's class method reached through a typed field). This is
+        how the PR-4 ledger-lock shape stays caught when the caller
+        and the blocking callee live in different files."""
+        project = getattr(ctx, "project", None)
+        if project is None:
+            return None
+        for callee in project.resolve_call_at(ctx, call):
+            sub = project.blocking_chain(callee)
+            if sub is not None:
+                return sub  # chain text starts at the callee's name
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -800,6 +820,299 @@ class JitPurityRule(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# R8 — static lockset race detector
+# ---------------------------------------------------------------------------
+
+
+class LocksetRaceRule(Rule):
+    id = "R8"
+    name = "lockset-race"
+    doc = (
+        "RacerD-style static lockset analysis over the whole-program "
+        "call graph: a self._field or written module global reachable "
+        "from >=2 concurrent thread roots (Thread targets, executor "
+        "submits, gRPC servicer methods, the owner surface of a "
+        "spawning class) with at least one write outside __init__ and "
+        "an access pair whose held-lock sets do not intersect is a "
+        "race; path coverage the runtime lock sanitizer structurally "
+        "lacks (it only sees orderings a test actually executes)"
+    )
+
+    # the threaded planes this rule gates (the ISSUE-7 floor was
+    # master/worker/ps/parallel/profiling; common/, data/ and rpc/
+    # joined once their findings were triaged)
+    SCOPE_PREFIXES = (
+        "elasticdl_tpu/master/",
+        "elasticdl_tpu/worker/",
+        "elasticdl_tpu/ps/",
+        "elasticdl_tpu/parallel/",
+        "elasticdl_tpu/common/",
+        "elasticdl_tpu/data/",
+        "elasticdl_tpu/rpc/",
+    )
+    SCOPE_FILES = ("elasticdl_tpu/utils/profiling.py",)
+
+    def _in_scope(self, path):
+        return path in self.SCOPE_FILES or any(
+            path.startswith(p) for p in self.SCOPE_PREFIXES
+        )
+
+    def check(self, ctx):
+        project = getattr(ctx, "project", None)
+        if project is None or not self._in_scope(ctx.path):
+            return []
+        out = []
+        for race in project.races():
+            # races() is program-wide; report each at its write site so
+            # the per-file ratchet keys stay meaningful
+            if race.path != ctx.path:
+                continue
+            out.append(
+                Finding(
+                    self.id,
+                    race.path,
+                    race.lineno,
+                    race.message,
+                    ctx.line_at(race.lineno),
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R9 — RPC retry-safety (the PR-2 invariants, statically enforced)
+# ---------------------------------------------------------------------------
+
+# every RPC name riding rpc/core.Client must be classified; an
+# unclassified name is a finding so a new RPC cannot ship without a
+# conscious idempotency decision
+RPC_IDEMPOTENT = frozenset(
+    (
+        # master control plane: reads, or version-guarded writes (a
+        # replayed report_gradient carries a stale version and is
+        # rejected; task reports/acks are keyed by task id)
+        "get_task",
+        "get_comm_world",
+        "leave_comm_world",
+        "standby_poll",
+        "get_model",
+        "report_variable",
+        "report_gradient",
+        "report_task_result",
+        "report_telemetry",
+        "report_evaluation_metrics",
+        "report_version",
+        "push_embedding_info",
+        "pull_embedding_vectors",
+        # PS data plane reads + replace-style writes
+        "pull_variable",
+        "pull_embedding_vector",
+        "pull_embedding_vectors_multi",
+        "pull_dense",
+        "push_model",
+    )
+)
+RPC_NON_IDEMPOTENT = frozenset(
+    (
+        # async PS applies the gradient on receipt: a resend after a
+        # post-apply connection drop applies it twice (PR-2)
+        "push_gradient",
+    )
+)
+
+
+class RpcRetrySafetyRule(Rule):
+    id = "R9"
+    name = "rpc-retry-safety"
+    doc = (
+        "rpc/core.Client call sites must honor the PR-2 retry "
+        "invariants: push_gradient (non-idempotent) is never sent "
+        "retriable — literal sites need _retriable=False, dynamic "
+        "dispatch needs a `method != \"push_gradient\"`-style guard — "
+        "a Master* class never passes deadline_s/retries (the control "
+        "plane blocks by design: a worker parked on get_task must "
+        "wait, not error), and every literal RPC name is classified "
+        "idempotent or not in the rule's registry"
+    )
+
+    _CLIENT_SUFFIX = ".rpc.core.Client"
+
+    def _in_scope(self, path):
+        return path.startswith("elasticdl_tpu/")
+
+    def _is_rpc_client_ctor(self, ctx, call):
+        project = getattr(ctx, "project", None)
+        d = dotted(call.func)
+        if not d:
+            return False
+        if project is not None:
+            from elasticdl_tpu.tools.edlint.project import module_name
+
+            d = project.expand(module_name(ctx.path), d)
+        return d.endswith(self._CLIENT_SUFFIX) or d == "Client" and (
+            ctx.path.endswith("rpc/core.py")
+        )
+
+    def _receiver_is_rpc_client(self, ctx, call):
+        """The ``.call`` receiver holds an rpc/core Client: typed via
+        the project's constructor inference when possible, with a
+        conservative name fallback (``*client*``/``*stub*``)."""
+        f = call.func
+        recv = f.value
+        project = getattr(ctx, "project", None)
+        if project is not None and isinstance(recv, ast.Attribute) and (
+            isinstance(recv.value, ast.Name) and recv.value.id == "self"
+        ):
+            cls_node = ctx.enclosing(call, ast.ClassDef)
+            if cls_node is not None:
+                from elasticdl_tpu.tools.edlint.project import module_name
+
+                mod = module_name(ctx.path)
+                ci = project.classes.get((mod, cls_node.name))
+                if ci is not None:
+                    for ctor in ci.attr_ctors.get(recv.attr, ()):
+                        if project.expand(mod, ctor).endswith(
+                            self._CLIENT_SUFFIX
+                        ):
+                            return True
+        b, rname = _receiver(call)
+        low = rname.lower()
+        return "client" in low or "stub" in low
+
+    @staticmethod
+    def _guards_non_idempotent(expr, method_var):
+        """True when ``_retriable=expr`` provably excludes every
+        non-idempotent method for dynamic dispatch on ``method_var``:
+        ``False``, ``m != "push_gradient"``, ``m not in (...)``."""
+        if isinstance(expr, ast.Constant) and expr.value is False:
+            return True
+        if not isinstance(expr, ast.Compare) or len(expr.ops) != 1:
+            return False
+        left, op, right = expr.left, expr.ops[0], expr.comparators[0]
+        if not (
+            isinstance(left, ast.Name)
+            # when the dispatched method is not a bare Name we cannot
+            # tie the comparison to it — a guard on some OTHER variable
+            # (``mode != "push_gradient"``) proves nothing, so reject
+            # and force the call site to bind the method to a local
+            and method_var is not None
+            and left.id == method_var
+        ):
+            return False
+        if isinstance(op, ast.NotEq):
+            return (
+                isinstance(right, ast.Constant)
+                and set(RPC_NON_IDEMPOTENT) == {right.value}
+            )
+        if isinstance(op, ast.NotIn) and isinstance(
+            right, (ast.Tuple, ast.List, ast.Set)
+        ):
+            literals = {
+                e.value
+                for e in right.elts
+                if isinstance(e, ast.Constant)
+            }
+            return RPC_NON_IDEMPOTENT <= literals
+        return False
+
+    def check(self, ctx):
+        if not self._in_scope(ctx.path):
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # (a) a Master* class constructing a deadline'd/retrying
+            # Client regresses the blocking control-plane invariant
+            if self._is_rpc_client_ctor(ctx, node):
+                cls = ctx.enclosing(node, ast.ClassDef)
+                if cls is not None and "Master" in cls.name:
+                    if (
+                        len(node.args) > 1
+                        or call_kwarg(node, "deadline_s") is not None
+                        or call_kwarg(node, "retries") is not None
+                    ):
+                        out.append(
+                            self.finding(
+                                ctx,
+                                node,
+                                "deadline/retries on the master "
+                                "control-plane channel (it must stay "
+                                "blocking: a worker parked on "
+                                "get_task against a busy master "
+                                "waits, it does not error)",
+                            )
+                        )
+                continue
+            # (b)/(c) .call sites on an rpc client
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr == "call"
+                and node.args
+            ):
+                continue
+            if not self._receiver_is_rpc_client(ctx, node):
+                continue
+            first = node.args[0]
+            retriable = call_kwarg(node, "_retriable")
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                name = first.value
+                if name in RPC_NON_IDEMPOTENT:
+                    safe = isinstance(retriable, ast.Constant) and (
+                        retriable.value is False
+                    )
+                    if not safe:
+                        out.append(
+                            self.finding(
+                                ctx,
+                                node,
+                                "non-idempotent RPC %r sent "
+                                "retriable — a resend after a "
+                                "post-apply connection drop applies "
+                                "it twice; pass _retriable=False"
+                                % name,
+                            )
+                        )
+                elif name not in RPC_IDEMPOTENT:
+                    out.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "unclassified RPC %r — add it to "
+                            "RPC_IDEMPOTENT or RPC_NON_IDEMPOTENT "
+                            "in edlint/rules.py (a new RPC cannot "
+                            "ship without an idempotency decision)"
+                            % name,
+                        )
+                    )
+            else:
+                # dynamic dispatch: the retry opt-out must be a guard
+                # that provably excludes the non-idempotent set
+                method_var = (
+                    first.id if isinstance(first, ast.Name) else None
+                )
+                if retriable is None or not self._guards_non_idempotent(
+                    retriable, method_var
+                ):
+                    out.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "dynamic RPC dispatch without a "
+                            "non-idempotency guard — pass "
+                            "_retriable=(method != "
+                            "\"push_gradient\") (or a not-in guard "
+                            "covering RPC_NON_IDEMPOTENT) so "
+                            "push_gradient can never be resent",
+                        )
+                    )
+        return out
+
+
 RULES = (
     DeviceProbeRule(),
     QueuePutRule(),
@@ -808,4 +1121,6 @@ RULES = (
     BlockingUnderLockRule(),
     SilentExceptRule(),
     JitPurityRule(),
+    LocksetRaceRule(),
+    RpcRetrySafetyRule(),
 )

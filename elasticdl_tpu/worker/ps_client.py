@@ -173,6 +173,15 @@ class PSClient:
         self._fanout_pool = None
         self._push_inflight = max(0, int(push_inflight))
         self._push_pool = None
+        # lazy pool creation happens on whichever thread first needs a
+        # pool (the minibatch path or a push-window driver) and close()
+        # tears them down from the worker's finally — pool handles ride
+        # one lock so a racing pair can't double-create and leak the
+        # loser's threads, and _closed keeps a late caller (a prefetch
+        # warm pull racing teardown) from resurrecting a pool nothing
+        # would ever shut down (edlint R8)
+        self._pool_lock = threading.Lock()
+        self._closed = False
         self._pending_pushes = deque()
         # combined outcome of async pushes reaped since the last drain
         self._reaped_accepted = True
@@ -197,14 +206,18 @@ class PSClient:
     # -- concurrent shard fan-out -------------------------------------------
 
     def _get_fanout_pool(self):
-        if self._fanout_pool is None:
-            # wider than num_ps: one multi-table pull produces
-            # (tables x shards) legs that should all fly in one round
-            self._fanout_pool = ThreadPoolExecutor(
-                max_workers=min(16, max(self.num_ps, 8)),
-                thread_name_prefix="edl-ps-fanout",
-            )
-        return self._fanout_pool
+        with self._pool_lock:
+            if self._closed:
+                raise RuntimeError("PSClient is closed")
+            if self._fanout_pool is None:
+                # wider than num_ps: one multi-table pull produces
+                # (tables x shards) legs that should all fly in one
+                # round
+                self._fanout_pool = ThreadPoolExecutor(
+                    max_workers=min(16, max(self.num_ps, 8)),
+                    thread_name_prefix="edl-ps-fanout",
+                )
+            return self._fanout_pool
 
     def _run_sharded(self, calls):
         """Run ``[(shard, thunk), ...]`` and return ``{shard: result}``.
@@ -250,11 +263,18 @@ class PSClient:
                 "async push window failed to drain at close: %s", err
             )
         finally:
-            for pool in (self._push_pool, self._fanout_pool):
+            # detach under the lock, shut down outside it (shutdown
+            # waits on worker threads; holding the lock across that
+            # would stall a concurrent _get_fanout_pool for the
+            # duration)
+            with self._pool_lock:
+                self._closed = True
+                pools = (self._push_pool, self._fanout_pool)
+                self._push_pool = None
+                self._fanout_pool = None
+            for pool in pools:
                 if pool is not None:
                     pool.shutdown(wait=True)
-            self._push_pool = None
-            self._fanout_pool = None
 
     # -- model lifecycle ----------------------------------------------------
 
@@ -373,16 +393,20 @@ class PSClient:
             return self._push_shards(reqs, version)
         while len(self._pending_pushes) >= self._push_inflight:
             self._reap_push(self._pending_pushes.popleft())
-        if self._push_pool is None:
-            # one driver thread per window slot, separate from the
-            # fan-out pool (a driver waits on fan-out futures; sharing
-            # the pool could starve its own legs)
-            self._push_pool = ThreadPoolExecutor(
-                max_workers=self._push_inflight,
-                thread_name_prefix="edl-ps-push",
-            )
+        with self._pool_lock:
+            if self._closed:
+                raise RuntimeError("PSClient is closed")
+            if self._push_pool is None:
+                # one driver thread per window slot, separate from the
+                # fan-out pool (a driver waits on fan-out futures;
+                # sharing the pool could starve its own legs)
+                self._push_pool = ThreadPoolExecutor(
+                    max_workers=self._push_inflight,
+                    thread_name_prefix="edl-ps-push",
+                )
+            push_pool = self._push_pool
         self._pending_pushes.append(
-            self._push_pool.submit(self._push_shards, reqs, version)
+            push_pool.submit(self._push_shards, reqs, version)
         )
         return True, self._last_push_version
 

@@ -2,9 +2,13 @@
 # One correctness gate for the threaded data plane
 # (docs/static_analysis.md):
 #
-#   1. edlint — the AST concurrency/jit-purity analyzer over the whole
-#      tree, all seven rules, stale-ratchet check on (allowlists may
-#      only shrink);
+#   1. edlint — the whole-program AST analyzer (R1-R9: concurrency,
+#      jit-purity, cross-file blocking chains, the R8 lockset race
+#      detector, R9 RPC retry-safety) with the stale-ratchet check on
+#      (allowlists may only shrink). The pass runs under a hard <30s
+#      wall-clock budget — the mtime-keyed AST cache keeps warm runs
+#      far below it — and emits --json; on failure the gate prints a
+#      compact per-rule summary instead of the full report.
 #   2. the data-plane suites under EDL_LOCKTRACE=1 — every
 #      threading.Lock/RLock our code takes joins the runtime lock-order
 #      sanitizer (ABBA raises deterministically instead of deadlocking)
@@ -14,8 +18,64 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== edlint (R1-R7 + stale-ratchet check) =="
-python -m elasticdl_tpu.tools.edlint --stale
+echo "== edlint whole-program (R1-R9 + stale-ratchet check, 30s budget) =="
+EDLINT_JSON="${TMPDIR:-/tmp}/edlint_gate.$$.json"
+trap 'rm -f "$EDLINT_JSON"' EXIT
+rc=0
+timeout -k 5 30 python -m elasticdl_tpu.tools.edlint --stale --json \
+    > "$EDLINT_JSON" || rc=$?
+# only timeout(1)'s own kill codes are budget overruns: 124 (TERM) and
+# 137 (KILL after -k). 125/126/127 mean timeout or python itself broke.
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "edlint gate: BUDGET EXCEEDED (rc=$rc; the whole-program pass"
+    echo "must finish in <30s on the full tree — profile the analyzer"
+    echo "or check for a cold cache + pathological module)"
+    exit "$rc"
+fi
+if [ "$rc" -ne 0 ]; then
+    EDLINT_JSON="$EDLINT_JSON" python - <<'PY'
+import json
+import os
+import sys
+from collections import Counter
+
+try:
+    with open(os.environ["EDLINT_JSON"]) as f:
+        doc = json.load(f)
+except (OSError, ValueError):
+    # edlint crashed before emitting JSON — the traceback on stderr
+    # above is the real failure, don't bury it under a JSONDecodeError
+    print("edlint gate FAILED: no JSON output (analyzer crashed; "
+          "see the traceback above)")
+    sys.exit(0)
+violations = [
+    f for f in doc["findings"] if f["ratchet_state"] == "violation"
+]
+per_rule = Counter(f["rule"] for f in violations)
+print(
+    "edlint gate FAILED: %d violation(s) [%s], %d stale entr(ies), "
+    "%d unparseable"
+    % (
+        len(violations),
+        " ".join("%s:%d" % rf for rf in sorted(per_rule.items())),
+        len(doc["stale"]),
+        len(doc["broken"]),
+    )
+)
+for f in violations[:10]:
+    print("  %s:%d [%s] %s" % (f["file"], f["line"], f["rule"],
+                               f["message"][:100]))
+if len(violations) > 10:
+    print("  ... %d more (python -m elasticdl_tpu.tools.edlint)"
+          % (len(violations) - 10))
+for s in doc["stale"]:
+    print("  stale ratchet %s %s: budget %d, used %d — shrink it"
+          % (s["rule"], s["file"], s["budget"], s["used"]))
+for b in doc["broken"]:
+    print("  unparseable %s: %s" % (b["file"], b["error"]))
+PY
+    exit "$rc"
+fi
 
 echo "== data-plane suites under the lock-order sanitizer =="
 JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \

@@ -4,22 +4,28 @@
 Three layers:
 
 - the tree gate: ``python -m elasticdl_tpu.tools.edlint`` must exit 0
-  over this repo with ALL seven rules active, and every allowlist
-  ratchet entry must carry a reason (the acceptance bar);
-- known-bad fixtures per rule R1–R7, each paired with the safe idiom
+  over this repo with ALL NINE rules active (the whole-program pass —
+  cross-file call graph, thread roots, R8 lockset race detection, R9
+  RPC retry-safety — included), and every allowlist ratchet entry must
+  carry a reason (the acceptance bar);
+- known-bad fixtures per rule R1–R9, each paired with the safe idiom
   the rule must NOT flag — the R4/R5/R6 bad fixtures are the REAL
-  pre-fix violations this PR fixed (k8s_client's stop-less watcher,
-  task_data_service's ack RPC reached through two calls under the
-  ledger lock, worker/main's silent leave_comm_world swallow),
-  pinned so the rules keep catching regressions of exactly those
-  shapes;
-- engine mechanics: the ratchet counts per (rule, file) and the
-  ``--stale`` only-shrinks check.
+  pre-fix violations PR 4 fixed; the cross-file R5 fixture re-splits
+  the PR-4 ledger-lock chain across a module boundary (the shape only
+  the whole-program lift can see); the R8 race fixture is additionally
+  executed under the runtime lock-order sanitizer to pin that the
+  static rule catches what the sanitizer structurally cannot;
+- engine mechanics: the ratchet counts per (rule, file), the
+  ``--stale`` only-shrinks check, the mtime-keyed AST cache, and the
+  ``--json`` machine output check.sh consumes.
 """
 
+import json
 import os
 import subprocess
 import sys
+
+import pytest
 
 from elasticdl_tpu.tools.edlint.core import (
     apply_ratchet,
@@ -33,19 +39,37 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 
 
+@pytest.fixture(autouse=True)
+def _isolated_edlint_cache(tmp_path_factory, monkeypatch):
+    # every fixture-tree scan (in-process or subprocess — the env is
+    # inherited) writes its AST cache under a throwaway dir instead of
+    # accumulating per-tmp-root pickles in the user's real ~/.cache
+    monkeypatch.setenv(
+        "XDG_CACHE_HOME", str(tmp_path_factory.mktemp("edlint-xdg"))
+    )
+
+
 _case = [0]
 
 
-def _lint(tmp_path, source, relpath="elasticdl_tpu/fixture.py"):
+def _plant(tmp_path, source, relpath, extra=None):
+    """A FRESH scratch tree holding ``source`` at ``relpath`` (+ any
+    ``extra`` {relpath: source} modules for cross-file fixtures)."""
+    _case[0] += 1
+    root = tmp_path / ("case%d" % _case[0])
+    for rel, src in dict(extra or {}, **{relpath: source}).items():
+        target = root / rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(src)
+    return root
+
+
+def _lint(tmp_path, source, relpath="elasticdl_tpu/fixture.py", extra=None):
     """Rule ids found in ``source`` planted at ``relpath`` of a FRESH
     scratch tree (one per call, so fixtures never see each other; the
     ratchet keys on repo paths, so scratch files never hit allowlist
     budgets)."""
-    _case[0] += 1
-    root = tmp_path / ("case%d" % _case[0])
-    target = root / relpath
-    target.parent.mkdir(parents=True, exist_ok=True)
-    target.write_text(source)
+    root = _plant(tmp_path, source, relpath, extra)
     findings, broken = scan(str(root))
     assert not broken, broken
     violations, _, _ = apply_ratchet(findings)
@@ -61,7 +85,7 @@ def _rules_of(violations):
 # ---------------------------------------------------------------------------
 
 
-def test_tree_is_clean_under_all_seven_rules():
+def test_tree_is_clean_under_all_nine_rules():
     proc = subprocess.run(
         [sys.executable, "-m", "elasticdl_tpu.tools.edlint", "--stale"],
         capture_output=True,
@@ -86,9 +110,10 @@ def test_every_ratchet_entry_carries_a_reason():
             )
 
 
-def test_greps_guard_shim_message_compat(tmp_path):
+def test_greps_guard_message_compat(tmp_path):
     """The retired regex guard's report vocabulary survives in R1/R2
-    (tests/test_greps_guard.py pins the subprocess contract)."""
+    (tests/test_greps_guard.py pins the subprocess contract against
+    edlint directly now that the shim is deleted)."""
     violations = _lint(
         tmp_path,
         "import jax\nimport queue\n"
@@ -573,6 +598,699 @@ def test_r7_sees_decorator_and_shard_map_forms(tmp_path):
     )
     assert _rules_of(bad) == ["R7"]
     assert len(bad) == 2
+
+
+# ---------------------------------------------------------------------------
+# R5 cross-file: the PR-4 ledger-lock chain THROUGH A MODULE BOUNDARY
+# ---------------------------------------------------------------------------
+
+R5_XFILE_CALLER = """
+import threading
+
+from elasticdl_tpu.worker.ack_ledger import drain_acknowledged
+
+
+class TaskDataService:
+    # the PR-4 pre-fix ledger-lock shape with the drain helper moved to
+    # its own module: lexically there is no blocking call in this file
+    # at all — only the whole-program call graph can see that the
+    # master RPC still runs under the ledger lock
+    def __init__(self, worker):
+        self._worker = worker
+        self._ledger_lock = threading.Lock()
+        self._inflight = []
+
+    def report_record_done(self, count):
+        with self._ledger_lock:
+            drain_acknowledged(self._inflight, self._worker)
+"""
+
+R5_XFILE_CALLEE = """
+def drain_acknowledged(inflight, worker):
+    while inflight:
+        _acknowledge(inflight.pop(), worker)
+
+
+def _acknowledge(task, worker):
+    worker.report_task_result(task, "")
+"""
+
+R5_XFILE_FIXED_CALLER = """
+import threading
+
+from elasticdl_tpu.worker.ack_ledger import snapshot_acknowledged
+
+
+class TaskDataService:
+    # the shipped fix, same module split: snapshot under the lock,
+    # send after release
+    def __init__(self, worker):
+        self._worker = worker
+        self._ledger_lock = threading.Lock()
+        self._inflight = []
+
+    def report_record_done(self, count):
+        with self._ledger_lock:
+            outbox = snapshot_acknowledged(self._inflight)
+        for task in outbox:
+            self._worker.report_task_result(task, "")
+"""
+
+R5_XFILE_FIXED_CALLEE = """
+def snapshot_acknowledged(inflight):
+    outbox = []
+    while inflight:
+        outbox.append(inflight.pop())
+    return outbox
+"""
+
+
+def test_r5_cross_file_ledger_lock_chain(tmp_path):
+    """Acceptance bar: the PR-4 ledger-lock finding reproduces from its
+    pre-fix fixture with caller and blocking callee split across
+    files."""
+    bad = _lint(
+        tmp_path,
+        R5_XFILE_CALLER,
+        relpath="elasticdl_tpu/worker/task_data_service.py",
+        extra={"elasticdl_tpu/worker/ack_ledger.py": R5_XFILE_CALLEE},
+    )
+    assert _rules_of(bad) == ["R5"], bad
+    # the chain names the blocking sink across both hops
+    assert "drain_acknowledged" in bad[0].message
+    assert "report_task_result" in bad[0].message
+    good = _lint(
+        tmp_path,
+        R5_XFILE_FIXED_CALLER,
+        relpath="elasticdl_tpu/worker/task_data_service.py",
+        extra={
+            "elasticdl_tpu/worker/ack_ledger.py": R5_XFILE_FIXED_CALLEE
+        },
+    )
+    assert not good
+
+
+def test_r5_cross_file_typed_field_method(tmp_path):
+    """A blocking method reached through a constructor-typed field
+    (self._ledger = AckLedger(...)) is followed into the other file."""
+    bad = _lint(
+        tmp_path,
+        "import threading\n"
+        "from elasticdl_tpu.worker.ack_ledger import AckLedger\n"
+        "class Service:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._ledger = AckLedger()\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            self._ledger.drain()\n",
+        relpath="elasticdl_tpu/worker/service.py",
+        extra={
+            "elasticdl_tpu/worker/ack_ledger.py": (
+                "import time\n"
+                "class AckLedger:\n"
+                "    def drain(self):\n"
+                "        time.sleep(0.5)\n"
+            )
+        },
+    )
+    assert _rules_of(bad) == ["R5"], bad
+    assert "sleep" in bad[0].message
+
+
+# ---------------------------------------------------------------------------
+# R8 — static lockset race detector
+# ---------------------------------------------------------------------------
+
+# two-thread/no-lock: the drain thread and the owner surface both touch
+# self._total with no lock anywhere — and because there is NO lock, the
+# runtime lock-order sanitizer (which only sees acquisition orderings a
+# test actually executes) structurally cannot flag it
+R8_RACE = """
+import threading
+
+
+class Acc:
+    def __init__(self):
+        self._total = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while not self._stop.is_set():
+            self._total += 1
+
+    def snapshot(self):
+        return self._total + 0
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._total = self.snapshot()
+"""
+
+R8_LOCKED = """
+import threading
+
+
+class Acc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._total += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._total + 0
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        with self._lock:
+            self._total = 0
+"""
+
+
+def test_r8_two_thread_no_lock_race(tmp_path):
+    bad = _lint(
+        tmp_path, R8_RACE, relpath="elasticdl_tpu/worker/fixture.py"
+    )
+    assert _rules_of(bad) == ["R8"], bad
+    assert "_total" in bad[0].message
+    good = _lint(
+        tmp_path, R8_LOCKED, relpath="elasticdl_tpu/worker/fixture.py"
+    )
+    assert not good
+
+
+def test_r8_exceeds_the_runtime_sanitizer(tmp_path):
+    """Acceptance bar: a race the locktraced suites do NOT flag is
+    caught statically. The fixture is executed for real under the
+    installed sanitizer — it has no locks, so lock-order tracing sees
+    nothing and raises nothing — then the same source is scanned and R8
+    flags it."""
+    from elasticdl_tpu.tools import locktrace
+
+    was_enabled = locktrace.enabled()
+    if not was_enabled:
+        locktrace.install()
+    try:
+        namespace = {}
+        exec(compile(R8_RACE, "r8_fixture.py", "exec"), namespace)
+        acc = namespace["Acc"]()
+        for _ in range(200):
+            acc.snapshot()
+        acc.close()  # no LockOrderError, no sanitizer finding: racy
+        # code with NO locks is invisible to runtime lock tracing
+    finally:
+        if not was_enabled:
+            locktrace.uninstall()
+    bad = _lint(
+        tmp_path, R8_RACE, relpath="elasticdl_tpu/worker/fixture.py"
+    )
+    assert _rules_of(bad) == ["R8"], (
+        "the static lockset rule must catch the race the sanitizer "
+        "structurally cannot"
+    )
+
+
+def test_r8_servicer_methods_are_concurrent_roots(tmp_path):
+    """gRPC servicer methods run on the server's thread pool: two
+    rpc_methods()-exposed handlers mutating shared state without a lock
+    race even though the class spawns no thread itself."""
+    bad = _lint(
+        tmp_path,
+        "class Servicer:\n"
+        "    def __init__(self):\n"
+        "        self._versions = {}\n"
+        "    def rpc_methods(self):\n"
+        "        return {\n"
+        "            'report': self.report,\n"
+        "            'fetch': self.fetch,\n"
+        "        }\n"
+        "    def report(self, req):\n"
+        "        self._versions[req['k']] = req['v']\n"
+        "        return {}\n"
+        "    def fetch(self, req):\n"
+        "        return {'v': self._versions}\n",
+        relpath="elasticdl_tpu/ps/fixture.py",
+    )
+    assert _rules_of(bad) == ["R8"], bad
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "class Servicer:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._versions = {}\n"
+        "    def rpc_methods(self):\n"
+        "        return {\n"
+        "            'report': self.report,\n"
+        "            'fetch': self.fetch,\n"
+        "        }\n"
+        "    def report(self, req):\n"
+        "        with self._lock:\n"
+        "            self._versions[req['k']] = req['v']\n"
+        "        return {}\n"
+        "    def fetch(self, req):\n"
+        "        with self._lock:\n"
+        "            return {'v': dict(self._versions)}\n",
+        relpath="elasticdl_tpu/ps/fixture.py",
+    )
+    assert not good
+
+
+def test_r8_exemptions_flag_publish_and_init_only(tmp_path):
+    """Constant-only writes (cancel-flag publishes, GIL-atomic) and
+    fields only written in __init__ are not races."""
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._config = {'a': 1}\n"  # init-only write
+        "        self._cancel = False\n"
+        "        self._thread = threading.Thread(\n"
+        "            target=self._loop, daemon=True)\n"
+        "        self._thread.start()\n"
+        "    def _loop(self):\n"
+        "        while not self._cancel:\n"
+        "            _ = self._config\n"
+        "    def close(self):\n"
+        "        self._cancel = True\n"  # constant publish
+        "        self._thread.join(timeout=5.0)\n",
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert not good
+
+
+def test_r8_lockset_travels_across_calls(tmp_path):
+    """An access in a helper is protected when every path to it holds
+    the lock — the lockset composes through the call graph instead of
+    stopping at the function boundary."""
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._t = threading.Thread(\n"
+        "            target=self._loop, daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def _bump(self):\n"
+        "        self._n += 1\n"  # no lexical lock HERE, but every
+        "    def read(self):\n"  # caller path holds it
+        "        with self._lock:\n"
+        "            return self._n\n"
+        "    def close(self):\n"
+        "        self._t.join(timeout=5.0)\n",
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert not good
+    # drop the caller's lock and the same helper write races
+    bad = _lint(
+        tmp_path,
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._t = threading.Thread(\n"
+        "            target=self._loop, daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        self._bump()\n"
+        "    def _bump(self):\n"
+        "        self._n += 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._n\n"
+        "    def close(self):\n"
+        "        self._t.join(timeout=5.0)\n",
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert _rules_of(bad) == ["R8"], bad
+
+
+def test_r8_repeated_thread_target_races_itself(tmp_path):
+    """A Thread target races its OWN siblings: single-spawn is
+    unprovable statically (the spawn method may run once per worker,
+    like LocalInstanceManager's per-process watchers), so an unlocked
+    check-then-increment reachable only from that one root is still a
+    race — the lost update over-spends the budget it guards."""
+    bad = _lint(
+        tmp_path,
+        "import threading\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._cancel = threading.Event()\n"
+        "        self._budget = 0\n"
+        "    def spawn(self, proc):\n"
+        "        threading.Thread(\n"
+        "            target=self._watch, args=(proc,), daemon=True\n"
+        "        ).start()\n"
+        "    def _watch(self, proc):\n"
+        "        proc.wait()\n"
+        "        if self._budget < 3:\n"
+        "            self._budget += 1\n"
+        "    def stop(self):\n"
+        "        self._cancel.set()\n",
+        relpath="elasticdl_tpu/master/fixture.py",
+    )
+    assert _rules_of(bad) == ["R8"], bad
+    assert "_budget" in bad[0].message
+    good = _lint(
+        tmp_path,
+        "import threading\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._cancel = threading.Event()\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._budget = 0\n"
+        "    def spawn(self, proc):\n"
+        "        threading.Thread(\n"
+        "            target=self._watch, args=(proc,), daemon=True\n"
+        "        ).start()\n"
+        "    def _watch(self, proc):\n"
+        "        proc.wait()\n"
+        "        with self._lock:\n"
+        "            if self._budget < 3:\n"
+        "                self._budget += 1\n"
+        "    def stop(self):\n"
+        "        self._cancel.set()\n",
+        relpath="elasticdl_tpu/master/fixture.py",
+    )
+    assert not good
+
+
+def test_r5_chain_cache_survives_call_cycles(tmp_path):
+    """A mutually-recursive pair must not poison the whole-program
+    chain cache: when a() <-> b() and a() also reaches a blocking sink,
+    querying a first (as the earlier call site does) once cached b as
+    proven-non-blocking — its only callee sat on the DFS stack, hiding
+    a's other branches — and the later with-lock call of b was silently
+    missed, making findings depend on scan order. Both sites must
+    flag."""
+    bad = _lint(
+        tmp_path,
+        "import threading\n"
+        "from elasticdl_tpu.worker.helpers import a, b\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def use_a(self):\n"
+        "        with self._lock:\n"
+        "            a()\n"
+        "    def use_b(self):\n"
+        "        with self._lock:\n"
+        "            b()\n",
+        relpath="elasticdl_tpu/worker/svc.py",
+        extra={
+            "elasticdl_tpu/worker/helpers.py": (
+                "import time\n"
+                "def a():\n"
+                "    b()\n"
+                "    d()\n"
+                "def b():\n"
+                "    a()\n"
+                "def d():\n"
+                "    time.sleep(0.5)\n"
+            )
+        },
+    )
+    assert _rules_of(bad) == ["R5"], bad
+    assert len(bad) == 2, (
+        "both with-lock call sites must flag, not just the one whose "
+        "query ran before the cycle poisoned the cache: %r" % bad
+    )
+
+
+# ---------------------------------------------------------------------------
+# R9 — RPC retry-safety (the PR-2 invariants)
+# ---------------------------------------------------------------------------
+
+R9_RETRIED_PUSH = """
+from elasticdl_tpu.rpc.core import Client
+
+
+class BoundPS:
+    def __init__(self, addr):
+        self._client = Client(addr, deadline_s=5.0, retries=2)
+
+    def push_gradient(self, grads):
+        # pre-PR-2-invariant shape: the non-idempotent push rides the
+        # default UNAVAILABLE retry — a resend after a post-apply
+        # connection drop applies the gradient twice
+        return self._client.call("push_gradient", grads=grads)
+"""
+
+R9_GUARDED = """
+from elasticdl_tpu.rpc.core import Client
+
+
+class MasterClient:
+    def __init__(self, addr):
+        self._client = Client(addr)
+
+    def get_task(self, worker_id):
+        return self._client.call("get_task", worker_id=worker_id)
+
+
+class BoundPS:
+    def __init__(self, addr):
+        self._client = Client(addr, deadline_s=5.0, retries=2)
+
+    def push_gradient(self, grads):
+        return self._client.call(
+            "push_gradient", _retriable=False, grads=grads
+        )
+
+    def dispatch(self, method, req):
+        # the shipped dynamic-dispatch idiom (worker/ps_client.BoundPS)
+        return self._client.call(
+            method, _retriable=(method != "push_gradient"), **req
+        )
+"""
+
+
+def test_r9_pins_retried_nonidempotent_push(tmp_path):
+    bad = _lint(
+        tmp_path, R9_RETRIED_PUSH, relpath="elasticdl_tpu/worker/ps.py"
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "push_gradient" in bad[0].message
+    assert not _lint(
+        tmp_path, R9_GUARDED, relpath="elasticdl_tpu/worker/ps.py"
+    )
+
+
+def test_r9_dynamic_dispatch_requires_guard(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class BoundPS:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, retries=2)\n"
+        "    def dispatch(self, method, req):\n"
+        "        return self._client.call(method, **req)\n",
+        relpath="elasticdl_tpu/worker/ps.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "guard" in bad[0].message
+
+
+def test_r9_guard_must_name_the_dispatched_method(tmp_path):
+    """A _retriable comparison on some OTHER variable proves nothing
+    about the dispatched method. When the first .call arg is not a bare
+    Name the guard cannot be tied to it — must stay a finding (an
+    unrelated ``mode != "push_gradient"`` once slipped through)."""
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class BoundPS:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, retries=2)\n"
+        "    def _method(self):\n"
+        "        return 'push_gradient'\n"
+        "    def dispatch(self, mode, req):\n"
+        "        return self._client.call(\n"
+        "            self._method(),\n"
+        "            _retriable=(mode != 'push_gradient'),\n"
+        "            **req,\n"
+        "        )\n",
+        relpath="elasticdl_tpu/worker/ps.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    # guarding a Name that is NOT the dispatched method is just as bad
+    also_bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class BoundPS:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, retries=2)\n"
+        "    def dispatch(self, method, mode, req):\n"
+        "        return self._client.call(\n"
+        "            method,\n"
+        "            _retriable=(mode != 'push_gradient'),\n"
+        "            **req,\n"
+        "        )\n",
+        relpath="elasticdl_tpu/worker/ps.py",
+    )
+    assert _rules_of(also_bad) == ["R9"], also_bad
+
+
+def test_r9_master_channel_stays_blocking(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class MasterClient:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=60.0)\n"
+        "    def get_task(self, worker_id):\n"
+        "        return self._client.call('get_task', worker_id=worker_id)\n",
+        relpath="elasticdl_tpu/master/fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "blocking" in bad[0].message
+    # the same ctor args on a NON-master (PS data plane) client are the
+    # PR-2 design
+    good = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class BoundPS:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=60.0)\n"
+        "    def pull_dense(self, req):\n"
+        "        return self._client.call('pull_dense', **req)\n",
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert not good
+
+
+def test_r9_unclassified_rpc_is_a_finding(tmp_path):
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class C:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr)\n"
+        "    def frob(self):\n"
+        "        return self._client.call('frobnicate')\n",
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "unclassified" in bad[0].message
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: the AST cache and --json
+# ---------------------------------------------------------------------------
+
+
+def test_ast_cache_reparses_only_changed_files(tmp_path, monkeypatch):
+    from elasticdl_tpu.tools.edlint.core import iter_source_files
+    from elasticdl_tpu.tools.edlint.project import (
+        _cache_path,
+        load_contexts,
+    )
+
+    root = _plant(
+        tmp_path,
+        "import jax\n",
+        "elasticdl_tpu/a.py",
+        extra={"elasticdl_tpu/b.py": "import queue\n"},
+    )
+    # the cache must live OUTSIDE the scanned tree (it is unpickled —
+    # a cache file a checkout could commit would execute code); pin
+    # both the location contract and the isolation from other roots
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    cache_file = _cache_path(str(root))
+    assert not cache_file.startswith(str(root))
+    assert cache_file.startswith(str(tmp_path / "xdg"))
+    assert _cache_path(str(tmp_path)) != cache_file
+
+    def load():
+        return load_contexts(
+            str(root), iter_source_files(str(root)), use_cache=True
+        )
+
+    _, _, stats = load()
+    assert stats == {"hits": 0, "misses": 2}
+    assert os.path.exists(cache_file)
+    _, _, stats = load()
+    assert stats == {"hits": 2, "misses": 0}
+    # touching one file invalidates exactly that entry
+    target = root / "elasticdl_tpu" / "a.py"
+    target.write_text("import jax  # changed\n")
+    os.utime(target, ns=(1, 1))  # force a distinct mtime_ns
+    _, _, stats = load()
+    assert stats == {"hits": 1, "misses": 1}
+    # --no-cache semantics: nothing read, nothing written
+    os.unlink(cache_file)
+    _, _, stats = load_contexts(
+        str(root), iter_source_files(str(root)), use_cache=False
+    )
+    assert stats == {"hits": 0, "misses": 2}
+    assert not os.path.exists(cache_file)
+
+
+def test_json_output_contract(tmp_path):
+    """--json is what check.sh consumes for its compact gate summary:
+    file/line/rule/message/ratchet-state per finding, stale entries,
+    and the exit code mirrored in the document."""
+    root = _plant(
+        tmp_path,
+        "import jax\n"
+        "def probe():\n"
+        "    return jax.devices()\n",
+        "elasticdl_tpu/bad.py",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.tools.edlint",
+            "--root",
+            str(root),
+            "--json",
+            "--stale",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["rc"] == 1
+    (finding,) = [
+        f for f in doc["findings"] if f["ratchet_state"] == "violation"
+    ]
+    assert finding["file"] == "elasticdl_tpu/bad.py"
+    assert finding["line"] == 3
+    assert finding["rule"] == "R1"
+    assert "escapable_call" in finding["message"]
+    assert doc["counts"] == [
+        {"rule": "R1", "file": "elasticdl_tpu/bad.py", "count": 1}
+    ]
+    # stale entries: repo ratchet budgets unused in this scratch tree
+    # surface here — but don't pin a specific entry, or even that any
+    # exist (fixing every ratcheted site and deleting the entries is
+    # the ratchet's stated end-state and must not break this test)
+    for s in doc["stale"]:
+        assert {"rule", "file", "budget", "used"} <= set(s)
 
 
 # ---------------------------------------------------------------------------

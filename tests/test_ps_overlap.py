@@ -388,6 +388,22 @@ def test_close_is_best_effort_after_failed_drain():
     assert client.pending_push_count == 0
 
 
+def test_close_refuses_to_resurrect_pools():
+    """A pull/push racing close() must not lazily recreate an executor
+    nothing will ever shut down (close() detaches the handles under
+    the pool lock and shuts the threads down OUTSIDE it, so a late
+    caller would otherwise see None and mint a leaking pool)."""
+    _, stubs = make_fleet(2)
+    client = PSClient(stubs, fanout=True, push_inflight=1)
+    client._get_fanout_pool()  # warm one pool pre-close
+    client.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        client._get_fanout_pool()
+    with pytest.raises(RuntimeError, match="closed"):
+        client.push_gradient({"w": np.ones((1,), np.float32)}, [], 0)
+    assert client._fanout_pool is None and client._push_pool is None
+
+
 def test_multi_table_pull_one_round_matches_per_table():
     """pull_embedding_vectors_multi returns per-table results identical
     to sequential per-table pulls, in ONE concurrent round (wall tracks

@@ -123,12 +123,12 @@ def test_ring_eviction_holds_while_newer_version_is_torn(tmp_path):
     write_manifest(10, 0)
     write_manifest(10, 1)
     write_manifest(20, 0)
-    mgr._evict()
+    mgr._evict(2)
     assert mgr.versions() == [10, 20], "evicted the only complete version"
 
     # straggler lands: v20 complete -> v10 becomes evictable
     write_manifest(20, 1)
-    mgr._evict()
+    mgr._evict(2)
     assert mgr.versions() == [20]
 
     # world GROWS to 4: a newer version with only the old world's count
@@ -136,11 +136,11 @@ def test_ring_eviction_holds_while_newer_version_is_torn(tmp_path):
     mgr.set_expected_writers(4)
     write_manifest(30, 0)
     write_manifest(30, 1)
-    mgr._evict()
+    mgr._evict(4)
     assert mgr.versions() == [20, 30], "torn post-grow version evicted v20"
     write_manifest(30, 2)
     write_manifest(30, 3)
-    mgr._evict()
+    mgr._evict(4)
     assert mgr.versions() == [30]
 
     # without expected_writers the conservative rule (newer must match
@@ -156,10 +156,10 @@ def test_ring_eviction_holds_while_newer_version_is_torn(tmp_path):
     wm2(10, 0)
     wm2(10, 1)
     wm2(20, 0)
-    mgr2._evict()
+    mgr2._evict(None)
     assert mgr2.versions() == [10, 20]
     wm2(20, 1)
-    mgr2._evict()
+    mgr2._evict(None)
     assert mgr2.versions() == [20]
 
 
@@ -185,12 +185,53 @@ def test_eviction_fallback_grow_tie(tmp_path, monkeypatch):
     wm(20, 0)
     wm(20, 1)  # torn: 2 of 4 manifests after the grow
     monkeypatch.setattr(sc.jax, "process_count", lambda: 4)
-    mgr._evict()
+    mgr._evict(None)
     assert mgr.versions() == [10, 20], "grow-tie evicted the only complete version"
     wm(20, 2)
     wm(20, 3)
-    mgr._evict()
+    mgr._evict(None)
     assert mgr.versions() == [20]
+
+
+def test_async_save_snapshots_world_config_at_submit(tmp_path, monkeypatch):
+    """edlint R8 regression (static lockset finding): the async-io write
+    runs on the checkpoint writer thread, so an elastic resize landing
+    between submit and write must NOT leak the NEW world's
+    expected_writers into the in-flight eviction — the value travels
+    with the snapshot it describes."""
+    import threading
+
+    import numpy as np
+
+    from elasticdl_tpu.common import sharded_checkpoint as sc
+
+    mgr = ShardedCheckpointManager(
+        str(tmp_path), 10, keep_max=1, async_io=True
+    )
+    mgr.set_expected_writers(2)
+    gate = threading.Event()
+    evict_saw = []
+
+    def slow_write(directory, snap, **kwargs):
+        assert gate.wait(timeout=10.0), "test gate never released"
+
+    monkeypatch.setattr(sc, "write_snapshot", slow_write)
+    monkeypatch.setattr(
+        mgr, "_evict", lambda expected: evict_saw.append(expected)
+    )
+    try:
+        mgr.save({"w": np.zeros(2)}, 10)
+        # the resize arrives while the write is still in flight
+        mgr.set_expected_writers(8)
+        gate.set()
+        mgr.wait()
+    finally:
+        gate.set()
+        mgr.close()
+    assert evict_saw == [2], (
+        "in-flight eviction saw the post-resize writer count: %r"
+        % evict_saw
+    )
 
 
 def test_trainer_sharded_checkpoint_roundtrip(tmp_path):

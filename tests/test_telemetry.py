@@ -525,6 +525,36 @@ def test_telemetry_tb_exporter_round_trip(tmp_path):
     )
 
 
+def test_telemetry_tb_exporter_concurrent_flush_exactness(tmp_path):
+    """edlint R8 regression (static lockset finding): the exporter
+    thread and close()'s final flush both run flush(); the _flushes
+    bump must not lose updates and two flushes must not interleave
+    add_scalars. Serialized flushes make this exact."""
+    import threading
+
+    registry = MetricsRegistry()
+    registry.counter("edl_t_total").inc(1)
+    exporter = TelemetryTBExporter(
+        str(tmp_path), registry=registry, interval_s=3600.0
+    )
+    n, per = 8, 5
+    try:
+        def pound():
+            for _ in range(per):
+                exporter.flush()
+
+        threads = [threading.Thread(target=pound) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert exporter._flushes == n * per
+    finally:
+        exporter.close()
+    # close() ran one final flush after the join
+    assert exporter._flushes == n * per + 1
+
+
 # ---------------------------------------------------------------------------
 # step_timer percentile fix
 # ---------------------------------------------------------------------------
