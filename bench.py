@@ -1593,6 +1593,389 @@ def _bench_ps_fanout_microbench(quick=False):
     }
 
 
+def bench_wire(quick=False):
+    """Seed-codec vs scatter-gather vs shared-memory arms on the
+    co-located dense pull+push round (docs/wire.md).
+
+    All three arms drive the SAME logical PS round — pull the dense
+    params, push a same-shaped gradient — against a real loopback gRPC
+    server, the deployment shape of a PS pod co-located with its
+    worker. The seed arm replicates the pre-PR-8 copy chain verbatim
+    on both sides (ascontiguousarray + tobytes + per-frame joins on
+    encode; bytes(view) per segment + values/indices .copy() on
+    decode). The scatter-gather arm is the shipped bytes path
+    (rpc/core plan + one preallocation + read-only view decode, with
+    the PSClient's audited materialize on retained params). The shm
+    arm adds the negotiated shared-memory ring, so the gRPC message
+    carries ~100 bytes regardless of payload. An equivalence pre-pass
+    pins identical pulled params and identical server-observed push
+    sums across arms; a bf16 A/B on the scatter-gather arm re-runs the
+    r5 experiment that LOST at 0.82x on loopback when compression paid
+    its own astype pass — the fused downcast must put it back >=1.0x.
+    """
+    import struct
+
+    from elasticdl_tpu.common.dtypes import (
+        dtype_name_to_numpy,
+        dtype_numpy_to_name,
+    )
+    from elasticdl_tpu.common.tensor import (
+        _MAGIC,
+        _VERSION,
+        Tensor,
+        release_message,
+    )
+    from elasticdl_tpu.rpc.core import Client, serve
+    from elasticdl_tpu.rpc.shm_transport import (
+        ShmChannel,
+        install_shm_endpoint,
+    )
+    from elasticdl_tpu.rpc.wire_compression import (
+        compress_tensors,
+        decompress_tensors,
+    )
+
+    n_tensors = 8
+    n_elems = (64 << 10) if quick else (128 << 10)  # per tensor, f32
+    measure_s = 0.8 if quick else 2.0
+    rng = np.random.default_rng(8)
+    params = [
+        Tensor("dense_%d" % i, rng.standard_normal(n_elems).astype(np.float32))
+        for i in range(n_tensors)
+    ]
+    grads = [
+        Tensor(t.name, (t.values * 0.01).astype(np.float32)) for t in params
+    ]
+
+    # -- the seed codec, replicated verbatim (the chain PR 8 removed) --
+
+    def seed_serialize_tensor(t):
+        values = np.ascontiguousarray(t.values)
+        header = {
+            "name": t.name,
+            "dtype": dtype_numpy_to_name(values.dtype),
+            "shape": list(values.shape),
+        }
+        parts = [values.tobytes()]
+        if t.indices is not None:
+            idx = np.ascontiguousarray(t.indices, dtype=np.int64)
+            header["num_indices"] = int(idx.shape[0])
+            parts.append(idx.tobytes())
+        hdr = json.dumps(header).encode("utf-8")
+        return b"".join(
+            [_MAGIC, struct.pack("<BI", _VERSION, len(hdr)), hdr] + parts
+        )
+
+    def seed_deserialize_tensor(data):
+        view = memoryview(data)
+        ver, hlen = struct.unpack_from("<BI", view, 4)
+        off = 9
+        header = json.loads(bytes(view[off : off + hlen]).decode("utf-8"))
+        off += hlen
+        dtype = dtype_name_to_numpy(header["dtype"])
+        shape = tuple(header["shape"])
+        n = int(np.prod(shape)) if shape else 1
+        values = np.frombuffer(
+            view[off : off + n * dtype.itemsize], dtype=dtype
+        ).reshape(shape)
+        off += n * dtype.itemsize
+        indices = None
+        if "num_indices" in header:
+            k = header["num_indices"]
+            indices = np.frombuffer(
+                view[off : off + 8 * k], dtype=np.int64
+            ).copy()
+        return Tensor(header["name"], values.copy(), indices)
+
+    def seed_pack_message(msg):
+        header = {}
+        segments = []
+
+        def add_segment(data):
+            segments.append(data)
+            return len(segments) - 1
+
+        for key, value in msg.items():
+            if isinstance(value, Tensor):
+                header[key] = {
+                    "t": "tensor",
+                    "i": add_segment(seed_serialize_tensor(value)),
+                }
+            elif isinstance(value, np.ndarray):
+                header[key] = {
+                    "t": "array",
+                    "i": add_segment(
+                        seed_serialize_tensor(Tensor(key, value))
+                    ),
+                }
+            elif (
+                isinstance(value, (list, tuple))
+                and value
+                and isinstance(value[0], Tensor)
+            ):
+                header[key] = {
+                    "t": "tensors",
+                    "i": [
+                        add_segment(seed_serialize_tensor(t)) for t in value
+                    ],
+                }
+            elif isinstance(value, (bytes, bytearray)):
+                header[key] = {"t": "bytes", "i": add_segment(bytes(value))}
+            else:
+                header[key] = {"t": "json", "v": value}
+        hdr = json.dumps(header).encode("utf-8")
+        out = [
+            struct.pack("<I", len(hdr)),
+            hdr,
+            struct.pack("<I", len(segments)),
+        ]
+        for seg in segments:
+            out.append(struct.pack("<Q", len(seg)))
+            out.append(seg)
+        return b"".join(out)
+
+    def seed_unpack_message(data):
+        view = memoryview(data)
+        (hlen,) = struct.unpack_from("<I", view, 0)
+        header = json.loads(bytes(view[4 : 4 + hlen]).decode("utf-8"))
+        off = 4 + hlen
+        (nseg,) = struct.unpack_from("<I", view, off)
+        off += 4
+        segments = []
+        for _ in range(nseg):
+            (slen,) = struct.unpack_from("<Q", view, off)
+            off += 8
+            segments.append(bytes(view[off : off + slen]))
+            off += slen
+        msg = {}
+        for key, spec in header.items():
+            kind = spec["t"]
+            if kind == "json":
+                msg[key] = spec["v"]
+            elif kind == "bytes":
+                msg[key] = segments[spec["i"]]
+            elif kind in ("tensor", "array"):
+                msg[key] = seed_deserialize_tensor(segments[spec["i"]])
+            else:
+                msg[key] = [
+                    seed_deserialize_tensor(segments[i]) for i in spec["i"]
+                ]
+        return msg
+
+    def serve_seed_codec(methods, port=0):
+        """rpc/core.serve with the seed codec on the server side (the
+        handler shape mirrors rpc/core._GenericHandler)."""
+        import grpc
+        from concurrent import futures as _futures
+
+        from elasticdl_tpu.common.constants import GRPC
+
+        class _Handler:
+            def service(self, details):
+                name = details.method.rsplit("/", 1)[-1]
+                fn = methods.get(name)
+                if fn is None:
+                    return None
+
+                def handler(request_bytes, context):
+                    reply = fn(seed_unpack_message(request_bytes))
+                    return seed_pack_message(
+                        reply if reply is not None else {}
+                    )
+
+                return grpc.unary_unary_rpc_method_handler(
+                    handler,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+
+        server = grpc.server(
+            _futures.ThreadPoolExecutor(max_workers=8),
+            options=[
+                (
+                    "grpc.max_send_message_length",
+                    GRPC.MAX_SEND_MESSAGE_LENGTH,
+                ),
+                (
+                    "grpc.max_receive_message_length",
+                    GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+                ),
+            ],
+            handlers=(_Handler(),),
+        )
+        server._edl_port = server.add_insecure_port("[::]:%d" % port)
+        server.start()
+        return server
+
+    # -- the shared PS round (what every arm must do) -------------------
+
+    def make_methods(observed, wire_dtype=None):
+        """{pull_dense, push_gradient} over ``params``; every push's
+        gradient sum lands in ``observed`` for the equivalence pass."""
+
+        def pull_dense(req):
+            out, names = compress_tensors(params, wire_dtype)
+            return {
+                "model_init_status": True,
+                "version": 1,
+                "params": out,
+                "compressed_f32": names,
+            }
+
+        def push_gradient(req):
+            tensors = decompress_tensors(
+                req["gradients"], req.get("compressed_f32")
+            )
+            observed.append(float(sum(t.values.sum() for t in tensors)))
+            return {"accepted": True, "version": 1}
+
+        return {"pull_dense": pull_dense, "push_gradient": push_gradient}
+
+    def pull_round(call, wire_dtype=None):
+        """One pull+push round through ``call(method, **fields)``,
+        consuming like PSClient does: retained params materialize, the
+        message releases (slot recycle on the shm arm)."""
+        resp = call("pull_dense")
+        named = {}
+        for t in decompress_tensors(
+            resp["params"], resp.get("compressed_f32")
+        ):
+            named[t.name] = t.materialize().values
+        release_message(resp)
+        out, names = compress_tensors(grads, wire_dtype)
+        resp = call(
+            "push_gradient", gradients=out, compressed_f32=names or None
+        )
+        release_message(resp)
+        return named
+
+    def timed(fn):
+        fn()  # warmup: channels connect, pools spin up
+        t0 = time.perf_counter()
+        rounds = 0
+        while time.perf_counter() - t0 < measure_s:
+            fn()
+            rounds += 1
+        return rounds / (time.perf_counter() - t0)
+
+    results = {}
+    pulls = {}
+    sums = {}
+
+    # seed arm: the replicated copy chain on BOTH sides
+    observed = []
+    server = serve_seed_codec(make_methods(observed))
+    import grpc
+
+    from elasticdl_tpu.common.constants import GRPC
+
+    channel = grpc.insecure_channel(
+        "localhost:%d" % server._edl_port,
+        options=[
+            ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+            (
+                "grpc.max_receive_message_length",
+                GRPC.MAX_RECEIVE_MESSAGE_LENGTH,
+            ),
+        ],
+    )
+    try:
+        stub = {}
+
+        def seed_call(method, **fields):
+            fn = stub.get(method)
+            if fn is None:
+                fn = stub[method] = channel.unary_unary(
+                    "/elasticdl/%s" % method,
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                )
+            return seed_unpack_message(fn(seed_pack_message(fields)))
+
+        pulls["seed"] = pull_round(seed_call)
+        results["seed"] = timed(lambda: pull_round(seed_call))
+        sums["seed"] = observed[-1]
+    finally:
+        channel.close()
+        server.stop(None)
+
+    # scatter-gather + shm arms share one server (the shm endpoint
+    # costs nothing until a client negotiates)
+    observed = []
+    methods, registry = install_shm_endpoint(make_methods(observed))
+    server = serve(methods, 0)
+    sg_client = Client("localhost:%d" % server._edl_port)
+    shm_client = Client("localhost:%d" % server._edl_port)
+    chan = ShmChannel(shm_client, n_slots=4, slot_mb=8)
+    try:
+        def sg_call(method, **fields):
+            return sg_client.call(method, _retriable=False, **fields)
+
+        pulls["sg"] = pull_round(sg_call)
+        results["sg"] = timed(lambda: pull_round(sg_call))
+        sums["sg"] = observed[-1]
+
+        pulls["shm"] = pull_round(chan.call)
+        results["shm"] = timed(lambda: pull_round(chan.call))
+        sums["shm"] = observed[-1]
+        if chan.state != "on" or not chan.stats["shm"]:
+            raise RuntimeError(
+                "shm arm never negotiated (state=%s stats=%s) — the "
+                "co-located measurement would silently re-run the "
+                "bytes path" % (chan.state, chan.stats)
+            )
+
+        # bf16 wire A/B on the scatter-gather arm (the r5 re-run): the
+        # downcast now fuses into the frame write, the payload halves
+        observed_bf16 = []
+        methods_bf16, _reg2 = install_shm_endpoint(
+            make_methods(observed_bf16, wire_dtype="bfloat16")
+        )
+        server_bf16 = serve(methods_bf16, 0)
+        bf16_client = Client("localhost:%d" % server_bf16._edl_port)
+        try:
+            def bf16_round():
+                return pull_round(
+                    lambda m, **f: bf16_client.call(
+                        m, _retriable=False, **f
+                    ),
+                    wire_dtype="bfloat16",
+                )
+
+            named = bf16_round()
+            for t in params:  # bf16 tolerance, not byte equality
+                np.testing.assert_allclose(
+                    named[t.name], t.values, rtol=1e-2, atol=1e-2
+                )
+            results["sg_bf16"] = timed(bf16_round)
+        finally:
+            bf16_client.close()
+            server_bf16.stop(None)
+            _reg2.close()
+    finally:
+        chan.close()
+        shm_client.close()
+        sg_client.close()
+        server.stop(None)
+        registry.close()
+
+    # equivalence pre-pass verdict: identical pulled params, identical
+    # server-observed push sums, across all three codec arms
+    for arm in ("sg", "shm"):
+        for t in params:
+            np.testing.assert_array_equal(pulls[arm][t.name], t.values)
+            np.testing.assert_array_equal(
+                pulls[arm][t.name], pulls["seed"][t.name]
+            )
+        if abs(sums[arm] - sums["seed"]) > 1e-6 * abs(sums["seed"]):
+            raise RuntimeError(
+                "push equivalence failed: %s=%r seed=%r"
+                % (arm, sums[arm], sums["seed"])
+            )
+    results["payload_mb"] = n_tensors * n_elems * 4 / (1 << 20)
+    return results
+
+
 def bench_input(quick=False):
     """Serial vs pipelined worker input plane under injected latency.
 
@@ -2337,6 +2720,37 @@ def main(argv=None):
         )
         return 0
 
+    if "--wire" in argv:
+        res = bench_wire(quick)
+        _emit(
+            "wire_dense_roundtrip_speedup",
+            round(res["shm"] / max(res["seed"], 1e-9), 2),
+            "x co-located (shm transport) vs seed-codec rounds/sec on "
+            "the dense pull+push round, %.1f MiB/direction over real "
+            "loopback gRPC (seed %.1f, scatter-gather %.1f [%.2fx], "
+            "shm %.1f rounds/s; equivalence pre-pass: identical pulled "
+            "params and push sums across arms)"
+            % (
+                res["payload_mb"],
+                res["seed"],
+                res["sg"],
+                res["sg"] / max(res["seed"], 1e-9),
+                res["shm"],
+            ),
+            update,
+        )
+        _emit(
+            "wire_bf16_ab_speedup",
+            round(res["sg_bf16"] / max(res["sg"], 1e-9), 2),
+            "x bf16-wire vs f32-wire rounds/sec on the scatter-gather "
+            "bytes path (the r5 A/B re-run: 0.82x when compression "
+            "paid its own astype pass, now the downcast fuses into "
+            "the single frame write and the payload halves; >=1.0x "
+            "means compression is no longer a loopback regression)",
+            update,
+        )
+        return 0
+
     if "--telemetry" in argv:
         res = bench_telemetry(quick)
         overhead = res["overhead_pct"]
@@ -2642,6 +3056,7 @@ def main(argv=None):
     section("input_examples_per_sec_pipelined", ["--input"], 300)
     section("telemetry_overhead_pct", ["--telemetry"], 600)
     section("compile_cached_establish_speedup", ["--compile"], 600)
+    section("wire_dense_roundtrip_speedup", ["--wire"], 300)
     section("ps_deepfm_examples_per_sec", ["--ps"], 900)
     # device sections, cheapest diagnosis first (each shrinks its
     # workload and renames its metric _cpu when the backend is plain

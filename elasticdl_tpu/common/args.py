@@ -389,6 +389,31 @@ def add_common_args_between_master_and_worker(parser):
         "expiry is never retried at this layer",
     )
     parser.add_argument(
+        "--ps_shm",
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="Shared-memory payload transport toward PS pods "
+        "co-located on this host (docs/wire.md): 'auto' (default) "
+        "negotiates per channel at first call and silently keeps the "
+        "bytes path cross-host or on attach failure; 'off' never "
+        "negotiates",
+    )
+    parser.add_argument(
+        "--ps_shm_slots",
+        type=pos_int,
+        default=4,
+        help="Slots per negotiated shm ring (one ring per PS channel); "
+        "calls beyond the pool fall back to the bytes path per call",
+    )
+    parser.add_argument(
+        "--ps_shm_slot_mb",
+        type=pos_int,
+        default=8,
+        help="Slot payload size in MiB: one slot must hold one logical "
+        "request or reply (a dense pull partition, a per-shard "
+        "gradient push); larger payloads ride the bytes path",
+    )
+    parser.add_argument(
         "--task_prefetch",
         type=non_neg_int,
         default=1,

@@ -1,4 +1,4 @@
-"""Named-tensor wire codec.
+"""Named-tensor wire codec — single-copy by contract (docs/wire.md).
 
 Parity: reference common/tensor.py — an ElasticDL ``Tensor`` is a named
 ndarray with optional ``indices`` (an IndexedSlices analog for sparse
@@ -8,6 +8,18 @@ self-contained binary frame (JSON header + raw little-endian buffers) so the
 control plane / checkpoint layer needs no protoc codegen; the ALLREDUCE data
 plane never touches this codec (dense tensors stay in HBM, exchanged by XLA
 collectives).
+
+Copy discipline (edlint R10): encoding plans the exact frame size up
+front and writes headers + payloads straight into one preallocated
+buffer through memoryview slices — one memcpy per payload, with any
+bf16 wire downcast (``Tensor.wire_dtype`` mark, set by
+rpc/wire_compression) FUSED into that same write via ``np.copyto``.
+Decoding returns READ-ONLY ``np.frombuffer`` views pinned to the
+received buffer; nothing is copied until a consumer that retains or
+mutates calls :meth:`Tensor.materialize` (the audited escape hatch).
+:class:`WireArena` is the lifetime handle for the backing buffer —
+advisory for refcounted ``bytes``, load-bearing for shared-memory
+slots (rpc/shm_transport.py), where ``release()`` recycles the slot.
 
 Also provides pytree <-> named-tensor-list bridges so JAX parameter pytrees
 can ride the same wire/checkpoint format.
@@ -41,6 +53,11 @@ class Tensor:
         self.indices = (
             None if indices is None else np.asarray(indices, dtype=np.int64)
         )
+        # wire downcast mark (rpc/wire_compression.compress_tensors):
+        # a numpy dtype the f32 payload narrows to DURING the frame
+        # copy-out, so compression costs no separate allocation pass.
+        # Metadata only — ``values`` itself is never converted here.
+        self.wire_dtype = None
         if self.indices is not None and self.values is not None:
             if len(self.indices) != self.values.shape[0]:
                 raise ValueError(
@@ -86,6 +103,26 @@ class Tensor:
         indices, values = combine_indexed_slices(self.indices, self.values)
         return Tensor(self.name, values, indices=indices)
 
+    def materialize(self):
+        """An owned, writable twin of a zero-copy decoded tensor.
+
+        Decoded payloads are read-only views pinned to the wire buffer
+        (docs/wire.md); a consumer that RETAINS a tensor past its
+        message's arena lifetime, or needs in-place math, must go
+        through here first. Tensors whose payloads are already writable
+        (locally constructed, or already materialized) return ``self``
+        unchanged, so the call is free everywhere but the decode edge.
+        """
+        v_owned = self.values is None or self.values.flags.writeable
+        i_owned = self.indices is None or self.indices.flags.writeable
+        if v_owned and i_owned:
+            return self
+        return Tensor(
+            self.name,
+            self.values if v_owned else self.values.copy(),
+            self.indices if i_owned else self.indices.copy(),
+        )
+
     def to_bytes(self):
         return serialize_tensor(self)
 
@@ -115,39 +152,112 @@ def combine_indexed_slices(indices, values):
     return unique, combined
 
 
+_FIXED = 9  # magic(4) + version(1) + header_len(4)
+_INT64 = np.dtype(np.int64)
+
+
+def plan_tensor_frame(t):
+    """Exact layout of one tensor frame, computed WITHOUT touching the
+    payload: ``(header_bytes, values, wire_np_dtype, indices, total)``.
+
+    The plan is what scatter-gather writers consume
+    (:func:`write_tensor_frame`, rpc/core's message packer): the total
+    lets the caller preallocate one buffer for any number of frames,
+    and the wire dtype carries the fused bf16 downcast decision — a
+    marked f32 payload serializes narrow without an intermediate
+    ``astype`` array ever existing.
+    """
+    values = t.values
+    wire = t.wire_dtype if getattr(t, "wire_dtype", None) is not None else None
+    out_dtype = (
+        wire
+        if wire is not None and values.dtype == np.float32
+        else values.dtype
+    )
+    header = {
+        "name": t.name,
+        "dtype": dtype_numpy_to_name(out_dtype),
+        "shape": list(values.shape),
+    }
+    indices = t.indices
+    if indices is not None:
+        header["num_indices"] = int(indices.shape[0])
+    hdr = json.dumps(header).encode("utf-8")
+    total = _FIXED + len(hdr) + values.size * out_dtype.itemsize
+    if indices is not None:
+        total += indices.shape[0] * 8
+    return hdr, values, out_dtype, indices, total
+
+
+def _write_array(buf, off, arr, dtype):
+    """ONE memcpy of ``arr`` into ``buf[off:]`` as C-order ``dtype``.
+
+    ``np.copyto`` handles strided sources (so no ``ascontiguousarray``
+    staging copy) and fuses any dtype narrowing (f32 -> bf16 wire
+    compression) into the same pass. Returns the new offset."""
+    nbytes = arr.size * dtype.itemsize
+    if nbytes:
+        dest = np.frombuffer(buf[off : off + nbytes], dtype=dtype)
+        np.copyto(dest.reshape(arr.shape), arr, casting="unsafe")
+    return off + nbytes
+
+
+def write_tensor_frame(plan, buf, off=0):
+    """Write one planned frame into ``buf`` (a writable memoryview /
+    bytearray) at ``off``; returns the offset past the frame."""
+    if not isinstance(buf, memoryview):
+        # a bytearray SLICE copies; all writes must go through one view
+        buf = memoryview(buf)
+    hdr, values, out_dtype, indices, _total = plan
+    struct.pack_into("<4sBI", buf, off, _MAGIC, _VERSION, len(hdr))
+    off += _FIXED
+    buf[off : off + len(hdr)] = hdr
+    off += len(hdr)
+    off = _write_array(buf, off, values, out_dtype)
+    if indices is not None:
+        off = _write_array(buf, off, indices, _INT64)
+    return off
+
+
 def serialize_tensor(t):
     """Frame: magic | u8 ver | u32 header_len | header json | values | indices.
 
     Header carries name/dtype/shape (+ indices count); payloads are raw
-    C-order little-endian buffers, so round-trip cost is one memcpy per
-    buffer — the same "no pb copy" goal as reference tensor.py:166-187.
+    C-order little-endian buffers written straight into the one exact
+    preallocation — a single memcpy per payload, the bf16 wire downcast
+    fused in when ``t.wire_dtype`` is set. Returns a ``bytearray``
+    (bytes-like); the frame bytes are identical to the historical
+    join-based codec, so mixed-version fleets interoperate.
     """
-    values = np.ascontiguousarray(t.values)
-    header = {
-        "name": t.name,
-        "dtype": dtype_numpy_to_name(values.dtype),
-        "shape": list(values.shape),
-    }
-    parts = [values.tobytes()]
-    if t.indices is not None:
-        idx = np.ascontiguousarray(t.indices, dtype=np.int64)
-        header["num_indices"] = int(idx.shape[0])
-        parts.append(idx.tobytes())
-    hdr = json.dumps(header).encode("utf-8")
-    return b"".join(
-        [_MAGIC, struct.pack("<BI", _VERSION, len(hdr)), hdr] + parts
-    )
+    plan = plan_tensor_frame(t)
+    buf = bytearray(plan[4])
+    write_tensor_frame(plan, buf)
+    return buf
+
+
+def _readonly(data):
+    """A read-only memoryview of ``data`` — the writable=False floor
+    every decoded view inherits (numpy propagates the flag)."""
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    return view if view.readonly else view.toreadonly()
 
 
 def deserialize_tensor(data):
-    view = memoryview(data)
-    if bytes(view[:4]) != _MAGIC:
+    """Zero-copy decode: values and indices come back as READ-ONLY
+    ``np.frombuffer`` views pinned to ``data`` (the views hold the
+    buffer alive; see :class:`WireArena` for the explicit lifetime
+    handle). Mutating/retaining consumers call
+    :meth:`Tensor.materialize` — in-process fast paths (the master
+    rung, tests) read straight out of the frame buffer with no copy at
+    all, indices included."""
+    view = _readonly(data)
+    if view[:4] != _MAGIC:
         raise ValueError("bad tensor frame magic")
     ver, hlen = struct.unpack_from("<BI", view, 4)
     if ver != _VERSION:
         raise ValueError("unsupported tensor frame version %d" % ver)
-    off = 9
-    header = json.loads(bytes(view[off : off + hlen]).decode("utf-8"))
+    off = _FIXED
+    header = json.loads(bytes(view[off : off + hlen]))
     off += hlen
     dtype = dtype_name_to_numpy(header["dtype"])
     shape = tuple(header["shape"])
@@ -159,24 +269,25 @@ def deserialize_tensor(data):
     indices = None
     if "num_indices" in header:
         n = header["num_indices"]
-        indices = np.frombuffer(
-            view[off : off + 8 * n], dtype=np.int64
-        ).copy()
-    return Tensor(header["name"], values.copy(), indices)
+        indices = np.frombuffer(view[off : off + 8 * n], dtype=np.int64)
+    return Tensor(header["name"], values, indices)
 
 
 def serialize_tensors(tensors):
-    """Concatenate framed tensors with a u64 length prefix each."""
-    out = []
-    for t in tensors:
-        b = serialize_tensor(t)
-        out.append(struct.pack("<Q", len(b)))
-        out.append(b)
-    return b"".join(out)
+    """Concatenate framed tensors with a u64 length prefix each —
+    planned once, written into ONE exact preallocation (the historical
+    per-frame join plus outer join both folded away)."""
+    plans = [plan_tensor_frame(t) for t in tensors]
+    buf = bytearray(sum(8 + p[4] for p in plans))
+    off = 0
+    for plan in plans:
+        struct.pack_into("<Q", buf, off, plan[4])
+        off = write_tensor_frame(plan, buf, off + 8)
+    return buf
 
 
 def deserialize_tensors(data):
-    view = memoryview(data)
+    view = _readonly(data)
     off = 0
     tensors = []
     while off < len(view):
@@ -185,6 +296,51 @@ def deserialize_tensors(data):
         tensors.append(deserialize_tensor(view[off : off + n]))
         off += n
     return tensors
+
+
+class WireArena:
+    """Lifetime handle for the buffer backing zero-copy decoded views.
+
+    On the gRPC bytes path the decoded numpy views refcount the buffer
+    themselves, so ``release()`` is advisory — views created from OTHER
+    messages (or this one) stay valid after it. On the shared-memory
+    path (rpc/shm_transport.py) ``release()`` RECYCLES the slot: views
+    into it become invalid, which is why the audited retention sites
+    materialize before their message is released. ``__del__`` is the
+    backstop so a dropped reply can never leak a slot."""
+
+    __slots__ = ("_buf", "_on_release", "released")
+
+    def __init__(self, buf, on_release=None):
+        self._buf = buf
+        self._on_release = on_release
+        self.released = False
+
+    def release(self):
+        if self.released:
+            return
+        self.released = True
+        self._buf = None
+        callback, self._on_release = self._on_release, None
+        if callback is not None:
+            callback()
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:  # noqa: BLE001 — interpreter-teardown destructor
+            pass
+
+
+def release_message(msg):
+    """Release the arena pinning a decoded message's buffer (no-op for
+    messages that carry none — in-process dicts, handler-side requests).
+    After this, tensors decoded from a shared-memory reply are invalid;
+    anything retained must have been materialized first."""
+    if isinstance(msg, dict):
+        arena = msg.pop("_wire_arena", None)
+        if arena is not None:
+            arena.release()
 
 
 # ---------------------------------------------------------------------------

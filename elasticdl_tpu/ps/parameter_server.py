@@ -22,6 +22,7 @@ class ParameterServer:
     def __init__(self, args):
         self._args = args
         self._server = None
+        self._shm_registry = None
         module = load_module(
             get_module_file_path(args.model_zoo, args.model_def)
         ).__dict__
@@ -51,6 +52,14 @@ class ParameterServer:
                 return handler
 
             methods = {name: delayed(fn) for name, fn in methods.items()}
+        # the shared-memory endpoint is always offered (docs/wire.md):
+        # it only engages when a co-located client negotiates a ring
+        # via transport_hello, and costs nothing otherwise. Installed
+        # OUTSIDE the delay wrap so the injected RTT still prices the
+        # control round trip, not the slot reads.
+        from elasticdl_tpu.rpc.shm_transport import install_shm_endpoint
+
+        methods, self._shm_registry = install_shm_endpoint(methods)
         self._server = serve(methods, self._args.port)
         logger.info(
             "RPC server started on port %d", self._server._edl_port
@@ -69,6 +78,12 @@ class ParameterServer:
         if self._server:
             self._server.stop(grace=None)
             self._server = None
+        if self._shm_registry is not None:
+            # reclaims every attached ring, including segments whose
+            # creator worker was SIGKILLed mid-call (its atexit unlink
+            # never ran — this is the orphan-reclamation path)
+            self._shm_registry.close()
+            self._shm_registry = None
 
 
 def main():

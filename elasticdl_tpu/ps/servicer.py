@@ -146,6 +146,13 @@ class PserverServicer:
                     "accepted": False,
                     "version": self._parameters.version,
                 }
+            # AUDITED retention sites (docs/wire.md): sync accumulation
+            # outlives this request, and the request's tensors are
+            # zero-copy views into a wire buffer that may be a shm slot
+            # the client recycles right after the reply — so the first
+            # round MUST materialize. ``combined()`` always returns
+            # fresh arrays (sparse), ``.copy()`` covers dense; later
+            # rounds allocate through ``+`` anyway.
             for t in gradients:
                 self._parameters.check_grad(t)
                 if t.is_indexed_slices():
@@ -182,6 +189,10 @@ class PserverServicer:
             return {"accepted": True, "version": self._parameters.version}
 
     def _apply(self, gradients, request_version):
+        # async applies consume the request's zero-copy views entirely
+        # WITHIN this handler call (the optimizer reads them and writes
+        # back fresh arrays), so nothing here needs materializing —
+        # the wire buffer is guaranteed alive until the reply is packed
         if self._lr_modulation:
             staleness = max(1, self._parameters.version - request_version)
             self._lr_modulation.set_multiplier(1.0 / staleness)

@@ -22,8 +22,10 @@ import numpy as np
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.tensor import (
     Tensor,
+    WireArena,
     deserialize_tensor,
-    serialize_tensor,
+    plan_tensor_frame,
+    write_tensor_frame,
 )
 from elasticdl_tpu.utils import profiling
 
@@ -55,46 +57,114 @@ def _client_metrics():
     )
 
 
-def pack_message(msg):
-    """dict -> bytes. Arrays/Tensors ride as codec frames."""
+class MessagePlan:
+    """Exact layout of one packed message (docs/wire.md).
+
+    ``segments`` holds ``("frame", tensor_frame_plan)`` or
+    ``("raw", bytes_like)`` entries with their byte lengths already
+    known, so any writer (the bytearray packer below, the shm slot
+    packer) allocates once and performs ONE memcpy per payload."""
+
+    __slots__ = ("header", "segments", "total")
+
+    def __init__(self, header, segments, total):
+        self.header = header
+        self.segments = segments
+        self.total = total
+
+
+def plan_message(msg):
+    """dict -> :class:`MessagePlan`. Arrays/Tensors ride as codec
+    frames; the plan computes every offset up front (scatter-gather)."""
     header = {}
     segments = []
 
-    def add_segment(data):
-        segments.append(data)
+    def add_frame(t):
+        plan = plan_tensor_frame(t)
+        segments.append(("frame", plan, plan[4]))
         return len(segments) - 1
 
     for key, value in msg.items():
+        if key == "_wire_arena":
+            continue  # decode-side lifetime handle, never a wire field
         if isinstance(value, Tensor):
-            header[key] = {"t": "tensor", "i": add_segment(value.to_bytes())}
+            header[key] = {"t": "tensor", "i": add_frame(value)}
         elif isinstance(value, np.ndarray):
-            header[key] = {
-                "t": "array",
-                "i": add_segment(serialize_tensor(Tensor(key, value))),
-            }
+            header[key] = {"t": "array", "i": add_frame(Tensor(key, value))}
         elif (
             isinstance(value, (list, tuple))
             and value
             and isinstance(value[0], Tensor)
         ):
-            idxs = [add_segment(t.to_bytes()) for t in value]
-            header[key] = {"t": "tensors", "i": idxs}
-        elif isinstance(value, (bytes, bytearray)):
-            header[key] = {"t": "bytes", "i": add_segment(bytes(value))}
+            header[key] = {"t": "tensors", "i": [add_frame(t) for t in value]}
+        elif isinstance(value, (bytes, bytearray, memoryview)):
+            if isinstance(value, memoryview) and (
+                value.itemsize != 1 or value.ndim != 1
+            ):
+                # len() counts ELEMENTS; the frame needs bytes (a
+                # non-contiguous view raises here — loudly, not as a
+                # torn length prefix)
+                value = value.cast("B")
+            segments.append(("raw", value, len(value)))
+            header[key] = {"t": "bytes", "i": len(segments) - 1}
         else:
             header[key] = {"t": "json", "v": value}
     hdr = json.dumps(header).encode("utf-8")
-    out = [struct.pack("<I", len(hdr)), hdr, struct.pack("<I", len(segments))]
-    for seg in segments:
-        out.append(struct.pack("<Q", len(seg)))
-        out.append(seg)
-    return b"".join(out)
+    total = 8 + len(hdr) + sum(8 + n for _, _, n in segments)
+    return MessagePlan(hdr, segments, total)
 
 
-def unpack_message(data):
-    view = memoryview(data)
+def pack_message_into(plan, buf, off=0):
+    """Write a planned message into ``buf`` (writable memoryview /
+    bytearray) at ``off``; returns the offset past the message."""
+    if not isinstance(buf, memoryview):
+        buf = memoryview(buf)  # bytearray slices copy; views don't
+    hdr = plan.header
+    struct.pack_into("<I", buf, off, len(hdr))
+    off += 4
+    buf[off : off + len(hdr)] = hdr
+    off += len(hdr)
+    struct.pack_into("<I", buf, off, len(plan.segments))
+    off += 4
+    for kind, payload, nbytes in plan.segments:
+        struct.pack_into("<Q", buf, off, nbytes)
+        off += 8
+        if kind == "frame":
+            off = write_tensor_frame(payload, buf, off)
+        else:
+            buf[off : off + nbytes] = payload
+            off += nbytes
+    return off
+
+
+def pack_message(msg):
+    """dict -> one exactly-sized frame (``bytearray``, bytes-like).
+
+    One preallocation, one memcpy per payload, zero intermediate
+    per-segment ``bytes`` objects — the seed codec's per-frame joins,
+    ``serialize_tensors``' double join, and this function's own outer
+    join all folded into the single scatter-gather write. Byte-layout
+    identical to the historical packer."""
+    plan = plan_message(msg)
+    buf = bytearray(plan.total)
+    pack_message_into(plan, buf)
+    return buf
+
+
+def unpack_message(data, arena=None):
+    """bytes-like -> dict, zero-copy: segments stay memoryview slices
+    of ``data`` and the field decoders decide what materializes —
+    tensor/array fields decode to READ-ONLY views pinned to the buffer,
+    ``bytes`` fields materialize (callers expect hashable bytes; tensor
+    payloads never ride that kind), json fields are scalars. ``arena``
+    (a :class:`WireArena`) rides along under ``"_wire_arena"`` so the
+    consumer controls the buffer's lifetime (mandatory for shm slots;
+    see common/tensor.release_message)."""
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    if not view.readonly:
+        view = view.toreadonly()
     (hlen,) = struct.unpack_from("<I", view, 0)
-    header = json.loads(bytes(view[4 : 4 + hlen]).decode("utf-8"))
+    header = json.loads(bytes(view[4 : 4 + hlen]))
     off = 4 + hlen
     (nseg,) = struct.unpack_from("<I", view, off)
     off += 4
@@ -102,7 +172,7 @@ def unpack_message(data):
     for _ in range(nseg):
         (slen,) = struct.unpack_from("<Q", view, off)
         off += 8
-        segments.append(bytes(view[off : off + slen]))
+        segments.append(view[off : off + slen])
         off += slen
     msg = {}
     for key, spec in header.items():
@@ -110,7 +180,7 @@ def unpack_message(data):
         if kind == "json":
             msg[key] = spec["v"]
         elif kind == "bytes":
-            msg[key] = segments[spec["i"]]
+            msg[key] = bytes(segments[spec["i"]])
         elif kind == "tensor":
             msg[key] = deserialize_tensor(segments[spec["i"]])
         elif kind == "array":
@@ -119,6 +189,8 @@ def unpack_message(data):
             msg[key] = [deserialize_tensor(segments[i]) for i in spec["i"]]
         else:
             raise ValueError("unknown field kind %r" % kind)
+    if arena is not None:
+        msg["_wire_arena"] = arena
     return msg
 
 
@@ -137,7 +209,11 @@ class _GenericHandler:
 
         def handler(request_bytes, context):
             reply = fn(unpack_message(request_bytes))
-            return pack_message(reply if reply is not None else {})
+            # cygrpc's SendMessageOperation is typed `bytes` exactly
+            # (grpc 1.68): this conversion is the single transport
+            # handoff copy on the reply direction — the shm transport's
+            # slot replies skip it (edlint R10 ratchet)
+            return bytes(pack_message(reply if reply is not None else {}))
 
         return self._grpc.unary_unary_rpc_method_handler(
             handler,
@@ -215,12 +291,16 @@ class Client:
         )
         self._stubs = {}
 
-    def call(self, rpc_name, _retriable=True, **fields):
+    def call(self, rpc_name, _retriable=True, _plan=None, **fields):
         """``_retriable=False`` opts this call out of the UNAVAILABLE
         retry: a non-idempotent RPC (``push_gradient`` — async mode
         applies on receipt) must not be resent when the connection died
         AFTER the server processed it, or the gradient applies twice.
         The underscore keeps the name out of the protocol field space.
+
+        ``_plan``: an already-built :class:`MessagePlan` for ``fields``
+        (the shm transport's per-call fallback hands its plan over so
+        an oversized payload is not planned twice).
         """
         stub = self._stubs.get(rpc_name)
         if stub is None:
@@ -234,7 +314,14 @@ class Client:
             # when two legs race the first call of a method (the loser
             # stub is garbage, never a torn entry)
             stub = self._stubs.setdefault(rpc_name, stub)
-        request = pack_message(fields)
+        plan = _plan if _plan is not None else plan_message(fields)
+        buf = bytearray(plan.total)
+        pack_message_into(plan, buf)
+        # cygrpc requires an exact `bytes` request: the one transport
+        # handoff copy of the send direction (edlint R10 ratchet); the
+        # scatter-gather packer already collapsed everything upstream
+        # of it to one memcpy per payload
+        request = bytes(buf)
         attempt = 0
         while True:
             t0 = time.perf_counter()
@@ -248,7 +335,11 @@ class Client:
                 self._latency.observe(
                     time.perf_counter() - t0, method=rpc_name
                 )
-                return unpack_message(reply)
+                # the gRPC reply bytes become the arena: decoded tensor
+                # views pin them by refcount, and release_message() is
+                # the uniform consumer-side hook shared with the shm
+                # path (where release actually recycles a slot)
+                return unpack_message(reply, arena=WireArena(reply))
             except self._grpc.RpcError as err:
                 code = err.code() if callable(getattr(err, "code", None)) else None
                 self._errors.inc(

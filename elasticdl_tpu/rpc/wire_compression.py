@@ -16,6 +16,17 @@ untouched in both directions, so a bf16-parameter model composes with
 compression, and a sender/receiver flag mismatch degrades to "no
 compression" rather than corruption (the frames are self-describing).
 
+Copy discipline (docs/wire.md, edlint R10): ``compress_tensors`` only
+MARKS tensors (``Tensor.wire_dtype``) — the actual f32 -> bf16 narrowing
+is fused into the codec's single frame copy-out
+(common/tensor.write_tensor_frame), so compression no longer pays its
+own ``astype`` allocation pass; the wire bytes are identical to the
+eager-downcast protocol. On the in-process transport (no serialization)
+a marked tensor passes through at full f32 precision — strictly less
+rounding than the wire pays, same contract for the receiver. The
+receiver-side upcast is the decode path's one required materialization
+for compressed tensors (R10-ratcheted with that reason).
+
 Enable with ``--wire_dtype=bfloat16`` (relayed master -> worker/PS pods
 via the argv relay, so one flag configures the whole job).
 """
@@ -27,8 +38,12 @@ from elasticdl_tpu.common.tensor import Tensor
 
 
 def compress_tensors(tensors, wire_dtype):
-    """Downcast f32 payloads to ``wire_dtype``; returns
-    ``(tensors, compressed_names)``. No-op when ``wire_dtype`` is falsy."""
+    """Mark f32 payloads to ride the wire as ``wire_dtype``; returns
+    ``(tensors, compressed_names)``. No-op when ``wire_dtype`` is falsy.
+
+    Marking is allocation-free: the returned tensors alias the input
+    arrays, and the downcast happens inside the frame writer's single
+    memcpy."""
     if not wire_dtype:
         return list(tensors), []
     if wire_dtype != "bfloat16":
@@ -39,7 +54,9 @@ def compress_tensors(tensors, wire_dtype):
     out, names = [], []
     for t in tensors:
         if t.values is not None and t.values.dtype == np.float32:
-            out.append(Tensor(t.name, t.values.astype(bf16), t.indices))
+            marked = Tensor(t.name, t.values, t.indices)
+            marked.wire_dtype = bf16
+            out.append(marked)
             names.append(t.name)
         else:
             out.append(t)
@@ -47,13 +64,25 @@ def compress_tensors(tensors, wire_dtype):
 
 
 def decompress_tensors(tensors, compressed_names):
-    """Upcast the named tensors' payloads back to f32."""
+    """Upcast the named tensors' payloads back to f32.
+
+    Payloads that arrive already f32 (the in-process transport, where a
+    compression mark never materialized) pass through without a copy —
+    only the mark is shed, so a later re-serialize cannot silently
+    downcast them again."""
     if not compressed_names:
         return list(tensors)
     names = set(compressed_names)
-    return [
-        Tensor(t.name, t.values.astype(np.float32), t.indices)
-        if t.name in names and t.values is not None
-        else t
-        for t in tensors
-    ]
+    out = []
+    for t in tensors:
+        if t.name not in names or t.values is None:
+            out.append(t)
+        elif t.values.dtype == np.float32:
+            out.append(Tensor(t.name, t.values, t.indices))
+        else:
+            # the one required decode materialization: an f32 consumer
+            # cannot read bf16 in place (edlint R10 ratchet)
+            out.append(
+                Tensor(t.name, t.values.astype(np.float32), t.indices)
+            )
+    return out

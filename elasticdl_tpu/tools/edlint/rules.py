@@ -911,6 +911,9 @@ RPC_IDEMPOTENT = frozenset(
         "pull_embedding_vectors_multi",
         "pull_dense",
         "push_model",
+        # shm ring negotiation (rpc/shm_transport): re-sending a hello
+        # re-registers the same ring (the registry pops the old attach)
+        "transport_hello",
     )
 )
 RPC_NON_IDEMPOTENT = frozenset(
@@ -1113,6 +1116,115 @@ class RpcRetrySafetyRule(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# R10 — copy-on-wire (the PR-8 zero-copy data-plane contract)
+# ---------------------------------------------------------------------------
+
+
+class CopyOnWireRule(Rule):
+    id = "R10"
+    name = "copy-on-wire"
+    doc = (
+        "the PS wire path is single-copy by contract (docs/wire.md): "
+        "inside rpc/, common/tensor.py, and the PSClient/servicer "
+        "data-plane methods, no .tobytes()/np.ascontiguousarray() "
+        "payload flattening, no .astype() on a held array, and no "
+        "wholesale bytes(...) materialization (header-sized "
+        "json.loads(bytes(...)) decodes are exempt) — encode through "
+        "the scatter-gather frame planner, decode through read-only "
+        "frombuffer views, and Tensor.materialize() at the audited "
+        "retention sites; the transport-handoff copies that must "
+        "remain are reason-ratcheted"
+    )
+
+    SCOPE_PREFIXES = ("elasticdl_tpu/rpc/",)
+    SCOPE_FILES = ("elasticdl_tpu/common/tensor.py",)
+    # in these files only the data-plane method bodies are in scope
+    # (push_*/pull_*/apply*): constructor plumbing, caches and stats
+    # code may copy freely — the contract is about payload bytes
+    METHOD_SCOPED_FILES = (
+        "elasticdl_tpu/worker/ps_client.py",
+        "elasticdl_tpu/ps/servicer.py",
+    )
+
+    def _in_scope(self, path):
+        return (
+            path in self.SCOPE_FILES
+            or path in self.METHOD_SCOPED_FILES
+            or any(path.startswith(p) for p in self.SCOPE_PREFIXES)
+        )
+
+    @staticmethod
+    def _data_plane_fn(name):
+        return name.lstrip("_").startswith(("push", "pull", "apply"))
+
+    def _feeds_json_loads(self, ctx, node):
+        """True for ``json.loads(bytes(view[...]))`` — a header-sized
+        decode, not a payload copy."""
+        parent = ctx.parent.get(node)
+        return (
+            isinstance(parent, ast.Call)
+            and dotted(parent.func).rsplit(".", 1)[-1] == "loads"
+        )
+
+    def _why(self, ctx, node):
+        """Why this call copies a payload, or None."""
+        d = dotted(node.func)
+        tail = d.rsplit(".", 1)[-1] if d else ""
+        if isinstance(node.func, ast.Attribute):
+            if tail == "tobytes":
+                return "payload flattened through .tobytes()"
+            if tail == "ascontiguousarray":
+                return "np.ascontiguousarray staging copy"
+            if tail == "astype" and isinstance(
+                node.func.value, (ast.Name, ast.Attribute)
+            ):
+                # a chained .astype off a fresh call result (e.g.
+                # np.stack(...).astype) converts an array this code
+                # just allocated, not a held wire payload
+                return (
+                    "dtype conversion allocates a full copy (fuse it "
+                    "into the frame write via Tensor.wire_dtype)"
+                )
+            return None
+        if (
+            d == "bytes"
+            and len(node.args) == 1
+            and not self._feeds_json_loads(ctx, node)
+        ):
+            return "bytes(...) materializes the whole value"
+        return None
+
+    def check(self, ctx):
+        if not self._in_scope(ctx.path):
+            return []
+        method_scoped = ctx.path in self.METHOD_SCOPED_FILES
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if method_scoped:
+                fn = ctx.enclosing(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+                if fn is None or not self._data_plane_fn(fn.name):
+                    continue
+            why = self._why(ctx, node)
+            if why:
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "copy on the wire path (%s) — the data plane "
+                        "is single-copy by contract (docs/wire.md): "
+                        "plan+write frames scatter-gather, decode as "
+                        "read-only views, materialize() only at "
+                        "audited retention sites" % why,
+                    )
+                )
+        return out
+
+
 RULES = (
     DeviceProbeRule(),
     QueuePutRule(),
@@ -1123,4 +1235,5 @@ RULES = (
     JitPurityRule(),
     LocksetRaceRule(),
     RpcRetrySafetyRule(),
+    CopyOnWireRule(),
 )

@@ -41,6 +41,7 @@ def _run(args):
         else None
     )
     ps_client = None
+    bound_ps = []
     if args.ps_addrs:
         from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
 
@@ -51,15 +52,22 @@ def _run(args):
             # trains under between model pulls
             window = getattr(args, "get_model_steps", 1)
         deadline_s = getattr(args, "rpc_deadline_s", 60.0)
+        bound_ps = [
+            BoundPS(
+                a,
+                deadline_s=deadline_s if deadline_s > 0 else None,
+                retries=getattr(args, "rpc_retries", 2),
+                # co-located pods negotiate the shared-memory payload
+                # path at first call; cross-host (or any attach
+                # failure) silently keeps the bytes path (docs/wire.md)
+                shm=getattr(args, "ps_shm", "auto"),
+                shm_slots=getattr(args, "ps_shm_slots", 4),
+                shm_slot_mb=getattr(args, "ps_shm_slot_mb", 8),
+            )
+            for a in addrs
+        ]
         ps_client = PSClient(
-            [
-                BoundPS(
-                    a,
-                    deadline_s=deadline_s if deadline_s > 0 else None,
-                    retries=getattr(args, "rpc_retries", 2),
-                )
-                for a in addrs
-            ],
+            bound_ps,
             wire_dtype=wire_dtype,
             hot_row_cache_rows=getattr(args, "hot_row_cache_rows", 0),
             staleness_window=window,
@@ -232,6 +240,10 @@ def _run(args):
             # settles any still-pending async pushes and releases the
             # fan-out threads
             ps_client.close()
+        for bound in bound_ps:
+            # unlink negotiated shm rings + close the channels (the
+            # atexit hook is only the crash floor)
+            bound.close()
     return 0
 
 

@@ -26,7 +26,7 @@ from elasticdl_tpu.common.hash_utils import (
     scatter_embedding_vector,
     string_to_id,
 )
-from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.common.tensor import Tensor, release_message
 
 
 class HotRowCache:
@@ -300,14 +300,15 @@ class PSClient:
             calls.append(
                 (shard, lambda ps=ps, req=req: ps.push_model(req))
             )
-        self._run_sharded(calls)
+        for resp in self._run_sharded(calls).values():
+            release_message(resp)
 
     def push_embedding_info(self, embedding_infos):
         infos = [
             {"name": i.name, "dim": i.dim, "initializer": i.initializer}
             for i in embedding_infos
         ]
-        self._run_sharded(
+        resps = self._run_sharded(
             [
                 (
                     shard,
@@ -318,6 +319,8 @@ class PSClient:
                 for shard, ps in enumerate(self._ps)
             ]
         )
+        for resp in resps.values():
+            release_message(resp)
 
     def pull_dense(self):
         """Merge every shard's params; returns (all_initialized, version,
@@ -341,17 +344,27 @@ class PSClient:
         )
         named = {}
         versions = []
-        for shard in range(self.num_ps):
-            resp = resps[shard]
-            if not resp.get("model_init_status"):
-                return False, -1, {}
-            versions.append(resp["version"])
-            if self._cache is not None:
-                self._cache.note_version(shard, resp["version"])
-            for t in decompress_tensors(
-                resp.get("params", []), resp.get("compressed_f32")
-            ):
-                named[t.name] = t.values
+        try:
+            for shard in range(self.num_ps):
+                resp = resps[shard]
+                if not resp.get("model_init_status"):
+                    return False, -1, {}
+                versions.append(resp["version"])
+                if self._cache is not None:
+                    self._cache.note_version(shard, resp["version"])
+                for t in decompress_tensors(
+                    resp.get("params", []), resp.get("compressed_f32")
+                ):
+                    # AUDITED retention site (docs/wire.md): the worker
+                    # keeps these params across steps, so zero-copy
+                    # decoded views must materialize here — the single
+                    # decode copy of the dense pull. Owned arrays
+                    # (in-process stubs, already-upcast bf16) pass
+                    # through untouched.
+                    named[t.name] = t.materialize().values
+        finally:
+            for resp in resps.values():
+                release_message(resp)
         return True, min(versions), named
 
     # -- gradients ----------------------------------------------------------
@@ -447,6 +460,7 @@ class PSClient:
                 # version: noting it here ages our cached copies of the
                 # rows it just rewrote
                 self._cache.note_version(shard, resp["version"])
+            release_message(resp)  # scalar reply: its shm slot recycles
         return accepted, (-1 if out_version is None else out_version)
 
     def _reap_push(self, fut):
@@ -567,6 +581,9 @@ class PSClient:
                 st["out"] = np.empty(
                     (len(st["ids"]), got.shape[1]), np.float32
                 )
+            # the scatter into the caller-owned output (and the cache's
+            # own row copies below) IS this path's one decode copy, so
+            # the zero-copy view ``got`` never outlives its message
             st["out"][positions] = got
             if self._cache is not None:
                 version = resp.get("version")
@@ -574,6 +591,7 @@ class PSClient:
                 self._cache.put_rows(
                     name, st["ids"][positions], shard, version, got
                 )
+            release_message(resp)
         return {name: st["out"] for name, st in state.items()}
 
 
@@ -608,9 +626,25 @@ class BoundPS:
     the gradient twice). ``None`` keeps the historical blocking
     channel. Terminal transport failures surface as :class:`PSRpcError`
     (a RuntimeError), feeding the worker's minibatch retry loop.
+
+    ``shm`` (docs/wire.md): ``"auto"`` negotiates the co-located
+    shared-memory payload path at first call (``transport_hello``) and
+    silently keeps the bytes path cross-host or on any attach/setup
+    failure; ``"off"`` (default — the conservative choice for direct
+    constructions in tests/benches) never negotiates. Slot geometry
+    rides ``shm_slots`` x ``shm_slot_mb``.
     """
 
-    def __init__(self, addr, deadline_s=None, retries=0, backoff_s=0.2):
+    def __init__(
+        self,
+        addr,
+        deadline_s=None,
+        retries=0,
+        backoff_s=0.2,
+        shm="off",
+        shm_slots=4,
+        shm_slot_mb=8,
+    ):
         from elasticdl_tpu.rpc.core import Client
 
         self._addr = addr
@@ -620,6 +654,27 @@ class BoundPS:
             retries=retries,
             backoff_s=backoff_s,
         )
+        self._shm = None
+        if shm in ("auto", "on"):
+            from elasticdl_tpu.rpc.shm_transport import ShmChannel
+
+            self._shm = ShmChannel(
+                self._client, n_slots=shm_slots, slot_mb=shm_slot_mb
+            )
+        elif shm not in ("off", "", None, False):
+            raise ValueError("shm must be 'auto', 'on' or 'off'")
+
+    @property
+    def shm_channel(self):
+        """The ShmChannel (None when disabled) — state/stats live on it."""
+        return self._shm
+
+    def close(self):
+        """Release the channel: unlink the shm ring (if negotiated) and
+        close the gRPC channel. Safe to call repeatedly."""
+        if self._shm is not None:
+            self._shm.close()
+        self._client.close()
 
     def __getattr__(self, method):
         def call(req):
@@ -628,6 +683,10 @@ class BoundPS:
             from elasticdl_tpu.utils import profiling
 
             try:
+                if self._shm is not None:
+                    # ShmChannel applies the same retry guard
+                    # internally (push_gradient never resends)
+                    return self._shm.call(method, **req)
                 return self._client.call(
                     method,
                     _retriable=(method != "push_gradient"),

@@ -217,6 +217,12 @@ class Worker:
         got_version, named = self._stub.get_model(version, method)
         if not named:
             return
+        # aliasing note (docs/wire.md): over real gRPC these arrays are
+        # zero-copy read-only views pinning ONE get_model reply buffer
+        # until the next pull replaces them — safe (the master plane
+        # never rides shm slots) and copy-free; jnp consumers copy at
+        # device put anyway. The PS path above materializes instead,
+        # because its replies may live in recycled shm slots.
         if self._params is not None:
             flat = pytree_to_named_arrays(self._params)
             if set(flat) == set(named):

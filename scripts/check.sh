@@ -2,9 +2,10 @@
 # One correctness gate for the threaded data plane
 # (docs/static_analysis.md):
 #
-#   1. edlint — the whole-program AST analyzer (R1-R9: concurrency,
+#   1. edlint — the whole-program AST analyzer (R1-R10: concurrency,
 #      jit-purity, cross-file blocking chains, the R8 lockset race
-#      detector, R9 RPC retry-safety) with the stale-ratchet check on
+#      detector, R9 RPC retry-safety, R10 copy-on-wire) with the
+#      stale-ratchet check on
 #      (allowlists may only shrink). The pass runs under a hard <30s
 #      wall-clock budget — the mtime-keyed AST cache keeps warm runs
 #      far below it — and emits --json; on failure the gate prints a
@@ -18,7 +19,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== edlint whole-program (R1-R9 + stale-ratchet check, 30s budget) =="
+echo "== edlint whole-program (R1-R10 + stale-ratchet check, 30s budget) =="
 EDLINT_JSON="${TMPDIR:-/tmp}/edlint_gate.$$.json"
 trap 'rm -f "$EDLINT_JSON"' EXIT
 rc=0
@@ -87,6 +88,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_telemetry.py \
     tests/test_locktrace.py \
     tests/test_edlint.py \
+    tests/test_wire.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 echo "check.sh: all gates green"

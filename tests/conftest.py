@@ -65,6 +65,7 @@ _LOCKTRACE_SUITES = {
     "test_compile_plane",
     "test_locktrace",
     "test_telemetry",
+    "test_wire",
 }
 
 

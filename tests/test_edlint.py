@@ -4,11 +4,11 @@
 Three layers:
 
 - the tree gate: ``python -m elasticdl_tpu.tools.edlint`` must exit 0
-  over this repo with ALL NINE rules active (the whole-program pass —
+  over this repo with ALL TEN rules active (the whole-program pass —
   cross-file call graph, thread roots, R8 lockset race detection, R9
-  RPC retry-safety — included), and every allowlist ratchet entry must
-  carry a reason (the acceptance bar);
-- known-bad fixtures per rule R1–R9, each paired with the safe idiom
+  RPC retry-safety, R10 copy-on-wire — included), and every allowlist
+  ratchet entry must carry a reason (the acceptance bar);
+- known-bad fixtures per rule R1–R10, each paired with the safe idiom
   the rule must NOT flag — the R4/R5/R6 bad fixtures are the REAL
   pre-fix violations PR 4 fixed; the cross-file R5 fixture re-splits
   the PR-4 ledger-lock chain across a module boundary (the shape only
@@ -85,7 +85,7 @@ def _rules_of(violations):
 # ---------------------------------------------------------------------------
 
 
-def test_tree_is_clean_under_all_nine_rules():
+def test_tree_is_clean_under_all_ten_rules():
     proc = subprocess.run(
         [sys.executable, "-m", "elasticdl_tpu.tools.edlint", "--stale"],
         capture_output=True,
@@ -1192,6 +1192,114 @@ def test_r9_unclassified_rpc_is_a_finding(tmp_path):
     )
     assert _rules_of(bad) == ["R9"], bad
     assert "unclassified" in bad[0].message
+
+
+# ---------------------------------------------------------------------------
+# R10 — copy-on-wire (the PR-8 zero-copy data-plane contract)
+# ---------------------------------------------------------------------------
+
+R10_SEED_CODEC = '''
+import json
+import struct
+
+import numpy as np
+
+_MAGIC = b"EDLT"
+
+
+def serialize_tensor(t):
+    # the seed copy chain this rule exists to keep dead: a staging
+    # ascontiguousarray, a tobytes flatten, and the b"".join
+    values = np.ascontiguousarray(t.values)
+    header = json.dumps({"shape": list(values.shape)}).encode()
+    return b"".join([_MAGIC, struct.pack("<I", len(header)), header,
+                     values.tobytes()])
+
+
+def unpack_message(data):
+    view = memoryview(data)
+    segments = [bytes(view[8:])]
+    return segments
+'''
+
+R10_SCATTER_GATHER = '''
+import json
+import struct
+
+import numpy as np
+
+_MAGIC = b"EDLT"
+
+
+def serialize_tensor(t):
+    header = json.dumps({"shape": list(t.values.shape)}).encode()
+    buf = bytearray(8 + len(header) + t.values.nbytes)
+    view = memoryview(buf)
+    struct.pack_into("<4sI", view, 0, _MAGIC, len(header))
+    view[8:8 + len(header)] = header
+    dest = np.frombuffer(view[8 + len(header):], dtype=t.values.dtype)
+    np.copyto(dest.reshape(t.values.shape), t.values, casting="unsafe")
+    return buf
+
+
+def unpack_message(data):
+    view = memoryview(data).toreadonly()
+    (hlen,) = struct.unpack_from("<I", view, 4)
+    header = json.loads(bytes(view[8:8 + hlen]))  # header-sized: exempt
+    return [view[8 + hlen:]], header
+'''
+
+
+def test_r10_pins_the_seed_copy_chain(tmp_path):
+    bad = _lint(
+        tmp_path, R10_SEED_CODEC, relpath="elasticdl_tpu/rpc/fixture.py"
+    )
+    assert _rules_of(bad) == ["R10"], bad
+    messages = "\n".join(v.message for v in bad)
+    assert "tobytes" in messages
+    assert "ascontiguousarray" in messages
+    assert "bytes(...)" in messages
+    # the scatter-gather idiom (plan, one preallocation, copyto into a
+    # frombuffer view, json.loads over a header-sized bytes()) is clean
+    assert not _lint(
+        tmp_path,
+        R10_SCATTER_GATHER,
+        relpath="elasticdl_tpu/rpc/fixture.py",
+    )
+
+
+def test_r10_is_receiver_and_scope_typed(tmp_path):
+    # .astype on a HELD array in a data-plane method copies a payload
+    bad = _lint(
+        tmp_path,
+        "import numpy as np\n"
+        "class PSClient:\n"
+        "    def pull_dense(self, resp):\n"
+        "        return resp.values.astype(np.float32)\n",
+        relpath="elasticdl_tpu/worker/ps_client.py",
+    )
+    assert _rules_of(bad) == ["R10"], bad
+    # chained off a fresh allocation (np.stack already copied) is not a
+    # wire-payload copy; non-data-plane methods are out of scope; and
+    # the same seed chain OUTSIDE the wire path is not this rule's
+    # business
+    assert not _lint(
+        tmp_path,
+        "import numpy as np\n"
+        "class PSClient:\n"
+        "    def pull_rows(self, rows):\n"
+        "        return np.stack(rows).astype(np.float32, copy=False)\n"
+        "    def _stats_blob(self, arr):\n"
+        "        return bytes(arr) + arr.tobytes()\n",
+        relpath="elasticdl_tpu/worker/ps_client.py",
+    )
+    assert not _lint(
+        tmp_path,
+        "import numpy as np\n"
+        "def checkpoint_leaf(arr):\n"
+        "    return np.ascontiguousarray(arr).tobytes()\n",
+        relpath="elasticdl_tpu/common/checkpoint_utils.py",
+    )
 
 
 # ---------------------------------------------------------------------------

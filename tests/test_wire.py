@@ -1,0 +1,571 @@
+"""The zero-copy wire contract (docs/wire.md).
+
+Four layers:
+
+- byte identity: the scatter-gather codec (plan + one preallocation +
+  one memcpy per payload) must emit EXACTLY the seed join-based
+  codec's bytes — mixed-version fleets interoperate — including
+  bf16-fused frames (Tensor.wire_dtype vs the seed's eager astype),
+  sparse indices, strided sources, and whole packed messages;
+- the aliasing/lifetime contract: decoded tensors are READ-ONLY
+  frombuffer views pinned to the received buffer (writes raise),
+  ``Tensor.materialize()`` is the audited escape hatch, and on the
+  bytes path views survive ``release_message`` of their own and of
+  OTHER messages (the arena is advisory there — refcounts rule);
+- the shared-memory transport: hello negotiation over real loopback
+  gRPC, slot round trip + recycle on release, per-call and cross-host
+  fallbacks, and orphan reclamation — the server registry unlinks the
+  ring of a client SIGKILLed mid-pull whose atexit never ran;
+- conftest wires this module into the locktraced suites, so every
+  lock the shm slot accounting takes joins the runtime lock-order
+  sanitizer and no test may leak a non-daemon thread.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.dtypes import (
+    dtype_name_to_numpy,
+    dtype_numpy_to_name,
+)
+from elasticdl_tpu.common.tensor import (
+    Tensor,
+    WireArena,
+    deserialize_tensor,
+    deserialize_tensors,
+    release_message,
+    serialize_tensor,
+    serialize_tensors,
+)
+from elasticdl_tpu.common.tensor import _MAGIC, _VERSION
+from elasticdl_tpu.rpc.core import pack_message, unpack_message
+from elasticdl_tpu.rpc.shm_transport import (
+    ShmChannel,
+    ShmEndpointRegistry,
+    ShmRing,
+    host_fingerprint,
+    install_shm_endpoint,
+)
+from elasticdl_tpu.rpc.wire_compression import (
+    compress_tensors,
+    decompress_tensors,
+)
+
+BF16 = dtype_name_to_numpy("bfloat16")
+
+
+# ---------------------------------------------------------------------------
+# the seed codec, replicated verbatim (the copy chain PR 8 removed) —
+# the byte-layout oracle the zero-copy writers must match exactly
+# ---------------------------------------------------------------------------
+
+
+def seed_serialize_tensor(t):
+    values = np.ascontiguousarray(t.values)
+    header = {
+        "name": t.name,
+        "dtype": dtype_numpy_to_name(values.dtype),
+        "shape": list(values.shape),
+    }
+    parts = [values.tobytes()]
+    if t.indices is not None:
+        idx = np.ascontiguousarray(t.indices, dtype=np.int64)
+        header["num_indices"] = int(idx.shape[0])
+        parts.append(idx.tobytes())
+    hdr = json.dumps(header).encode("utf-8")
+    return b"".join(
+        [_MAGIC, struct.pack("<BI", _VERSION, len(hdr)), hdr] + parts
+    )
+
+
+def seed_serialize_tensors(tensors):
+    out = []
+    for t in tensors:
+        b = seed_serialize_tensor(t)
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    return b"".join(out)
+
+
+def seed_pack_message(msg):
+    header = {}
+    segments = []
+
+    def add_segment(data):
+        segments.append(data)
+        return len(segments) - 1
+
+    for key, value in msg.items():
+        if isinstance(value, Tensor):
+            header[key] = {
+                "t": "tensor",
+                "i": add_segment(seed_serialize_tensor(value)),
+            }
+        elif isinstance(value, np.ndarray):
+            header[key] = {
+                "t": "array",
+                "i": add_segment(seed_serialize_tensor(Tensor(key, value))),
+            }
+        elif (
+            isinstance(value, (list, tuple))
+            and value
+            and isinstance(value[0], Tensor)
+        ):
+            idxs = [add_segment(seed_serialize_tensor(t)) for t in value]
+            header[key] = {"t": "tensors", "i": idxs}
+        elif isinstance(value, (bytes, bytearray)):
+            header[key] = {"t": "bytes", "i": add_segment(bytes(value))}
+        else:
+            header[key] = {"t": "json", "v": value}
+    hdr = json.dumps(header).encode("utf-8")
+    out = [struct.pack("<I", len(hdr)), hdr, struct.pack("<I", len(segments))]
+    for seg in segments:
+        out.append(struct.pack("<Q", len(seg)))
+        out.append(seg)
+    return b"".join(out)
+
+
+def _rng():
+    return np.random.default_rng(8)
+
+
+def _sparse():
+    return Tensor(
+        "emb",
+        _rng().standard_normal((3, 4)).astype(np.float32),
+        indices=np.array([7, 1, 30], dtype=np.int64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# byte identity vs the seed codec
+# ---------------------------------------------------------------------------
+
+
+def test_frame_bytes_identical_to_seed_codec():
+    dense = Tensor("w", _rng().standard_normal((5, 3)).astype(np.float32))
+    ints = Tensor("steps", np.arange(6, dtype=np.int64).reshape(2, 3))
+    empty = Tensor("z", np.zeros((0, 4), np.float32))
+    for t in (dense, _sparse(), ints, empty):
+        assert bytes(serialize_tensor(t)) == seed_serialize_tensor(t)
+    assert bytes(
+        serialize_tensors([dense, _sparse(), ints])
+    ) == seed_serialize_tensors([dense, _sparse(), ints])
+
+
+def test_strided_source_serializes_like_seed():
+    # the seed staged through ascontiguousarray; the scatter-gather
+    # writer lets np.copyto walk the strides during its one memcpy
+    base = _rng().standard_normal((8, 6)).astype(np.float32)
+    strided = base[::2, ::3]
+    assert not strided.flags.c_contiguous
+    t = Tensor("s", strided)
+    assert bytes(serialize_tensor(t)) == seed_serialize_tensor(t)
+
+
+def test_bf16_fused_frame_identical_to_seed_eager_downcast():
+    dense = Tensor("w", _rng().standard_normal((4, 4)).astype(np.float32))
+    sparse = _sparse()
+    marked, names = compress_tensors([dense, sparse], "bfloat16")
+    assert names == ["w", "emb"]
+    # the mark is allocation-free: payloads still alias the caller's
+    assert marked[0].values is dense.values
+    # the seed protocol downcast eagerly, then serialized the bf16 copy
+    seed = seed_serialize_tensor(
+        Tensor("w", dense.values.astype(BF16), None)
+    )
+    assert bytes(serialize_tensor(marked[0])) == seed
+    seed_sp = seed_serialize_tensor(
+        Tensor("emb", sparse.values.astype(BF16), sparse.indices)
+    )
+    assert bytes(serialize_tensor(marked[1])) == seed_sp
+    # and the receiver upcast restores f32 within bf16 tolerance
+    back = decompress_tensors(
+        [deserialize_tensor(bytes(serialize_tensor(m))) for m in marked],
+        names,
+    )
+    assert back[0].values.dtype == np.float32
+    np.testing.assert_allclose(
+        back[0].values, dense.values, rtol=1e-2, atol=1e-2
+    )
+    np.testing.assert_array_equal(back[1].indices, sparse.indices)
+
+
+def test_packed_message_identical_to_seed_packer():
+    msg = {
+        "t": Tensor("w", _rng().standard_normal((3, 3)).astype(np.float32)),
+        "arr": np.arange(5, dtype=np.float32),
+        "many": [_sparse(), Tensor("b", np.ones((2,), np.float32))],
+        "blob": b"\x00raw\xff",
+        "version": 41,
+        "name": "shard-0",
+    }
+    assert bytes(pack_message(msg)) == seed_pack_message(msg)
+    # and "_wire_arena" is a decode-side handle, never a wire field
+    decoded = unpack_message(
+        bytes(pack_message(msg)), arena=WireArena(b"")
+    )
+    assert bytes(pack_message(decoded)) == seed_pack_message(msg)
+
+
+# ---------------------------------------------------------------------------
+# the aliasing/lifetime contract
+# ---------------------------------------------------------------------------
+
+
+def test_decoded_views_are_readonly_and_zero_copy():
+    t = _sparse()
+    buf = bytearray(serialize_tensor(t))  # writable backing store
+    got = deserialize_tensor(buf)
+    for arr in (got.values, got.indices):
+        assert not arr.flags.writeable
+        with pytest.raises((ValueError, RuntimeError)):
+            arr[0] = 0
+    # views alias the frame buffer: an in-place poke to the backing
+    # bytearray is visible through the decoded arrays (zero copy,
+    # indices included — the in-process master path reads in place)
+    before_v = got.values.copy()
+    before_i = got.indices.copy()
+    buf[-1] ^= 0xFF  # last byte of the indices payload
+    assert not np.array_equal(got.indices, before_i)
+    buf[-1] ^= 0xFF
+    np.testing.assert_array_equal(got.values, before_v)
+    np.testing.assert_array_equal(got.indices, before_i)
+
+
+def test_materialize_is_the_escape_hatch_and_free_for_owned():
+    got = deserialize_tensor(bytes(serialize_tensor(_sparse())))
+    owned = got.materialize()
+    assert owned is not got
+    assert owned.values.flags.writeable and owned.indices.flags.writeable
+    np.testing.assert_array_equal(owned.values, got.values)
+    owned.values[0, 0] = 7.0  # safe: no longer aliases the wire buffer
+    # already-owned tensors pass through untouched (the call is free
+    # everywhere but the decode edge)
+    local = _sparse()
+    assert local.materialize() is local
+    assert owned.materialize() is owned
+
+
+def test_views_survive_arena_release_of_other_messages():
+    msgs = []
+    for k in range(3):
+        wire = bytes(
+            pack_message(
+                {"t": Tensor("w", np.full((64,), float(k), np.float32))}
+            )
+        )
+        msgs.append(unpack_message(wire, arena=WireArena(wire)))
+    release_message(msgs[0])
+    release_message(msgs[0])  # idempotent, and a no-op without an arena
+    for k in (1, 2):
+        np.testing.assert_array_equal(
+            msgs[k]["t"].values, np.full((64,), float(k), np.float32)
+        )
+    # on the bytes path even the RELEASED message's views stay valid:
+    # numpy refcounts the buffer, the arena is advisory
+    np.testing.assert_array_equal(
+        msgs[0]["t"].values, np.zeros((64,), np.float32)
+    )
+
+
+def test_arena_release_callback_fires_once_even_via_del():
+    fired = []
+    arena = WireArena(b"x", on_release=lambda: fired.append(1))
+    msg = {"_wire_arena": arena}
+    release_message(msg)
+    assert "_wire_arena" not in msg
+    release_message(msg)
+    arena.release()
+    arena.__del__()
+    assert fired == [1]
+
+
+# ---------------------------------------------------------------------------
+# the shared-memory transport
+# ---------------------------------------------------------------------------
+
+
+def _dense(n=2048):
+    return np.arange(n, dtype=np.float32)
+
+
+@pytest.fixture
+def shm_fleet():
+    """Real loopback gRPC server with the shm endpoint installed, plus
+    a negotiated ShmChannel client. Closes everything on teardown."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841 — transport dep
+    from elasticdl_tpu.rpc.core import Client, serve
+
+    calls = {"n": 0}
+
+    def pull_dense(req):
+        calls["n"] += 1
+        return {
+            "version": calls["n"],
+            "params": [Tensor("w", _dense() * req.get("scale", 1.0))],
+        }
+
+    def push_gradient(req):
+        # the audited-retention shape: accumulate outlives the request,
+        # so the handler materializes before the slot recycles
+        g = req["grad"].materialize()
+        return {"accepted": True, "sum": float(g.values.sum())}
+
+    methods, registry = install_shm_endpoint(
+        {"pull_dense": pull_dense, "push_gradient": push_gradient}
+    )
+    server = serve(methods, 0)
+    client = Client("localhost:%d" % server._edl_port)
+    chan = ShmChannel(client, n_slots=2, slot_mb=1)
+    try:
+        yield chan, registry, calls
+    finally:
+        chan.close()
+        client.close()
+        server.stop(None)
+        registry.close()
+
+
+def test_shm_round_trip_and_slot_recycle(shm_fleet):
+    chan, _registry, _calls = shm_fleet
+    resp = chan.call("pull_dense", scale=2.0)
+    assert chan.state == "on"
+    assert chan.stats["shm"] == 1 and chan.stats["inline"] == 0
+    got = resp["params"][0]
+    assert not got.values.flags.writeable
+    np.testing.assert_array_equal(got.values, _dense() * 2.0)
+    # retention contract: materialize BEFORE releasing the message —
+    # release recycles the slot on this transport
+    kept = got.materialize().values
+    release_message(resp)
+    with chan._mu:
+        assert sorted(chan._free) == [0, 1]  # slot back in the pool
+    np.testing.assert_array_equal(kept, _dense() * 2.0)
+    # push direction: request payload rides the slot too
+    resp2 = chan.call(
+        "push_gradient", grad=Tensor("g", np.ones((8,), np.float32))
+    )
+    assert resp2["accepted"] and resp2["sum"] == 8.0
+    release_message(resp2)
+    assert chan.stats["shm"] == 2
+
+
+def test_shm_oversized_payload_falls_back_per_call(shm_fleet):
+    chan, _registry, _calls = shm_fleet
+    big = Tensor("g", np.zeros((1 << 19,), np.float32))  # 2 MiB > 1 MiB slot
+    resp = chan.call("push_gradient", grad=big)
+    assert resp["accepted"]
+    assert chan.stats["inline"] == 1
+    assert chan.state == "on"  # per-call fallback, channel stays on
+    resp2 = chan.call("pull_dense")
+    np.testing.assert_array_equal(resp2["params"][0].values, _dense())
+    release_message(resp2)
+    assert chan.stats["shm"] == 1
+
+
+def test_shm_declined_cross_host_uses_bytes_path():
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from elasticdl_tpu.rpc.core import Client, serve
+
+    registry = ShmEndpointRegistry()
+    registry._fingerprint = "other-host|far-away"  # cross-host server
+    methods = {
+        "pull_dense": lambda req: {"params": [Tensor("w", _dense())]}
+    }
+    wrapped = {n: registry.wrap(f) for n, f in methods.items()}
+    wrapped["transport_hello"] = registry.hello
+    server = serve(wrapped, 0)
+    client = Client("localhost:%d" % server._edl_port)
+    chan = ShmChannel(client, n_slots=2, slot_mb=1)
+    try:
+        resp = chan.call("pull_dense")
+        np.testing.assert_array_equal(resp["params"][0].values, _dense())
+        assert chan.state == "off"
+        assert chan.stats["inline"] == 1 and chan.stats["shm"] == 0
+        release_message(resp)  # advisory on the bytes path
+    finally:
+        chan.close()
+        client.close()
+        server.stop(None)
+        registry.close()
+
+
+def test_shm_hello_validates_geometry_and_name():
+    registry = ShmEndpointRegistry()
+    fp = host_fingerprint()
+    base = {"n_slots": 2, "slot_size": 1 << 20, "host": fp}
+    assert not registry.hello(dict(base, name="not-ours"))["accepted"]
+    assert not registry.hello(
+        dict(base, name="edlw-x", n_slots=10_000)
+    )["accepted"]
+    assert not registry.hello(
+        dict(base, name="edlw-x", host="elsewhere|")
+    )["accepted"]
+    # a well-formed hello for a segment that does not exist fails at
+    # attach, not with a crash
+    resp = registry.hello(dict(base, name="edlw-nonexistent"))
+    assert not resp["accepted"] and "attach" in resp["reason"]
+    registry.close()
+
+
+def test_shm_ring_reclaimed_after_client_sigkilled_mid_pull():
+    """The orphan path (docs/wire.md): a client creates a ring, the
+    server attaches via hello, the client is SIGKILLed mid-pull — its
+    atexit unlink never runs (and the pod-kill case loses the resource
+    tracker too, which the child simulates by unregistering) — and the
+    server registry's close() is what reclaims the segment name."""
+    child = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import sys, time\n"
+            "from multiprocessing import resource_tracker\n"
+            "from elasticdl_tpu.rpc.shm_transport import ShmRing\n"
+            "ring = ShmRing(2, 1 << 16)\n"
+            "resource_tracker.unregister(ring._shm._name, 'shared_memory')\n"
+            "print(ring.name, flush=True)\n"
+            "time.sleep(120)\n",  # parked "mid-pull" until the SIGKILL
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        name = child.stdout.readline().strip()
+        assert name.startswith("edlw-")
+        registry = ShmEndpointRegistry()
+        accepted = registry.hello(
+            {
+                "name": name,
+                "n_slots": 2,
+                "slot_size": 1 << 16,
+                "host": host_fingerprint(),
+            }
+        )
+        assert accepted["accepted"]
+        child.kill()  # SIGKILL: no atexit, no tracker cleanup
+        child.wait(timeout=30)
+        # the name leaked past the client's death...
+        from multiprocessing import shared_memory
+
+        probe = shared_memory.SharedMemory(name=name)
+        probe.close()
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister("/" + name, "shared_memory")
+        except (KeyError, ValueError, OSError):
+            pass
+        # ...until the server registry reclaims every attached ring
+        registry.close()
+        with pytest.raises(FileNotFoundError):
+            shared_memory.SharedMemory(name=name)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+        if child.stdout:
+            child.stdout.close()
+
+
+def test_shm_server_restart_disables_channel_and_resends_inline():
+    """A restarted PS lost its ring attachments: the server answers
+    _shm_error BEFORE dispatch, the client resends inline exactly once
+    and stops offering shm on the channel."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from elasticdl_tpu.rpc.core import Client, serve
+
+    methods, registry = install_shm_endpoint(
+        {"pull_dense": lambda req: {"params": [Tensor("w", _dense())]}}
+    )
+    server = serve(methods, 0)
+    client = Client("localhost:%d" % server._edl_port)
+    chan = ShmChannel(client, n_slots=2, slot_mb=1)
+    try:
+        resp = chan.call("pull_dense")
+        release_message(resp)
+        assert chan.state == "on"
+        registry.close()  # the "restart": attachments gone, server up
+        resp = chan.call("pull_dense")  # _shm_error -> inline resend
+        np.testing.assert_array_equal(resp["params"][0].values, _dense())
+        assert chan.state == "off"
+        assert chan.stats["inline"] == 1
+    finally:
+        chan.close()
+        client.close()
+        server.stop(None)
+        registry.close()
+
+
+def test_shm_ring_unlink_is_idempotent_and_attach_checks_size():
+    ring = ShmRing(2, 1 << 12)
+    attached = ShmRing(2, 1 << 12, name=ring.name)
+    with pytest.raises(ValueError):
+        ShmRing(64, 1 << 20, name=ring.name)  # advertised > actual
+    with pytest.raises(ValueError):
+        ShmRing(2, 1 << 12, name="unprefixed-segment")
+    attached.destroy()  # attacher: close only, no unlink
+    ring.destroy()
+    ring.destroy()  # idempotent
+    from multiprocessing import shared_memory
+
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=ring.name)
+
+
+def test_memoryview_field_sizes_in_bytes_not_elements():
+    # plan_message accepts memoryview payloads; a typed view's len()
+    # counts elements, and sizing the frame with it would corrupt the
+    # length prefix — the packer must count bytes
+    arr = np.arange(4, dtype=np.float32)
+    msg = unpack_message(bytes(pack_message({"b": memoryview(arr)})))
+    assert msg["b"] == arr.tobytes()
+
+
+def test_disable_defers_ring_destroy_until_inflight_drain():
+    """A peer _shm_error (or close()) racing a fan-out sibling's
+    in-flight call must not close the shared mapping under it: the
+    sibling degrades to the bytes path, the ring dies with the last
+    user out."""
+    chan = ShmChannel(client=None, n_slots=2, slot_mb=1)
+    ring = ShmRing(2, 1 << 12)
+    with chan._mu:
+        chan._state = "on"
+        chan._ring = ring
+    claim = chan._acquire()  # a call is now between acquire and leave
+    assert claim is not None and claim[0] is ring
+    chan._disable()
+    assert chan.state == "off"
+    assert not ring._destroyed  # deferred: the in-flight call owns it
+    ring.read_header(claim[1])  # mapping still usable mid-call
+    assert chan._acquire() is None  # but no NEW claims after disable
+    chan._leave()
+    assert ring._destroyed  # last user out destroyed the retired ring
+    chan.close()
+
+
+def test_release_under_load_returns_every_slot(shm_fleet):
+    """A fan-out-shaped burst: more calls than slots, interleaved
+    releases — every slot must come home and no call may fail."""
+    chan, _registry, _calls = shm_fleet
+    for _round in range(3):
+        resps = [chan.call("pull_dense") for _ in range(4)]
+        for resp in resps:
+            np.testing.assert_array_equal(
+                resp["params"][0].values, _dense()
+            )
+            release_message(resp)
+    with chan._mu:
+        assert sorted(chan._free) == [0, 1]
+    # 2 slots, 4 concurrent-ish calls per round: the pool bounds shm
+    # use, the spill rides inline, nothing errors
+    assert chan.stats["shm"] + chan.stats["inline"] == 12

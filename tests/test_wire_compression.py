@@ -11,7 +11,11 @@ import numpy as np
 import optax
 import pytest
 
-from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.common.tensor import (
+    Tensor,
+    deserialize_tensor,
+    serialize_tensor,
+)
 from elasticdl_tpu.rpc.wire_compression import (
     compress_tensors,
     decompress_tensors,
@@ -28,14 +32,25 @@ def test_roundtrip_within_bf16_tolerance_and_names_listed():
     )
     out, names = compress_tensors([dense, sparse], "bfloat16")
     assert names == ["w", "emb"]
-    assert str(out[0].values.dtype) == "bfloat16"
-    back = decompress_tensors(out, names)
+    # compression MARKS (allocation-free): values still alias the
+    # caller's f32 arrays, the downcast fuses into the frame copy-out
+    assert out[0].values is dense.values
+    assert str(out[0].wire_dtype) == "bfloat16"
+    frame = deserialize_tensor(serialize_tensor(out[0]))
+    assert str(frame.values.dtype) == "bfloat16"
+    back = decompress_tensors([frame, out[1]], names)
     assert back[0].values.dtype == np.float32
     # bf16 has 8 mantissa bits
     np.testing.assert_allclose(
         back[0].values, dense.values, rtol=1e-2, atol=1e-2
     )
     np.testing.assert_array_equal(back[1].indices, sparse.indices)
+    # the in-process transport never serialized: the marked tensor
+    # passes decompress at full f32 precision, mark shed
+    assert back[1].values is sparse.values or np.array_equal(
+        back[1].values, sparse.values
+    )
+    assert back[1].wire_dtype is None
 
 
 def test_non_f32_payloads_pass_through():
