@@ -36,6 +36,13 @@ Additional modes (BASELINE.md "measured baselines" rows):
   arms (concurrent shard fan-out + double-buffered async push) and a
   slow-shard fan-out microbench whose wall must track the slowest
   shard, not the shard sum.
+- ``--hybrid``: the hybrid comm plane (docs/embedding_planes.md) vs the
+  PS-everything trainer on the same 2-process injected-RTT fleet —
+  dense parameters local + the PS-plane table's pull overlapped behind
+  the previous batch's compute, against every parameter round-tripping
+  through the PS at its best known config. Gated >=1.3x, behind a
+  bitwise lookup/gradient equivalence pre-pass. CPU-only; part of the
+  default suite.
 - ``--e2e``: feeds the step from a generated EDLR record file through the
   framework's reader + Dataset shim (decode, map, shuffle, batch,
   prefetch) — what a worker actually runs, so input-pipeline regressions
@@ -1214,9 +1221,111 @@ def _force_cpu_backend():
         clear_backends()
 
 
-def _bench_ps_impl(quick=False):
+# PS bootstrap: CPU-forced, and a parent-death watchdog so a killed
+# bench driver (subprocess timeout) cannot leak PS grandchildren.
+# Shared by every fleet-driving arm (--ps, --hybrid).
+def _ps_fleet_boot_code():
+    here = os.path.dirname(os.path.abspath(__file__))
+    return (
+        "import os, sys, threading, time\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "bench._force_cpu_backend()\n"
+        "_parent = os.getppid()\n"
+        "def _watch():\n"
+        "    while os.getppid() == _parent:\n"
+        "        time.sleep(1.0)\n"
+        "    os._exit(0)\n"
+        "threading.Thread(target=_watch, daemon=True).start()\n"
+        "from elasticdl_tpu.ps.parameter_server import ParameterServer\n"
+        "from elasticdl_tpu.common.args import parse_ps_args\n"
+        "server = ParameterServer(parse_ps_args(sys.argv[1:]))\n"
+        "server.prepare()\n"
+        "server.run()\n"
+    ) % here
+
+
+def _launch_ps_fleet(err_dir, model_zoo, model_def, tag, extra_args=(), n=2):
+    """Launch ``n`` real async PS OS processes and wait for their ports.
+
+    Returns (procs, addrs); stop with :func:`_stop_ps_fleet`. The
+    bind-then-close port picking has a TOCTOU window; a lost race
+    surfaces through the per-process stderr files in ``err_dir``
+    instead of silently."""
     import socket
     import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    ps_boot = _ps_fleet_boot_code()
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    procs = []
+    for i, port in enumerate(ports):
+        err = open(
+            os.path.join(err_dir, "ps-%s-%d.err" % (tag, i)), "wb"
+        )
+        procs.append(
+            (
+                subprocess.Popen(
+                    [
+                        sys.executable, "-c", ps_boot,
+                        "--ps_id", str(i),
+                        "--port", str(port),
+                        "--model_zoo", model_zoo,
+                        "--model_def", model_def,
+                        "--use_async", "true",
+                        "--grads_to_wait", "1",
+                    ] + list(extra_args),
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=err,
+                ),
+                err,
+            )
+        )
+    deadline = time.time() + 60
+    for (proc, err), port in zip(procs, ports):
+        while True:
+            if proc.poll() is not None:
+                err.flush()
+                raise RuntimeError(
+                    "PS exited rc=%d at boot: %s"
+                    % (
+                        proc.returncode,
+                        open(err.name, "rb").read()[-2000:],
+                    )
+                )
+            try:
+                with socket.create_connection(("localhost", port), 1.0):
+                    break
+            except OSError:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        "PS did not come up: %s"
+                        % open(err.name, "rb").read()[-2000:]
+                    )
+                time.sleep(0.2)
+    return procs, ["localhost:%d" % p for p in ports]
+
+
+def _stop_ps_fleet(procs):
+    for proc, _ in procs:
+        proc.terminate()
+    for proc, err in procs:
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+        err.close()
+
+
+def _bench_ps_impl(quick=False):
     import tempfile
 
     _force_cpu_backend()
@@ -1242,101 +1351,16 @@ def _bench_ps_impl(quick=False):
     model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
     model_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
-    # PS bootstrap: CPU-forced, and a parent-death watchdog so a killed
-    # bench driver (subprocess timeout) cannot leak PS grandchildren
-    ps_boot = (
-        "import os, sys, threading, time\n"
-        "sys.path.insert(0, %r)\n"
-        "import bench\n"
-        "bench._force_cpu_backend()\n"
-        "_parent = os.getppid()\n"
-        "def _watch():\n"
-        "    while os.getppid() == _parent:\n"
-        "        time.sleep(1.0)\n"
-        "    os._exit(0)\n"
-        "threading.Thread(target=_watch, daemon=True).start()\n"
-        "from elasticdl_tpu.ps.parameter_server import ParameterServer\n"
-        "from elasticdl_tpu.common.args import parse_ps_args\n"
-        "server = ParameterServer(parse_ps_args(sys.argv[1:]))\n"
-        "server.prepare()\n"
-        "server.run()\n"
-    ) % here
-
     def launch_fleet(wire, err_dir, tag=None, extra_args=()):
-        # bind-then-close port picking has a TOCTOU window; a lost race
-        # surfaces through the stderr files below instead of silently
-        ports = []
-        for _ in range(2):
-            s = socket.socket()
-            s.bind(("localhost", 0))
-            ports.append(s.getsockname()[1])
-            s.close()
-        procs = []
-        for i, port in enumerate(ports):
-            err = open(
-                os.path.join(
-                    err_dir,
-                    "ps-%s-%d.err" % (tag or wire or "f32", i),
-                ),
-                "wb",
-            )
-            procs.append(
-                (
-                    subprocess.Popen(
-                        [
-                            sys.executable, "-c", ps_boot,
-                            "--ps_id", str(i),
-                            "--port", str(port),
-                            "--model_zoo", MODEL_ZOO_PATH,
-                            "--model_def", model_def,
-                            "--use_async", "true",
-                            "--grads_to_wait", "1",
-                            "--wire_dtype", wire,
-                        ] + list(extra_args),
-                        env=env,
-                        stdout=subprocess.DEVNULL,
-                        stderr=err,
-                    ),
-                    err,
-                )
-            )
-        deadline = time.time() + 60
-        for (proc, err), port in zip(procs, ports):
-            while True:
-                if proc.poll() is not None:
-                    err.flush()
-                    raise RuntimeError(
-                        "PS exited rc=%d at boot: %s"
-                        % (
-                            proc.returncode,
-                            open(err.name, "rb").read()[-2000:],
-                        )
-                    )
-                try:
-                    with socket.create_connection(
-                        ("localhost", port), 1.0
-                    ):
-                        break
-                except OSError:
-                    if time.time() > deadline:
-                        raise RuntimeError(
-                            "PS did not come up: %s"
-                            % open(err.name, "rb").read()[-2000:]
-                        )
-                    time.sleep(0.2)
-        return procs, ["localhost:%d" % p for p in ports]
+        return _launch_ps_fleet(
+            err_dir,
+            MODEL_ZOO_PATH,
+            model_def,
+            tag or wire or "f32",
+            extra_args=["--wire_dtype", wire] + list(extra_args),
+        )
 
-    def stop_fleet(procs):
-        for proc, _ in procs:
-            proc.terminate()
-        for proc, err in procs:
-            try:
-                proc.wait(timeout=10)
-            except Exception:
-                proc.kill()
-            err.close()
+    stop_fleet = _stop_ps_fleet
 
     def run_job(
         addrs,
@@ -1591,6 +1615,278 @@ def _bench_ps_fanout_microbench(quick=False):
         "fanout_slowest_shard_s": slow_s,
         "fanout_shard_sum_s": fast_s * (shards - 1) + slow_s,
     }
+
+
+def bench_hybrid(quick=False):
+    """Hybrid comm plane vs the PS-everything trainer
+    (docs/embedding_planes.md): the same deepfm workload against the
+    same 2-process injected-RTT PS fleet, driven (a) with every
+    parameter — dense layers included — round-tripping through the PS
+    (the classic loop at its best known config: fan-out + async push
+    window + get_model_steps=4) and (b) in hybrid mode, where dense
+    parameters live in the local/allreduce world and only the
+    PS-plane embedding table is served by the fleet, its per-batch
+    pull overlapped behind the previous batch's jitted step. An
+    equivalence pre-pass runs first: PS-only and hybrid produce
+    BITWISE-identical lookups and dense gradients from a common
+    initialization (the SSP window's step-0 point), so the speedup is
+    a wire-plane property, not a numerics change. CPU-forced
+    subprocess, same containment as --ps."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench, json\n"
+        "print('HYBENCH ' + json.dumps(bench._bench_hybrid_impl(%r)))\n"
+    ) % (here, quick)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=1800,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(
+            "hybrid bench timed out:\n%s" % str(e.stdout or "")[-2000:]
+        ) from e
+    for line in proc.stdout.splitlines():
+        if line.startswith("HYBENCH "):
+            return json.loads(line[len("HYBENCH "):])
+    raise RuntimeError(
+        "hybrid bench failed:\n"
+        + proc.stdout[-2000:]
+        + proc.stderr[-2000:]
+    )
+
+
+def _hybrid_equivalence_check():
+    """The --hybrid pre-pass: PS-only vs hybrid planes from one common
+    initialization produce bitwise-identical lookups (forward logits),
+    loss, shared dense gradients, and embedding-row gradients (the
+    hybrid bias table's dense gradient must equal the PS arm's
+    scattered sparse rows). In-process servicers: no wire, no
+    scheduling noise — pure plane numerics."""
+    import optax
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.ps.parameters import Parameters
+    from elasticdl_tpu.ps.servicer import PserverServicer
+    from elasticdl_tpu.worker.ps_client import PSClient
+    from tests.test_utils import MODEL_ZOO_PATH
+    from elasticdl_tpu.worker.worker import Worker
+
+    vocab, dim = 96, 16
+    rng = np.random.default_rng(11)
+    pool = rng.permutation(vocab)[:24]
+    weights = 1.0 / np.arange(1, 25) ** 1.1
+    weights /= weights.sum()
+    # power-law duplicated ids: the dedup planner's combined row grads
+    # must match the dense scatter under heavy duplication too
+    features = {
+        "feature": rng.choice(pool, size=(64, 10), p=weights).astype(
+            np.int64
+        )
+    }
+    labels = rng.integers(0, 2, size=(64, 1)).astype(np.int32)
+
+    servicers = [
+        PserverServicer(
+            Parameters(),
+            grads_to_wait=1,
+            optimizer=optax.sgd(0.1),
+            use_async=True,
+        )
+        for _ in range(2)
+    ]
+
+    def make_worker(zoo_plane, worker_plane):
+        return Worker(
+            worker_id=1,
+            job_type=JobType.TRAINING_ONLY,
+            minibatch_size=64,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def=(
+                "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+            ),
+            model_params="embedding_dim=%d,fc_unit=16,vocab_size=%d,"
+            "embedding_plane='%s'" % (dim, vocab, zoo_plane),
+            ps_client=PSClient(servicers),
+            embedding_plane=worker_plane,
+            embedding_prefetch=False,
+        )
+
+    wp = make_worker("ps", "ps")
+    wh = make_worker("hybrid", "hybrid")
+    wp._run_model_call_before_training(features)
+    wh._run_model_call_before_training(features)
+    # one common initialization: shared dense leaves copied across, the
+    # hybrid bias table seeded from the SAME store rows the PS arm pulls
+    for key in ("Dense_0", "Dense_1"):
+        wh._params[key] = wp._params[key]
+    bias_rows = wp._ps_client.pull_embedding_vectors(
+        "id_bias", np.arange(vocab)
+    )
+    import jax.numpy as jnp
+
+    wh._params["id_bias"]["table"] = jnp.asarray(
+        np.asarray(bias_rows, np.float32)
+    )
+
+    checks = {}
+    fp = wp.forward_process(features)
+    fh = wh.forward_process(features)
+    checks["lookups_identical"] = bool(
+        np.array_equal(np.asarray(fp["logits"]), np.asarray(fh["logits"]))
+    )
+    lp, gp, sp = wp.training_process(features, labels)
+    lh, gh, sh = wh.training_process(features, labels)
+    checks["loss_identical"] = float(lp) == float(lh)
+    checks["dense_grads_identical"] = all(
+        np.array_equal(np.asarray(gp[k][leaf]), np.asarray(gh[k][leaf]))
+        for k in ("Dense_0", "Dense_1")
+        for leaf in gp[k]
+    )
+    sp_by = {t.name: t for t in sp}
+    sh_by = {t.name: t for t in sh}
+    checks["embedding_row_grads_identical"] = bool(
+        np.array_equal(
+            sp_by["embedding"].values, sh_by["embedding"].values
+        )
+        and np.array_equal(
+            sp_by["embedding"].indices, sh_by["embedding"].indices
+        )
+    )
+    scattered = np.zeros((vocab, 1), np.float32)
+    scattered[np.asarray(sp_by["id_bias"].indices)] = np.asarray(
+        sp_by["id_bias"].values
+    )
+    checks["bias_plane_grads_identical"] = bool(
+        np.array_equal(scattered, np.asarray(gh["id_bias"]["table"]))
+    )
+    for worker in (wp, wh):
+        worker._ps_client.close()
+    checks["ok"] = all(checks.values())
+    return checks
+
+
+def _bench_hybrid_impl(quick=False):
+    import tempfile
+
+    _force_cpu_backend()
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import (
+        MODEL_ZOO_PATH,
+        DatasetName,
+        create_recordio_file,
+    )
+
+    results = {"equivalence": _hybrid_equivalence_check()}
+    if not results["equivalence"]["ok"]:
+        return results
+
+    records = 256 if quick else 2048
+    batch = 32
+    rtt_ms = 30.0
+    results["rtt_ms"] = rtt_ms
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+
+    def launch_fleet(tag):
+        return _launch_ps_fleet(
+            tmp,
+            MODEL_ZOO_PATH,
+            model_def,
+            "hy-" + tag,
+            extra_args=["--rpc_inject_delay_ms", str(rtt_ms)],
+        )
+
+    stop_fleet = _stop_ps_fleet
+
+    def run_job(addrs, data, n, model_params, worker_kwargs):
+        shards = {data: (0, n)}
+        task_d = TaskDispatcher(shards, {}, {}, batch * 4, 1)
+        master = MasterServicer(
+            1,
+            batch,
+            None,
+            task_d,
+            checkpoint_service=CheckpointService("", 0, 0, False),
+            use_async=True,
+        )
+        ps_client = PSClient(
+            [BoundPS(a) for a in addrs],
+            fanout=True,
+            push_inflight=1,
+        )
+        worker = Worker(
+            worker_id=1,
+            job_type=JobType.TRAINING_ONLY,
+            minibatch_size=batch,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def=model_def,
+            model_params=model_params,
+            ps_client=ps_client,
+            **worker_kwargs,
+        )
+        worker._stub = InProcessMaster(master)
+        t0 = time.perf_counter()
+        try:
+            worker.run()
+        finally:
+            ps_client.close()
+        dt = time.perf_counter() - t0
+        if not task_d.finished():
+            raise RuntimeError("hybrid bench job did not finish")
+        return n / dt
+
+    base_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
+    arms = {
+        # the PS-everything baseline at its best known config: fan-out
+        # + async push window + SSP local updates between pulls
+        "examples_per_sec_ps": (
+            base_params + ",embedding_plane='ps'",
+            dict(get_model_steps=4),
+        ),
+        # hybrid: dense local, sparse pull prefetched behind compute,
+        # sparse-only pushes through the same async window
+        "examples_per_sec_hybrid": (
+            base_params + ",embedding_plane='hybrid'",
+            dict(embedding_plane="hybrid"),
+        ),
+    }
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        tmp = tmp_dir
+        f = create_recordio_file(
+            records, DatasetName.FRAPPE, 10, temp_dir=tmp
+        )
+        warm = create_recordio_file(
+            batch * 4, DatasetName.FRAPPE, 10, temp_dir=tmp
+        )
+        # fresh fleet per arm: each pays its own lazy table init and
+        # sees untouched versions; the warmup job pays worker jit
+        # compiles (first arm) and the fleet's lazy init (every arm)
+        for key, (model_params, worker_kwargs) in arms.items():
+            procs, addrs = launch_fleet(key[-6:])
+            try:
+                run_job(addrs, warm, batch * 4, model_params, worker_kwargs)
+                results[key] = run_job(
+                    addrs, f, records, model_params, worker_kwargs
+                )
+            finally:
+                stop_fleet(procs)
+    return results
 
 
 def bench_wire(quick=False):
@@ -2720,6 +3016,66 @@ def main(argv=None):
         )
         return 0
 
+    if "--hybrid" in argv:
+        res = bench_hybrid(quick)
+        eq = res.get("equivalence", {})
+        if not eq.get("ok"):
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_deepfm_examples_per_sec_hybrid",
+                        "error": "hybrid/PS equivalence pre-pass FAILED "
+                        "(%s): the hybrid plane is not numerically the "
+                        "same trainer; speedup withheld"
+                        % ", ".join(
+                            k for k, v in eq.items() if k != "ok" and not v
+                        ),
+                    }
+                )
+            )
+            return 1
+        ratio = res["examples_per_sec_hybrid"] / max(
+            res["examples_per_sec_ps"], 1e-9
+        )
+        if ratio < 1.3:
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_deepfm_examples_per_sec_hybrid",
+                        "error": "hybrid plane %.2fx the PS-everything "
+                        "arm (%.1f vs %.1f ex/s) — below the 1.3x gate "
+                        "on the %dms injected-RTT fleet"
+                        % (
+                            ratio,
+                            res["examples_per_sec_hybrid"],
+                            res["examples_per_sec_ps"],
+                            int(res["rtt_ms"]),
+                        ),
+                    }
+                )
+            )
+            return 1
+        _emit(
+            "ps_deepfm_examples_per_sec_hybrid",
+            round(res["examples_per_sec_hybrid"], 1),
+            "examples/sec in hybrid comm-plane mode (dense + bias "
+            "table local, PS-plane feature table served by the "
+            "overlapped pull, sparse-only async pushes) vs %.1f ex/s "
+            "with EVERYTHING on the PS fleet at its best config "
+            "(fan-out + push window + get_model_steps=4): hybrid "
+            "%.2fx (gate >=1.3x), both arms on the 2-process fleet "
+            "with %.0f ms injected per-RPC RTT; equivalence pre-pass: "
+            "bitwise-identical lookups, loss, dense and embedding-row "
+            "gradients from a common init"
+            % (
+                res["examples_per_sec_ps"],
+                ratio,
+                res["rtt_ms"],
+            ),
+            update,
+        )
+        return 0
+
     if "--wire" in argv:
         res = bench_wire(quick)
         _emit(
@@ -3058,6 +3414,7 @@ def main(argv=None):
     section("compile_cached_establish_speedup", ["--compile"], 600)
     section("wire_dense_roundtrip_speedup", ["--wire"], 300)
     section("ps_deepfm_examples_per_sec", ["--ps"], 900)
+    section("ps_deepfm_examples_per_sec_hybrid", ["--hybrid"], 900)
     # device sections, cheapest diagnosis first (each shrinks its
     # workload and renames its metric _cpu when the backend is plain
     # CPU, so the suite fits the budget without an accelerator)

@@ -414,6 +414,28 @@ def add_common_args_between_master_and_worker(parser):
         "gradient push); larger payloads ride the bytes path",
     )
     parser.add_argument(
+        "--master_shm",
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="Shared-memory payload path for the master channel's "
+        "get_model replies when the master pod is co-located on this "
+        "host (docs/wire.md): same negotiation and silent bytes-path "
+        "fallback as --ps_shm; only the reply-heavy model pull rides "
+        "slots — requests stay on the bytes path",
+    )
+    parser.add_argument(
+        "--embedding_plane",
+        default="ps",
+        choices=["ps", "hybrid"],
+        help="Comm-plane trainer mode (docs/embedding_planes.md): 'ps' "
+        "round-trips dense parameters through the PS fleet (the "
+        "classic parameter-server loop); 'hybrid' keeps dense "
+        "parameters (HBM-plane tables included) in the local/"
+        "allreduce world and uses the PS fleet only for PS-plane "
+        "embedding tables, with the per-batch pull overlapped behind "
+        "the previous batch's compute",
+    )
+    parser.add_argument(
         "--task_prefetch",
         type=non_neg_int,
         default=1,

@@ -316,6 +316,15 @@ class WireArena:
         self._on_release = on_release
         self.released = False
 
+    @property
+    def recycles(self):
+        """True when release() actually invalidates the views (a shm
+        slot arena): consumers that retain decoded tensors must
+        materialize first. False on the advisory gRPC-bytes arena,
+        where retained views stay valid — callers can keep the
+        zero-copy fast path there."""
+        return self._on_release is not None and not self.released
+
     def release(self):
         if self.released:
             return

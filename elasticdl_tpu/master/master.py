@@ -401,16 +401,21 @@ class Master:
         if self.evaluation_service:
             self.evaluation_service.start()
         from elasticdl_tpu.rpc.core import serve
+        from elasticdl_tpu.rpc.shm_transport import install_shm_endpoint
 
         port = self.args.port if self.args.port is not None else 50001
-        self._server = serve(
-            MasterRpcService(
-                self.master_servicer,
-                membership=self.membership,
-                wire_dtype=getattr(self.args, "wire_dtype", ""),
-            ).rpc_methods(),
-            port,
-        )
+        methods = MasterRpcService(
+            self.master_servicer,
+            membership=self.membership,
+            wire_dtype=getattr(self.args, "wire_dtype", ""),
+        ).rpc_methods()
+        # shared-memory reply path for co-located worker pods
+        # (docs/wire.md): workers negotiate per channel via
+        # transport_hello and route ONLY their get_model pulls through
+        # slots (MasterClient); plain requests pass through the wrap
+        # untouched, so cross-host fleets see the bytes path unchanged
+        methods, self._shm_registry = install_shm_endpoint(methods)
+        self._server = serve(methods, port)
         self.port = self._server._edl_port
         logger.info("Master RPC server started on port %d", self.port)
         telemetry_port = getattr(self.args, "telemetry_port", None)
@@ -481,6 +486,11 @@ class Master:
         if self._server:
             self._server.stop(grace=None)
             self._server = None
+        if getattr(self, "_shm_registry", None) is not None:
+            # reclaim attached worker rings — SIGKILLed clients' shm
+            # segments included (their atexit unlink never ran)
+            self._shm_registry.close()
+            self._shm_registry = None
 
 
 def main():

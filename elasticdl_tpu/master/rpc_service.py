@@ -177,13 +177,35 @@ class MasterRpcService:
 
 
 class MasterClient:
-    """Worker side: the servicer method surface over an rpc.core channel."""
+    """Worker side: the servicer method surface over an rpc.core channel.
 
-    def __init__(self, addr, wire_dtype=""):
+    ``shm`` (docs/wire.md): ``"auto"`` negotiates the co-located
+    shared-memory payload path at first model pull and routes ONLY
+    ``get_model`` through it — the master channel's one reply-heavy
+    call. Requests (gradient reports, eval metrics) stay on the bytes
+    path on purpose: the master servicer retains decoded request
+    tensors past the reply (report_variable keeps the model, sync-mode
+    report_gradient accumulates), and a recycled request slot under
+    those retentions would corrupt them — the PS servicer was audited
+    for exactly this, the master's write path deliberately was not.
+    Cross-host (or any attach failure) silently keeps the bytes path.
+    """
+
+    def __init__(self, addr, wire_dtype="", shm="off", shm_slots=4,
+                 shm_slot_mb=8):
         from elasticdl_tpu.rpc.core import Client
 
         self._client = Client(addr)
         self._wire_dtype = wire_dtype
+        self._shm = None
+        if shm in ("auto", "on"):
+            from elasticdl_tpu.rpc.shm_transport import ShmChannel
+
+            self._shm = ShmChannel(
+                self._client, n_slots=shm_slots, slot_mb=shm_slot_mb
+            )
+        elif shm not in ("off", "", None, False):
+            raise ValueError("shm must be 'auto', 'on' or 'off'")
 
     def get_task(self, worker_id, task_type=None):
         resp = self._client.call(
@@ -203,14 +225,25 @@ class MasterClient:
         )
 
     def get_model(self, version, method=GetModelMethod.MINIMUM):
+        from elasticdl_tpu.common.tensor import release_message
         from elasticdl_tpu.rpc.wire_compression import decompress_tensors
 
-        resp = self._client.call(
+        channel = self._shm if self._shm is not None else self._client
+        resp = channel.call(
             "get_model", version=int(version), method=int(method)
         )
         params = decompress_tensors(
             resp.get("params", []), resp.get("compressed_f32")
         )
+        arena = resp.get("_wire_arena")
+        if arena is not None and arena.recycles:
+            # AUDITED retention site (docs/wire.md): the worker keeps
+            # these params across steps, and a recycling arena (shm
+            # slot) invalidates its views on release — materialize,
+            # then hand the slot back. The gRPC-bytes arena skips this:
+            # its views stay valid, keeping the zero-copy pull.
+            params = [t.materialize() for t in params]
+            release_message(resp)
         return resp["version"], {t.name: t.values for t in params}
 
     def report_variable(self, named_arrays):
@@ -297,4 +330,6 @@ class MasterClient:
         ]
 
     def close(self):
+        if self._shm is not None:
+            self._shm.close()
         self._client.close()

@@ -1,0 +1,619 @@
+"""One comm-plane interface over the two embedding planes.
+
+Production recsys at the ROADMAP's millions-of-users scale runs BOTH
+sparse storage planes in the same job: dense layers (and HBM-resident
+tables) synced on-device, while the multi-hundred-GB tables stay
+sharded on the host-PS fleet ("Elastic Model Aggregation with Parameter
+Service", PAPERS.md 2204.03211). Historically ``nn/embedding.py`` (the
+host-PS plane) and ``nn/hbm_embedding.py`` (the in-mesh a2a plane) were
+separate per-zoo code paths selected wholesale; this module gives them
+ONE interface so a single model mixes planes per table
+(docs/embedding_planes.md):
+
+    plan_lookup -> pull -> scatter -> push
+
+- ``plan_lookup_multi`` is the PR-1 dedup planner, now canonical here:
+  the host-side batch-wide unique plan for the PS plane, and the
+  declared twin of the in-graph :func:`~elasticdl_tpu.nn.sparse_comms.
+  padded_unique` plan the HBM plane runs under jit.
+- ``pull`` fetches the planned unique rows (a no-op for in-graph
+  planes, whose "pull" is the a2a collective inside the jitted step).
+- ``scatter`` pads pulled rows to the plan's static bucket so the
+  jitted step's shapes stay stable across batches.
+- ``push`` ships the combined per-unique-row gradients back; for the
+  PS plane it rides the PR-2 non-blocking push window, whose
+  :meth:`~CommPlane.drain` the worker calls at every SSP boundary in
+  BOTH trainer modes (task/eval/checkpoint), so the staleness bound is
+  plane-shared.
+
+The PR-1 :class:`HotRowCache` also lives here now — one version-tagged
+cache instance can back the PS plane's pulls and (ROADMAP item 3) a
+serving plane's read-through lookups, whatever plane a table rides.
+
+Per-table selection (``plane=``): :func:`make_embedding` builds the
+layer for one table from its plane name, and
+:func:`resolve_table_planes` parses the zoo-facing
+``embedding_plane=ps|hbm|hybrid|"table:plane/table:plane"`` spec.
+
+The hybrid trainer mode itself lives in worker/worker.py
+(``embedding_plane="hybrid"``): dense params and HBM tables stay in the
+local/allreduce world (no PS round trip for dense), PS-resident tables
+are served by :class:`EmbeddingPullPipeline` — the pull for batch N+1
+fans out on a background thread while batch N's jitted step runs.
+"""
+
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+PLANES = ("ps", "hbm")
+
+
+# ---------------------------------------------------------------------------
+# the dedup planner (PR-1, canonical home; nn/embedding.py re-exports)
+# ---------------------------------------------------------------------------
+
+
+def plan_lookup(ids, bucket_min=8):
+    """unique ids + per-element positions, padded to a pow2 bucket.
+
+    Returns (unique_ids (k,), idx ids.shape int32, bucket_size).
+    Static bucket sizes keep the jitted step's shapes stable across
+    batches with different unique-id counts.
+    """
+    unique, (idx,), bucket = plan_lookup_multi([ids], bucket_min)
+    return unique, idx, bucket
+
+
+def plan_lookup_multi(ids_list, bucket_min=8, dedup=True):
+    """Union lookup plan over every call of one layer per forward.
+
+    Returns (unique_ids (k,), [idx per call], bucket_size): one shared
+    rows pull covers all calls (a tied embedding reads the same table),
+    each call keeping its own position array into that buffer.
+
+    This host-side batch-wide dedup is the PS plane's half of the
+    sparse-comms fast path (nn/sparse_comms.py): only unique rows are
+    pulled, and since every occurrence gathers from its unique slot, the
+    step's row gradients come back ALREADY combined (the take VJP
+    scatter-adds over the plan's positions) — one row per unique id in
+    both wire directions. ``dedup=False`` builds the naive
+    per-occurrence plan (every id keeps its own slot; duplicates pull
+    and push duplicate rows) — the pre-fast-path wire behavior, kept
+    for benchmarking and equivalence tests.
+    """
+    arrays = [np.asarray(ids) for ids in ids_list]
+    flat = np.concatenate(
+        [a.reshape(-1).astype(np.int64) for a in arrays]
+    )
+    if dedup:
+        unique, inverse = np.unique(flat, return_inverse=True)
+    else:
+        unique = flat
+        inverse = np.arange(flat.size, dtype=np.int64)
+    k = len(unique)
+    bucket = bucket_min
+    while bucket < k:
+        bucket *= 2
+    idxs, off = [], 0
+    for a in arrays:
+        n = a.size
+        idxs.append(
+            inverse[off : off + n].reshape(a.shape).astype(np.int32)
+        )
+        off += n
+    return unique, idxs, bucket
+
+
+def pad_rows_to_bucket(rows, bucket):
+    """Pad pulled (k, dim) rows with zeros to the plan's static bucket.
+
+    The shared ``scatter`` step of the host-side planes: the jitted
+    step gathers from a pow2-sized buffer, so its compiled shapes are
+    stable across batches with different unique-id counts."""
+    rows = np.asarray(rows, dtype=np.float32)
+    if rows.shape[0] >= bucket:
+        return rows
+    return np.concatenate(
+        [
+            rows,
+            np.zeros((bucket - rows.shape[0], rows.shape[1]), np.float32),
+        ]
+    )
+
+
+# ---------------------------------------------------------------------------
+# the version-tagged hot-row cache (PR-1, canonical home;
+# worker/ps_client.py re-exports)
+# ---------------------------------------------------------------------------
+
+
+class HotRowCache:
+    """Worker-side LRU of recently pulled embedding rows, with
+    version-tagged invalidation.
+
+    Power-law id distributions re-pull the same head rows every batch;
+    this cache serves those repeats locally instead of over gRPC. Every
+    entry is tagged with the owning PS shard's model version at pull
+    time; the client notes the newest version it has SEEN per shard
+    (from pull AND push responses — the same version counter
+    ps/servicer.py's staleness machinery modulates the LR by), and an
+    entry older than ``window`` versions behind that is a miss. The
+    served rows are therefore stale by at most ``window`` optimizer
+    steps of that shard — the same bounded-staleness contract SSP local
+    updates already run under (``get_model_steps``, with the async LR
+    discounted by 1/staleness via master/learning_rate_modulator.py) —
+    so the cache never adds a staleness mode the training loop doesn't
+    already tolerate.
+
+    Plane-shared since the comm-plane refactor: the cache is keyed by
+    (table, id) with the plane-neutral shard/version tag, so one
+    instance can back every PS-resident table of a hybrid model and,
+    later, a serving worker's read-through lookups (ROADMAP item 3).
+
+    Thread-safe: with the overlapped data plane, push completions note
+    versions from the fan-out/push threads while the worker thread
+    probes and fills, so every mutation runs under one internal lock.
+    """
+
+    def __init__(self, max_rows, window=1):
+        if max_rows <= 0:
+            raise ValueError("max_rows must be positive")
+        if window < 0:
+            raise ValueError("window must be >= 0")
+        self._max_rows = max_rows
+        self._window = window
+        self._mu = threading.Lock()
+        self._rows = OrderedDict()  # (name, id) -> (shard, version, row)
+        self._latest = {}  # shard -> newest version seen in any response
+        self.hits = 0
+        self.misses = 0
+
+    def note_version(self, shard, version):
+        """Record a version observed in shard ``shard``'s response."""
+        if version is None or version < 0:
+            return
+        with self._mu:
+            if version > self._latest.get(shard, -1):
+                self._latest[shard] = version
+
+    def get(self, name, row_id):
+        """The cached row, or None on miss/stale (stale entries drop)."""
+        with self._mu:
+            return self._get_locked(name, row_id)
+
+    def get_rows(self, name, row_ids):
+        """Probe one batch under a single lock acquisition; one entry
+        per id, None on miss (the read-side twin of put_rows)."""
+        with self._mu:
+            return [self._get_locked(name, r) for r in row_ids]
+
+    def _get_locked(self, name, row_id):
+        key = (name, int(row_id))
+        entry = self._rows.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        shard, version, row = entry
+        if version < self._latest.get(shard, -1) - self._window:
+            del self._rows[key]
+            self.misses += 1
+            return None
+        self._rows.move_to_end(key)
+        self.hits += 1
+        return row
+
+    def put(self, name, row_id, shard, version, row):
+        if version is None:
+            return  # unversioned response: nothing safe to tag with
+        with self._mu:
+            self._put_locked(name, row_id, shard, version, row)
+
+    def put_rows(self, name, row_ids, shard, version, rows):
+        """Insert one pulled batch under a single lock acquisition."""
+        if version is None:
+            return
+        with self._mu:
+            for row_id, row in zip(row_ids, rows):
+                self._put_locked(name, row_id, shard, version, row)
+
+    def _put_locked(self, name, row_id, shard, version, row):
+        key = (name, int(row_id))
+        # copy: ``row`` is usually a view into the pull's full response
+        # array, and storing the view would pin that whole buffer for
+        # as long as any one of its rows stays hot
+        self._rows[key] = (shard, version, np.array(row, np.float32))
+        self._rows.move_to_end(key)
+        while len(self._rows) > self._max_rows:
+            self._rows.popitem(last=False)
+
+    def __len__(self):
+        with self._mu:
+            return len(self._rows)
+
+
+# ---------------------------------------------------------------------------
+# the plane interface
+# ---------------------------------------------------------------------------
+
+
+class CommPlane:
+    """Abstract comm plane for one (or more) embedding tables.
+
+    ``in_graph`` planes perform their lookup INSIDE the jitted step
+    (the HBM a2a plane); host planes pull rows over a data-plane
+    channel before the step and push combined row gradients after it.
+    """
+
+    name = "abstract"
+    in_graph = False
+
+    def plan_lookup_multi(self, ids_list, bucket_min=8, dedup=True):
+        """The shared dedup planner (see module-level twin)."""
+        return plan_lookup_multi(ids_list, bucket_min=bucket_min, dedup=dedup)
+
+    def pull(self, ids_by_table):
+        """{table_name: unique_ids} -> {table_name: rows} in ONE
+        logical round (implementations fan shard legs out)."""
+        raise NotImplementedError
+
+    def scatter(self, rows, bucket):
+        """Pulled rows -> the static-shape buffer the step gathers from."""
+        return pad_rows_to_bucket(rows, bucket)
+
+    def push(self, sparse_tensors, version):
+        """Ship combined row gradients; returns (accepted, version)."""
+        raise NotImplementedError
+
+    def drain(self):
+        """Settle any in-flight async pushes (SSP-boundary hook).
+
+        Returns (accepted, version) like the PS push window; planes
+        with no window return (True, -1)."""
+        return True, -1
+
+    @property
+    def cache(self):
+        """The shared :class:`HotRowCache`, or None."""
+        return None
+
+    def close(self):
+        """Release plane resources (threads, channels)."""
+
+
+class PsPlane(CommPlane):
+    """The sharded host-PS plane over a ``worker.ps_client.PSClient``.
+
+    pull rides the PR-2 concurrent (tables x shards) fan-out with the
+    PR-1 hot-row cache in front; push rides the non-blocking push
+    window (sparse-only — in hybrid mode dense gradients never touch
+    the PS), and :meth:`drain` settles it at SSP boundaries.
+    """
+
+    name = "ps"
+
+    def __init__(self, ps_client):
+        self._client = ps_client
+
+    @property
+    def client(self):
+        return self._client
+
+    @property
+    def cache(self):
+        return getattr(self._client, "hot_row_cache", None)
+
+    def pull(self, ids_by_table):
+        return self._client.pull_embedding_vectors_multi(ids_by_table)
+
+    def push(self, sparse_tensors, version):
+        # dense side empty by contract: the hybrid trainer keeps dense
+        # parameters out of the PS round trip entirely
+        return self._client.push_gradient({}, sparse_tensors, version)
+
+    def drain(self):
+        if hasattr(self._client, "drain"):
+            return self._client.drain()
+        return True, -1
+
+    def close(self):
+        if hasattr(self._client, "close"):
+            self._client.close()
+
+
+class MasterStorePlane(CommPlane):
+    """The master-KV store plane (the reference's non-PS deployment).
+
+    The master holds one process-local store, so pulls are per-table
+    RPCs on the blocking control channel and sparse pushes travel WITH
+    the dense gradients in ``report_gradient`` (the worker owns that
+    combined push; :meth:`push` is therefore unsupported here).
+    ``stub_fn`` resolves the master stub at call time — workers may be
+    handed their stub after construction (tests, the in-process rung).
+    """
+
+    name = "ps"  # same host-pull semantics; storage differs
+
+    def __init__(self, stub_fn):
+        self._stub_fn = stub_fn
+
+    def pull(self, ids_by_table):
+        stub = self._stub_fn()
+        return {
+            name: np.asarray(
+                stub.pull_embedding_vectors(name, ids), dtype=np.float32
+            )
+            for name, ids in ids_by_table.items()
+        }
+
+    def push(self, sparse_tensors, version):
+        raise NotImplementedError(
+            "master-store sparse gradients ride report_gradient with "
+            "the dense tensors; push() has no separate wire path here"
+        )
+
+
+class HbmPlane(CommPlane):
+    """The in-mesh HBM plane: the table is a sharded model parameter
+    and the lookup/update run INSIDE the jitted step (nn/hbm_embedding:
+    a2a row routing with the in-graph ``padded_unique`` dedup — the
+    jit-side twin of this interface's host planner). ``pull``/``push``
+    therefore never execute: the plane exists so hybrid planners can
+    treat every table uniformly, and so a shared cache can front HBM
+    tables on serving workers later (ROADMAP item 3)."""
+
+    name = "hbm"
+    in_graph = True
+
+    def __init__(self, shared_cache=None):
+        self._cache = shared_cache
+
+    @property
+    def cache(self):
+        return self._cache
+
+    def pull(self, ids_by_table):
+        raise RuntimeError(
+            "hbm tables are looked up inside the jitted step (a2a "
+            "collective); there is no host-side pull to perform"
+        )
+
+    def push(self, sparse_tensors, version):
+        raise RuntimeError(
+            "hbm table gradients apply inside the jitted step; there "
+            "is no host-side push to perform"
+        )
+
+
+# ---------------------------------------------------------------------------
+# per-table plane selection
+# ---------------------------------------------------------------------------
+
+
+def resolve_table_planes(spec, tables, hybrid_default=None):
+    """Parse an ``embedding_plane`` spec into {table_name: plane}.
+
+    Accepted forms:
+
+    - ``"ps"`` / ``"hbm"``: every table on that plane.
+    - ``"hybrid"``: per-table via ``hybrid_default`` (the zoo's
+      declared split — typically huge tables on ``ps``, small ones in
+      the dense/HBM world).
+    - ``"table:plane/table:plane"`` explicit per-table entries
+      (``/``-separated because ``,`` already delimits model_params);
+      unlisted tables get ``ps``.
+    """
+    tables = list(tables)
+    if spec in PLANES:
+        return {t: spec for t in tables}
+    if spec == "hybrid":
+        if not hybrid_default:
+            raise ValueError(
+                "embedding_plane='hybrid' needs the model to declare a "
+                "per-table split (hybrid_default)"
+            )
+        missing = [t for t in tables if t not in hybrid_default]
+        if missing:
+            raise ValueError(
+                "hybrid plane split missing tables %r" % (missing,)
+            )
+        return {t: hybrid_default[t] for t in tables}
+    out = {t: "ps" for t in tables}
+    for entry in str(spec).split("/"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        table, sep, plane = entry.partition(":")
+        if not sep or plane not in PLANES:
+            raise ValueError(
+                "bad embedding_plane entry %r (want 'table:ps' or "
+                "'table:hbm', '/'-separated; or one of %s, 'hybrid')"
+                % (entry, "/".join(PLANES))
+            )
+        if table not in out:
+            raise ValueError(
+                "embedding_plane names unknown table %r (model tables: %r)"
+                % (table, tables)
+            )
+        out[table] = plane
+    return out
+
+
+def make_embedding(
+    plane,
+    output_dim,
+    name,
+    vocab_size=None,
+    mesh=None,
+    axis="data",
+    mask_zero=False,
+    combiner=None,
+    collective=False,
+    embedding_initializer="uniform",
+    **hbm_kwargs,
+):
+    """Build one table's embedding layer from its plane name.
+
+    ``"ps"`` -> the elastic :class:`~elasticdl_tpu.nn.embedding.
+    Embedding` (unbounded vocab, rows pulled per batch, sparse grads
+    pushed); ``"hbm"`` -> :class:`~elasticdl_tpu.nn.hbm_embedding.
+    HbmEmbedding` (the table is a trainable parameter — vocab-sharded
+    over ``mesh[axis]`` when a mesh is given, a plain dense parameter
+    in the degenerate mesh=None form, which is exactly how a small
+    table lives in the hybrid trainer's dense/allreduce world).
+    """
+    if plane == "ps":
+        from elasticdl_tpu.nn.embedding import Embedding
+
+        return Embedding(
+            output_dim=output_dim,
+            embedding_initializer=embedding_initializer,
+            mask_zero=mask_zero,
+            combiner=combiner,
+            name=name,
+        )
+    if plane == "hbm":
+        from elasticdl_tpu.nn.hbm_embedding import HbmEmbedding
+
+        if vocab_size is None:
+            raise ValueError(
+                "hbm-plane table %r needs a declared vocab_size (the "
+                "table is a real parameter)" % name
+            )
+        return HbmEmbedding(
+            vocab_size=vocab_size,
+            features=output_dim,
+            mesh=mesh,
+            axis=axis,
+            mask_zero=mask_zero,
+            collective=collective,
+            name=name,
+            **hbm_kwargs,
+        )
+    raise ValueError(
+        "unknown embedding plane %r (want one of %s)"
+        % (plane, "/".join(PLANES))
+    )
+
+
+# ---------------------------------------------------------------------------
+# the overlapped pull (hybrid trainer mode)
+# ---------------------------------------------------------------------------
+
+
+class EmbeddingPullPipeline:
+    """One-batch-lookahead fan-out for PS-resident embedding pulls.
+
+    The worker plans batch N+1's lookups on ITS OWN thread (the flax
+    id-capture interceptor is not thread-safe to run concurrently with
+    a forward) and hands only the PULL — the RTT-heavy
+    ``pull_embedding_vectors_multi`` fan-out — to this pipeline's one
+    background thread, so the round trip overlaps batch N's jitted
+    forward/backward. Concurrency with the worker thread is limited to
+    the PSClient surfaces already built for it: the fan-out pool and
+    the lock-protected hot-row cache (docs/dense_overlap.md).
+
+    Staleness: a prefetched pull misses at most the worker's OWN
+    push for the in-flight batch — one optimizer step of staleness,
+    inside the SSP window the hot-row cache and async LR modulation
+    already price in (docs/embedding_planes.md).
+
+    Abandonment contract (the round-abandonment race pin): entries are
+    keyed by batch object identity, and :meth:`invalidate` drops every
+    pending entry EXACTLY ONCE — it waits for the in-flight pull to
+    finish (so no RPC is left mutating the cache after the caller moves
+    on) and discards the result. A requeued task's prefetched pull is
+    therefore dropped once and never served to a later batch; a second
+    invalidate (or a consume after invalidate) finds nothing.
+    """
+
+    def __init__(self, depth=2):
+        self._mu = threading.Lock()
+        self._pool = None
+        self._depth = max(1, int(depth))
+        self._entries = OrderedDict()  # id(batch) -> (batch, plan, future)
+        self._closed = False
+        self.dropped = 0  # pulls discarded by invalidate()
+        self.served = 0  # pulls consumed by the batch they were for
+
+    def _get_pool(self):
+        with self._mu:
+            if self._closed:
+                raise RuntimeError("EmbeddingPullPipeline is closed")
+            if self._pool is None:
+                # one thread: pulls dispatch in order, and the inner
+                # fan-out pool (PSClient) supplies the per-shard
+                # concurrency — a second driver would only reorder
+                # cache fills
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="edl-emb-pull"
+                )
+            return self._pool
+
+    def submit(self, key_obj, plan, pull_fn):
+        """Stage ``pull_fn()`` for the batch identified by ``key_obj``.
+
+        ``plan`` rides alongside so the consumer gets back exactly the
+        lookups the pull was planned from. Oldest entries beyond the
+        lookahead depth are dropped (they can only belong to batches
+        the consumer already passed)."""
+        pool = self._get_pool()
+        fut = pool.submit(pull_fn)
+        with self._mu:
+            self._entries[id(key_obj)] = (key_obj, plan, fut)
+            evicted = []
+            while len(self._entries) > self._depth:
+                evicted.append(self._entries.popitem(last=False))
+        for _key, (_, _, old) in evicted:
+            self._drop(old)
+
+    def consume(self, key_obj):
+        """(plan, pulled_rows) staged for this batch, or None.
+
+        Blocks on the in-flight pull when it has not landed yet — that
+        wait is the tail of the overlapped round trip."""
+        with self._mu:
+            entry = self._entries.pop(id(key_obj), None)
+        if entry is None:
+            return None
+        _, plan, fut = entry
+        result = fut.result()
+        self.served += 1
+        return plan, result
+
+    def invalidate(self):
+        """Drop every pending prefetched pull; returns how many.
+
+        Waits each future out (a discarded pull must not keep touching
+        the shared cache after the caller has moved on) and swallows
+        its errors — an abandoned batch's failed pull is nobody's
+        problem."""
+        with self._mu:
+            entries, self._entries = list(self._entries.values()), (
+                OrderedDict()
+            )
+        for _, _, fut in entries:
+            self._drop(fut)
+        return len(entries)
+
+    def _drop(self, fut):
+        try:
+            fut.result()
+        except Exception:  # noqa: BLE001 — abandoned pull, outcome moot
+            from elasticdl_tpu.common.log_utils import default_logger
+
+            default_logger.debug(
+                "abandoned prefetched embedding pull failed; dropped",
+                exc_info=True,
+            )
+        self.dropped += 1
+
+    def close(self):
+        self.invalidate()
+        with self._mu:
+            self._closed = True
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)

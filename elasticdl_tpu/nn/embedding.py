@@ -205,63 +205,35 @@ def capture_embedding_ids(
             return jnp.zeros(out_shape, jnp.float32)
         return next_fun(*args, **kwargs)
 
+    import jax
+
+    # the early abort raises _CaptureDone THROUGH module.apply, and
+    # jax's traceback filtering stats every frame's file against its
+    # package dirs on the way out — ~110 ms of posix.stat per capture,
+    # ~60x the actual forward (measured; it dominated the whole PS
+    # hot path). Filtering off for the apply makes the abort a plain
+    # raise. Process-global config, toggled only around this host-side
+    # eager pass: a concurrent thread erroring in this window would
+    # merely see an unfiltered traceback.
+    prev = jax.config.jax_traceback_filtering
+    jax.config.update("jax_traceback_filtering", "off")
     try:
         with nn.intercept_methods(interceptor):
             module.apply(variables, features, training=False)
     except _CaptureDone:
         pass
+    finally:
+        jax.config.update("jax_traceback_filtering", prev)
     return captured
 
 
-def plan_lookup(ids, bucket_min=8):
-    """unique ids + per-element positions, padded to a pow2 bucket.
-
-    Returns (unique_ids (k,), idx ids.shape int32, bucket_size).
-    Static bucket sizes keep the jitted step's shapes stable across
-    batches with different unique-id counts.
-    """
-    unique, (idx,), bucket = plan_lookup_multi([ids], bucket_min)
-    return unique, idx, bucket
-
-
-def plan_lookup_multi(ids_list, bucket_min=8, dedup=True):
-    """Union lookup plan over every call of one layer per forward.
-
-    Returns (unique_ids (k,), [idx per call], bucket_size): one shared
-    rows pull covers all calls (a tied embedding reads the same table),
-    each call keeping its own position array into that buffer.
-
-    This host-side batch-wide dedup is the PS plane's half of the
-    sparse-comms fast path (nn/sparse_comms.py): only unique rows are
-    pulled, and since every occurrence gathers from its unique slot, the
-    step's row gradients come back ALREADY combined (the take VJP
-    scatter-adds over the plan's positions) — one row per unique id in
-    both wire directions. ``dedup=False`` builds the naive
-    per-occurrence plan (every id keeps its own slot; duplicates pull
-    and push duplicate rows) — the pre-fast-path wire behavior, kept
-    for benchmarking and equivalence tests.
-    """
-    arrays = [np.asarray(ids) for ids in ids_list]
-    flat = np.concatenate(
-        [a.reshape(-1).astype(np.int64) for a in arrays]
-    )
-    if dedup:
-        unique, inverse = np.unique(flat, return_inverse=True)
-    else:
-        unique = flat
-        inverse = np.arange(flat.size, dtype=np.int64)
-    k = len(unique)
-    bucket = bucket_min
-    while bucket < k:
-        bucket *= 2
-    idxs, off = [], 0
-    for a in arrays:
-        n = a.size
-        idxs.append(
-            inverse[off : off + n].reshape(a.shape).astype(np.int32)
-        )
-        off += n
-    return unique, idxs, bucket
+# The batch-wide dedup planner moved behind the comm-plane interface
+# (nn/comm_plane.py) so both embedding planes share it; these names stay
+# importable here for the historical call sites.
+from elasticdl_tpu.nn.comm_plane import (  # noqa: E402,F401
+    plan_lookup,
+    plan_lookup_multi,
+)
 
 
 def path_name(path):

@@ -17,6 +17,14 @@ tables live in pod HBM with ICI collectives for id lookup/update"):
 
 The host-PS mode (nn/embedding.py + ps/) remains for CPU-RAM-sized tables
 and async training; both share checkpoint naming via the params pytree.
+Both planes implement the comm-plane interface (nn/comm_plane.py,
+docs/embedding_planes.md) — this one as the ``in_graph`` plane, whose
+"pull" is the a2a collective itself and whose dedup planner is the
+jit-side :func:`~elasticdl_tpu.nn.sparse_comms.padded_unique` twin of
+the host planner — so one model may mix planes per table
+(``comm_plane.make_embedding``), e.g. a hybrid deepfm with its huge
+feature table on the PS fleet and this layer's small tables living as
+ordinary dense-world parameters.
 """
 
 import flax.linen as nn

@@ -89,15 +89,21 @@ def ring_attention(q, k, v, axis_name, causal=False):
     q, k, v: (B, L_local, H, D). Returns (B, L_local, H, D).
     """
     n = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    # axis_index only under causal: a dead axis_index lowers to a
+    # partition_id instruction with no data dependence on the manual
+    # region's operands, which XLA hoists out of it — and the SPMD
+    # partitioner rejects PartitionId outside manual sharding
+    # ("PartitionId instruction is not supported for SPMD
+    # partitioning"). The non-causal ring needs no rank at all.
+    my_idx = jax.lax.axis_index(axis_name) if causal else None
     l_local = q.shape[1]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(step, carry):
         o, m, l, kk, vv = carry
-        # the K/V block now held came from device (my_idx - step) % n
-        src = (my_idx - step) % n
         if causal:
+            # the K/V block now held came from device (my_idx - step) % n
+            src = (my_idx - step) % n
             bias = _causal_bias(
                 my_idx * l_local,
                 src * l_local,
@@ -193,7 +199,9 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, block_q, block_k):
     from elasticdl_tpu.ops.flash_attention import flash_attention_with_lse
 
     n = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    # rank only under causal — see ring_attention: a dead axis_index
+    # becomes a hoisted PartitionId the SPMD partitioner rejects
+    my_idx = jax.lax.axis_index(axis_name) if causal else None
     b, l_local, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -205,7 +213,7 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, block_q, block_k):
 
     def body(step, carry):
         o, lse, kk, vv = carry
-        src = (my_idx - step) % n
+        src = (my_idx - step) % n if causal else None
         o_b, lse_b = _block_cases(
             src,
             my_idx,
@@ -242,7 +250,9 @@ def _ring_flash_bwd_rule(
 
     q, k, v, out, lse = residuals
     n = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    # rank only under causal — see ring_attention: a dead axis_index
+    # becomes a hoisted PartitionId the SPMD partitioner rejects
+    my_idx = jax.lax.axis_index(axis_name) if causal else None
     perm = [(i, (i + 1) % n) for i in range(n)]
     interpret = _use_interpret()
 
@@ -262,7 +272,7 @@ def _ring_flash_bwd_rule(
 
     def body(step, carry):
         dq, dkk, dvv, kk, vv = carry
-        src = (my_idx - step) % n
+        src = (my_idx - step) % n if causal else None
         dq_b, dk_b, dv_b = _block_cases(
             src,
             my_idx,

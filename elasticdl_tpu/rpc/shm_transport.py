@@ -333,7 +333,10 @@ class ShmChannel:
             **fields
         )
 
-    def call(self, method, **fields):
+    def call(self, method, /, **fields):
+        # positional-only: a wire field may itself be named "method"
+        # (get_model's GetModelMethod selector) and must land in
+        # ``fields``, not collide with the RPC name
         from elasticdl_tpu.rpc.core import (
             pack_message_into,
             plan_message,

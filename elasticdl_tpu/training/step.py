@@ -295,6 +295,24 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+def make_local_update_fn(optimizer):
+    """Jitted ``(grads, opt_state, params) -> (params, opt_state)``.
+
+    The dense half of the hybrid comm plane (docs/embedding_planes.md)
+    and the engine of SSP local updates: the worker advances its own
+    replica with its own optimizer instance between (or instead of)
+    model pulls. Jitted because it runs per accepted minibatch on the
+    hot path — the eager optax tree walk costs a dispatch per leaf,
+    which the hybrid trainer pays every step.
+    """
+
+    def update(grads, opt_state, params):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    return jax.jit(update)
+
+
 def make_embedding_grad_fn(module, loss_fn, precision=None):
     """Jitted grad step for models with elastic embedding layers.
 

@@ -151,6 +151,40 @@ class ElasticAllReduceWorker:
         )
 
         extra = get_dict_from_params_str(model_params) or {}
+        # per-table plane guard (docs/embedding_planes.md): a PS-plane
+        # table has no parameter — its rows live on the PS fleet and
+        # are pulled per batch, which the collective lockstep step
+        # cannot do. Fail HERE with the pointer, not deep inside
+        # establish after the world already formed (where it would
+        # crash-loop under relaunch). Resolved through the same
+        # selector the zoo uses (an explicit per-table spec defaults
+        # UNLISTED tables to ps, so string-sniffing the spec would
+        # miss e.g. "embedding:hbm"); zoos that don't declare TABLES
+        # get the conservative reading: only an all-tables "hbm" spec
+        # is provably collective-servable.
+        plane_spec = str(extra.get("embedding_plane", "") or "")
+        if plane_spec:
+            from elasticdl_tpu.nn.comm_plane import resolve_table_planes
+
+            tables = zoo_module.get("TABLES")
+            if tables:
+                planes = resolve_table_planes(
+                    plane_spec,
+                    tables,
+                    hybrid_default=zoo_module.get("HYBRID_SPLIT"),
+                )
+                has_ps_tables = "ps" in planes.values()
+            else:
+                has_ps_tables = plane_spec != "hbm"
+            if has_ps_tables:
+                raise NotImplementedError(
+                    "model config embedding_plane=%r places tables on "
+                    "the PS plane, which the elastic allreduce worker "
+                    "cannot serve; run PS-resident tables on the "
+                    "parameter-server worker (--embedding_plane=hybrid "
+                    "keeps dense local while the PS fleet serves the "
+                    "sparse tables)" % plane_spec
+                )
         wants_sharded = self._zoo_wants_sharded_params(
             zoo_module, model_params
         )

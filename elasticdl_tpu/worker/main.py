@@ -36,7 +36,14 @@ def main():
 def _run(args):
     wire_dtype = getattr(args, "wire_dtype", "")
     stub = (
-        MasterClient(args.master_addr, wire_dtype=wire_dtype)
+        MasterClient(
+            args.master_addr,
+            wire_dtype=wire_dtype,
+            # co-located master pods serve get_model replies through a
+            # negotiated shm ring; cross-host (or any attach failure)
+            # silently keeps the bytes path (docs/wire.md)
+            shm=getattr(args, "master_shm", "auto"),
+        )
         if args.master_addr
         else None
     )
@@ -232,6 +239,7 @@ def _run(args):
         telemetry_report_secs=getattr(
             args, "telemetry_report_secs", 5.0
         ),
+        embedding_plane=getattr(args, "embedding_plane", "ps"),
     )
     try:
         worker.run()
@@ -244,6 +252,9 @@ def _run(args):
             # unlink negotiated shm rings + close the channels (the
             # atexit hook is only the crash floor)
             bound.close()
+        if stub is not None:
+            # same discipline for the master channel's negotiated ring
+            stub.close()
     return 0
 
 

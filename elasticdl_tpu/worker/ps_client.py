@@ -29,103 +29,10 @@ from elasticdl_tpu.common.hash_utils import (
 from elasticdl_tpu.common.tensor import Tensor, release_message
 
 
-class HotRowCache:
-    """Worker-side LRU of recently pulled embedding rows, with
-    version-tagged invalidation.
-
-    Power-law id distributions re-pull the same head rows every batch;
-    this cache serves those repeats locally instead of over gRPC. Every
-    entry is tagged with the owning PS shard's model version at pull
-    time; the client notes the newest version it has SEEN per shard
-    (from pull AND push responses — the same version counter
-    ps/servicer.py's staleness machinery modulates the LR by), and an
-    entry older than ``window`` versions behind that is a miss. The
-    served rows are therefore stale by at most ``window`` optimizer
-    steps of that shard — the same bounded-staleness contract SSP local
-    updates already run under (``get_model_steps``, with the async LR
-    discounted by 1/staleness via master/learning_rate_modulator.py) —
-    so the cache never adds a staleness mode the training loop doesn't
-    already tolerate.
-
-    Thread-safe: with the overlapped data plane, push completions note
-    versions from the fan-out/push threads while the worker thread
-    probes and fills, so every mutation runs under one internal lock.
-    """
-
-    def __init__(self, max_rows, window=1):
-        if max_rows <= 0:
-            raise ValueError("max_rows must be positive")
-        if window < 0:
-            raise ValueError("window must be >= 0")
-        self._max_rows = max_rows
-        self._window = window
-        self._mu = threading.Lock()
-        self._rows = OrderedDict()  # (name, id) -> (shard, version, row)
-        self._latest = {}  # shard -> newest version seen in any response
-        self.hits = 0
-        self.misses = 0
-
-    def note_version(self, shard, version):
-        """Record a version observed in shard ``shard``'s response."""
-        if version is None or version < 0:
-            return
-        with self._mu:
-            if version > self._latest.get(shard, -1):
-                self._latest[shard] = version
-
-    def get(self, name, row_id):
-        """The cached row, or None on miss/stale (stale entries drop)."""
-        with self._mu:
-            return self._get_locked(name, row_id)
-
-    def get_rows(self, name, row_ids):
-        """Probe one batch under a single lock acquisition; one entry
-        per id, None on miss (the read-side twin of put_rows)."""
-        with self._mu:
-            return [self._get_locked(name, r) for r in row_ids]
-
-    def _get_locked(self, name, row_id):
-        key = (name, int(row_id))
-        entry = self._rows.get(key)
-        if entry is None:
-            self.misses += 1
-            return None
-        shard, version, row = entry
-        if version < self._latest.get(shard, -1) - self._window:
-            del self._rows[key]
-            self.misses += 1
-            return None
-        self._rows.move_to_end(key)
-        self.hits += 1
-        return row
-
-    def put(self, name, row_id, shard, version, row):
-        if version is None:
-            return  # unversioned response: nothing safe to tag with
-        with self._mu:
-            self._put_locked(name, row_id, shard, version, row)
-
-    def put_rows(self, name, row_ids, shard, version, rows):
-        """Insert one pulled batch under a single lock acquisition."""
-        if version is None:
-            return
-        with self._mu:
-            for row_id, row in zip(row_ids, rows):
-                self._put_locked(name, row_id, shard, version, row)
-
-    def _put_locked(self, name, row_id, shard, version, row):
-        key = (name, int(row_id))
-        # copy: ``row`` is usually a view into the pull's full response
-        # array, and storing the view would pin that whole buffer for
-        # as long as any one of its rows stays hot
-        self._rows[key] = (shard, version, np.array(row, np.float32))
-        self._rows.move_to_end(key)
-        while len(self._rows) > self._max_rows:
-            self._rows.popitem(last=False)
-
-    def __len__(self):
-        with self._mu:
-            return len(self._rows)
+# HotRowCache moved behind the comm-plane interface (nn/comm_plane.py)
+# so one version-tagged cache instance can serve every plane a table
+# rides; imported here for the historical call sites.
+from elasticdl_tpu.nn.comm_plane import HotRowCache  # noqa: E402,F401
 
 
 class PSClient:
@@ -138,6 +45,7 @@ class PSClient:
         staleness_window=1,
         fanout=True,
         push_inflight=0,
+        cache=None,
     ):
         """``ps_stubs``: list of objects exposing the Pserver dict-RPC
         methods — rpc.core Clients bound with ``BoundPS`` below, or
@@ -164,7 +72,12 @@ class PSClient:
         self._ps = ps_stubs
         self._wire_dtype = wire_dtype
         self._combine_push = combine_push
-        self._cache = (
+        # ``cache``: an externally-owned (plane-shared) HotRowCache —
+        # the comm-plane refactor lets one version-tagged cache back
+        # every PS-resident table, whichever client pulls them
+        # (docs/embedding_planes.md); hot_row_cache_rows > 0 keeps the
+        # historical per-client construction.
+        self._cache = cache if cache is not None else (
             HotRowCache(hot_row_cache_rows, staleness_window)
             if hot_row_cache_rows > 0
             else None

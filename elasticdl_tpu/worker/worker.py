@@ -24,7 +24,6 @@ import traceback
 
 import jax
 import numpy as np
-import optax
 
 from elasticdl_tpu.common.constants import (
     MAX_MINIBATCH_RETRY_NUM,
@@ -50,7 +49,6 @@ from elasticdl_tpu.nn.embedding import (
     capture_embedding_ids,
     flatten_collection,
     path_name,
-    plan_lookup_multi,
 )
 from elasticdl_tpu.nn.model_api import init_variables, split_variables
 from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
@@ -90,6 +88,8 @@ class Worker:
         task_ack_queue=8,
         loss_log_steps=20,
         telemetry_report_secs=5.0,
+        embedding_plane="ps",
+        embedding_prefetch=None,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -108,6 +108,62 @@ class Worker:
         # pre-combined (docs/sparse_fast_path.md). False restores the
         # naive per-occurrence plan for benchmarking/equivalence runs.
         self._sparse_dedup = sparse_dedup
+        # comm-plane mode (docs/embedding_planes.md): "ps" is the
+        # classic parameter-server trainer (dense params round-trip
+        # through pull_dense/push_gradient); "hybrid" keeps dense
+        # params (HBM-plane tables included — they are ordinary
+        # parameters) in the local/allreduce world and uses the PS
+        # fleet ONLY for PS-plane embedding tables, served by the
+        # overlapped pull pipeline below.
+        if embedding_plane not in ("ps", "hybrid"):
+            raise ValueError(
+                "embedding_plane must be 'ps' or 'hybrid', got %r"
+                % (embedding_plane,)
+            )
+        self._dense_local = embedding_plane == "hybrid"
+        if self._dense_local and ps_client is None:
+            raise ValueError(
+                "embedding_plane='hybrid' needs a ps_client: the PS "
+                "fleet serves the sparse tables while dense stays local"
+            )
+        if self._dense_local and job_type in (
+            JobType.EVALUATION_ONLY,
+            JobType.PREDICTION_ONLY,
+        ):
+            # hybrid's local replica is populated BY training (get_model
+            # is a no-op); an eval/predict-only job would silently score
+            # the random init and report garbage that looks finished
+            raise ValueError(
+                "embedding_plane='hybrid' only supports training job "
+                "types: %s has no training loop to populate the local "
+                "dense replica (serve saved models via the allreduce "
+                "plane's eval/predict modes or PS-mode workers)"
+                % job_type
+            )
+        from elasticdl_tpu.nn.comm_plane import (
+            EmbeddingPullPipeline,
+            MasterStorePlane,
+            PsPlane,
+        )
+
+        # one plane object fronts whichever store holds the PS-resident
+        # tables; the worker's embedding data path (plan -> pull ->
+        # scatter -> push -> drain) only ever talks to this interface
+        self._sparse_plane = (
+            PsPlane(ps_client)
+            if ps_client is not None
+            else MasterStorePlane(lambda: self._stub)
+        )
+        if embedding_prefetch is None:
+            # the overlapped pull pays off exactly when the dense half
+            # no longer serializes on the PS (hybrid); the classic PS
+            # trainer keeps the strictly-ordered inline pull
+            embedding_prefetch = self._dense_local
+        self._emb_pipeline = (
+            EmbeddingPullPipeline()
+            if embedding_prefetch and ps_client is not None
+            else None
+        )
 
         spec = get_model_spec(
             model_zoo=model_zoo,
@@ -198,7 +254,14 @@ class Worker:
         In sharded-PS mode the pull merges every shard's partition
         (reference worker.py:189-227); eval pinning to checkpointed
         versions is a master-mode feature, PS serves latest.
+
+        Hybrid mode never pulls: dense parameters live in the local/
+        allreduce world by construction (the PS fleet only ever sees
+        sparse tables), so eval/export score the local replica and the
+        model version advances from sparse-push responses instead.
         """
+        if self._dense_local:
+            return
         if self._ps_client is not None:
             initialized, got_version, named = self._ps_client.pull_dense()
             if not initialized and self._params is not None:
@@ -219,10 +282,11 @@ class Worker:
             return
         # aliasing note (docs/wire.md): over real gRPC these arrays are
         # zero-copy read-only views pinning ONE get_model reply buffer
-        # until the next pull replaces them — safe (the master plane
-        # never rides shm slots) and copy-free; jnp consumers copy at
-        # device put anyway. The PS path above materializes instead,
-        # because its replies may live in recycled shm slots.
+        # until the next pull replaces them — safe and copy-free; jnp
+        # consumers copy at device put anyway. Replies that rode a
+        # recycled shm slot were already materialized inside
+        # MasterClient.get_model (its audited retention edge), and the
+        # PS path above materializes in pull_dense for the same reason.
         if self._params is not None:
             flat = pytree_to_named_arrays(self._params)
             if set(flat) == set(named):
@@ -271,13 +335,24 @@ class Worker:
         job observes or persists model state (docs/dense_overlap.md).
         ``pull_dense`` also drains, so the window never widens the SSP
         staleness bound beyond what get_model_steps already allows.
+        The drain goes through the comm-plane interface, so hybrid and
+        classic PS mode settle their sparse pushes at the SAME SSP
+        boundaries (docs/embedding_planes.md).
         """
-        if self._ps_client is None or not hasattr(
-            self._ps_client, "drain"
-        ):
+        if self._ps_client is None:
+            return
+        # skeletal instances (tests build Worker.__new__ with only a
+        # ps_client) drain the client directly; fully-constructed
+        # workers go through the plane
+        plane = getattr(self, "_sparse_plane", None)
+        if plane is None and not hasattr(self._ps_client, "drain"):
             return
         try:
-            accepted, _ = self._ps_client.drain()
+            accepted, _ = (
+                plane.drain()
+                if plane is not None
+                else self._ps_client.drain()
+            )
         except RuntimeError as err:
             # a PS failure surfacing HERE (a boundary, not a minibatch)
             # means an already-reported batch's gradient was lost on
@@ -386,6 +461,28 @@ class Worker:
             self.report_variable()
             self._var_created = True
 
+    def _apply_local_dense(self, grads):
+        """Advance the local dense replica by one optimizer step.
+
+        The hybrid plane's dense world: dense layers AND HBM-plane
+        tables (ordinary parameters) update here with the worker's own
+        optimizer instance — no PS round trip. A multi-worker hybrid
+        job syncs this replica on the allreduce plane; the degenerate
+        one-worker world needs no sync at all. Also the engine behind
+        classic SSP local updates (reference worker.py:168-176). The
+        update is jitted (training/step.make_local_update_fn): hybrid
+        runs it every accepted minibatch, and the eager optax tree
+        walk would pay a dispatch per leaf per step."""
+        if self._local_opt is None:
+            from elasticdl_tpu.training.step import make_local_update_fn
+
+            self._local_opt = self._opt_fn()
+            self._local_opt_state = self._local_opt.init(self._params)
+            self._local_update_fn = make_local_update_fn(self._local_opt)
+        self._params, self._local_opt_state = self._local_update_fn(
+            grads, self._local_opt_state, self._params
+        )
+
     def _update_local_model(self):
         """Apply the last accepted gradients locally (SSP local updates).
 
@@ -394,23 +491,18 @@ class Worker:
         """
         if self._non_embed_grads is None:
             return
-        if self._local_opt is None:
-            self._local_opt = self._opt_fn()
-            self._local_opt_state = self._local_opt.init(self._params)
-        updates, self._local_opt_state = self._local_opt.update(
-            self._non_embed_grads, self._local_opt_state, self._params
-        )
-        self._params = optax.apply_updates(self._params, updates)
-        self._non_embed_grads = None
+        grads, self._non_embed_grads = self._non_embed_grads, None
+        self._apply_local_dense(grads)
 
     # -- elastic embedding plumbing ----------------------------------------
 
-    def _prepare_embedding_batch(self, features):
-        """Capture ids, pull + pad rows; returns (rows, idx, plan).
+    def _plan_embedding_lookups(self, features):
+        """Capture ids on host, build the per-layer dedup plan.
 
-        ``plan``: {path: (unique_ids, k)} for stripping padded gradients.
-        This is the hoisted-out-of-jit equivalent of the reference's
-        in-graph py_function lookup (layers/embedding.py:216-253).
+        Runs on the worker thread always — the flax capture interceptor
+        must not race a real forward — and is cheap (numpy only), so
+        the prefetch pipeline plans inline and backgrounds only the
+        RTT-heavy pull. Returns {path: (unique_ids, idxs, bucket)}.
         """
         variables = {"params": self._params, **self._state}
         captured = capture_embedding_ids(
@@ -419,45 +511,83 @@ class Worker:
             features,
             expected_count=self._embedding_num_calls,
         )
-        rows_by_path, idx_by_path, plan = {}, {}, {}
-        lookups = {}
-        for path, ids_list in captured.items():
-            # one union pull per layer, however many times it is called:
-            # every call slot gathers from the same rows buffer, so row
-            # gradients of a tied embedding accumulate across calls
-            lookups[path] = plan_lookup_multi(
+        # one union pull per layer, however many times it is called:
+        # every call slot gathers from the same rows buffer, so row
+        # gradients of a tied embedding accumulate across calls
+        return {
+            path: self._sparse_plane.plan_lookup_multi(
                 ids_list, dedup=self._sparse_dedup
             )
-        pulled = None
-        if self._ps_client is not None:
-            # one fan-out round for EVERY layer's rows: the per-layer
-            # serial pull loop would pay one PS round trip per table
-            # (docs/dense_overlap.md)
-            pulled = self._ps_client.pull_embedding_vectors_multi(
-                {
-                    path_name(path): unique
-                    for path, (unique, _, _) in lookups.items()
-                }
+            for path, ids_list in captured.items()
+        }
+
+    def _pull_embedding_rows(self, lookups):
+        """One comm-plane round for EVERY layer's rows: the per-layer
+        serial pull loop would pay one PS round trip per table
+        (docs/dense_overlap.md). Also the thunk the prefetch pipeline
+        runs on its background thread."""
+        return self._sparse_plane.pull(
+            {
+                path_name(path): unique
+                for path, (unique, _, _) in lookups.items()
+            }
+        )
+
+    def _kick_embedding_prefetch(self, batch):
+        """Stage the NEXT batch's embedding pull so its PS fan-out
+        overlaps the CURRENT batch's jitted forward/backward
+        (docs/embedding_planes.md). Plans inline (capture is worker-
+        thread-only), submits only the pull."""
+        if (
+            self._emb_pipeline is None
+            or not self._embedding_dims
+            or self._params is None
+        ):
+            return
+        features = batch[0] if isinstance(batch, tuple) else batch
+        try:
+            lookups = self._plan_embedding_lookups(features)
+        except Exception:
+            # planning the lookahead batch must never kill the current
+            # one — the consumer simply plans+pulls inline
+            logger.warning(
+                "embedding prefetch planning failed; next batch pulls "
+                "inline",
+                exc_info=True,
             )
+            return
+        self._emb_pipeline.submit(
+            features,
+            lookups,
+            lambda lookups=lookups: self._pull_embedding_rows(lookups),
+        )
+
+    def _prepare_embedding_batch(self, features):
+        """Plan ids, pull + pad rows; returns (rows, idx, plan).
+
+        ``plan``: {path: (unique_ids, k)} for stripping padded gradients.
+        This is the hoisted-out-of-jit equivalent of the reference's
+        in-graph py_function lookup (layers/embedding.py:216-253). A
+        pull prefetched for exactly this batch is consumed instead of
+        re-pulling; on a miss (first batch, retry after a stale-gradient
+        rejection — which WANTS fresh rows — or an invalidated round)
+        the pull runs inline.
+        """
+        pre = (
+            self._emb_pipeline.consume(features)
+            if self._emb_pipeline is not None
+            else None
+        )
+        if pre is not None:
+            lookups, pulled = pre
+        else:
+            lookups = self._plan_embedding_lookups(features)
+            pulled = self._pull_embedding_rows(lookups)
+        rows_by_path, idx_by_path, plan = {}, {}, {}
         for path, (unique, idxs, bucket) in lookups.items():
-            if pulled is not None:
-                rows = pulled[path_name(path)]
-            else:
-                rows = self._stub.pull_embedding_vectors(
-                    path_name(path), unique
-                )
-            rows = np.asarray(rows, dtype=np.float32)
-            if rows.shape[0] < bucket:
-                rows = np.concatenate(
-                    [
-                        rows,
-                        np.zeros(
-                            (bucket - rows.shape[0], rows.shape[1]),
-                            np.float32,
-                        ),
-                    ]
-                )
-            rows_by_path[path] = rows
+            rows_by_path[path] = self._sparse_plane.scatter(
+                pulled[path_name(path)], bucket
+            )
             for i, idx in enumerate(idxs):
                 idx_by_path[path + (call_slot_name(i),)] = idx
             plan[path] = (unique, len(unique))
@@ -509,6 +639,22 @@ class Worker:
 
     def _run_training_task(self, features, labels):
         loss, grads, sparse_grads = self.training_process(features, labels)
+        if self._dense_local:
+            # hybrid comm plane: only the PS-resident tables' row
+            # gradients cross the wire (riding the shared push window);
+            # dense gradients apply to the local replica immediately.
+            accepted, version = True, -1
+            if sparse_grads:
+                accepted, version = self._sparse_plane.push(
+                    sparse_grads, max(self._model_version, 0)
+                )
+            if version is not None and version >= 0:
+                # the version a rejection reports feeds the retry's
+                # next push; accepted pushes advance the SSP clock
+                self._model_version = max(self._model_version, version)
+            if accepted:
+                self._apply_local_dense(grads)
+            return accepted, self._model_version, loss
         accepted, min_model_version = self.report_gradient(
             grads, sparse_grads
         )
@@ -624,6 +770,23 @@ class Worker:
             traceback.print_exc()
             raise ex
         return err_msg
+
+    @staticmethod
+    def _lookahead_pairs(iterable):
+        """Yield (batch, next_batch) with a one-item lookahead;
+        next_batch is None on the last item. The dataset chain already
+        runs ahead of consumption (``.prefetch(1)``), so materializing
+        one more batch early adds no new accounting mode — the task
+        ledger advances on report_record_done, never on iteration."""
+        it = iter(iterable)
+        try:
+            cur = next(it)
+        except StopIteration:
+            return
+        for nxt in it:
+            yield cur, nxt
+            cur = nxt
+        yield cur, None
 
     @staticmethod
     def _batch_count(dataset_batch):
@@ -752,8 +915,13 @@ class Worker:
                 # init pass also wants host arrays.
                 dataset = dataset.device_prefetch()
             batches_seen = 0
-            for dataset_batch in dataset:
+            for dataset_batch, next_batch in self._lookahead_pairs(dataset):
                 batches_seen += 1
+                if next_batch is not None:
+                    # overlapped comm plane: batch N+1's embedding pull
+                    # fans out on the pipeline thread while batch N's
+                    # jitted step runs below (docs/embedding_planes.md)
+                    self._kick_embedding_prefetch(next_batch)
                 if self._job_type == JobType.TRAINING_WITH_EVALUATION:
                     if self._evaluate_only():
                         evaluation_task_executed = True
@@ -787,6 +955,12 @@ class Worker:
                 local_update_count += 1
                 if err_msg:
                     last_training_minibatch_failed = True
+                    if self._emb_pipeline is not None:
+                        # the failed task requeues: its prefetched
+                        # embedding pull is dropped here EXACTLY ONCE
+                        # (pipeline contract) — whichever worker re-runs
+                        # those records pulls fresh rows
+                        self._emb_pipeline.invalidate()
                 else:
                     last_training_minibatch_failed = False
                     if local_update_count < self._get_model_steps:
@@ -795,6 +969,10 @@ class Worker:
                     batch_count, err_msg
                 )
             del dataset
+            if self._emb_pipeline is not None:
+                # round boundary: a pull staged past the stream's end
+                # belongs to no batch anybody will run
+                self._emb_pipeline.invalidate()
             # task boundary: settle the async push window and the task
             # ack queue before the next round's eval/save-model
             # decisions see model/dispatch state
@@ -853,12 +1031,18 @@ class Worker:
 
     def run(self):
         """Fetch tasks from the master and train/evaluate/predict."""
-        if self._job_type == JobType.PREDICTION_ONLY:
-            self._predict_only()
-        elif self._job_type == JobType.EVALUATION_ONLY:
-            self._evaluate_only()
-        else:
-            self._train_and_evaluate()
+        try:
+            if self._job_type == JobType.PREDICTION_ONLY:
+                self._predict_only()
+            elif self._job_type == JobType.EVALUATION_ONLY:
+                self._evaluate_only()
+            else:
+                self._train_and_evaluate()
+        finally:
+            # the prefetch thread must not outlive the worker, crash
+            # paths included (conftest's leak check would flag it)
+            if self._emb_pipeline is not None:
+                self._emb_pipeline.close()
         self._drain_ps_pushes()
         # nothing may stay queued when the worker exits: the master's
         # doing-set must drain for the job to finish

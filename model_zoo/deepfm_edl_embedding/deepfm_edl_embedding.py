@@ -17,21 +17,39 @@ import optax
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.data.example import FixedLenFeature, parse_example
 from elasticdl_tpu.metrics import AUC
-from elasticdl_tpu.nn.embedding import Embedding
-from elasticdl_tpu.nn.hbm_embedding import HbmEmbedding
 
 # frappe CTR vocabulary (reference data/recordio_gen/frappe_recordio_gen)
 VOCAB_SIZE = 5384
 
 
-class DeepFMEdl(nn.Module):
-    """DeepFM whose embedding plane picks its storage by strategy:
+TABLES = ("embedding", "id_bias")
+# the hybrid split: the (conceptually multi-hundred-GB) feature table
+# stays sharded on the PS fleet; the small first-order bias table is an
+# ordinary parameter in the dense/allreduce world
+HYBRID_SPLIT = {"embedding": "ps", "id_bias": "hbm"}
 
-    - ``mesh=None`` (PS mode): elastic Embedding — tables in the
-      master/PS host store, rows pulled per batch, sparse grads pushed.
-    - ``mesh`` set (ALLREDUCE mode): HbmEmbedding — tables row-sharded
-      over ``table_axis`` device HBM, all_to_all row routing, updated
-      inside the jitted step (the BASELINE.json north star).
+
+class DeepFMEdl(nn.Module):
+    """DeepFM with a PER-TABLE embedding plane (docs/embedding_planes.md).
+
+    ``embedding_plane`` selects each table's storage through the
+    comm-plane interface (nn/comm_plane.py):
+
+    - ``"ps"``: elastic Embedding — tables in the master/PS host store,
+      rows pulled per batch, sparse grads pushed.
+    - ``"hbm"``: HbmEmbedding — tables are real parameters, row-sharded
+      over ``table_axis`` device HBM with all_to_all routing when a
+      mesh is set, plain dense parameters when not (the BASELINE.json
+      north star).
+    - ``"hybrid"``: the declared split (``HYBRID_SPLIT``) — the big
+      feature table on the PS fleet, the small bias table in the dense
+      world; run it with the worker's ``--embedding_plane=hybrid``
+      trainer mode so dense never round-trips through the PS.
+    - ``"table:plane/table:plane"``: explicit per-table entries.
+
+    Unset (``""``) keeps the historical mode-wide switch: PS layers
+    without a mesh, HBM layers with one (or with force_hbm/collective)
+    — one model body serves every mode either way.
     """
 
     embedding_dim: int = 64
@@ -40,6 +58,7 @@ class DeepFMEdl(nn.Module):
     mesh: object = None
     vocab_size: int = VOCAB_SIZE
     table_axis: str = "data"
+    embedding_plane: str = ""
     # force the HBM layer even without a mesh (single-device jnp.take —
     # the dense numerics twin the sharded path is validated against)
     force_hbm: bool = False
@@ -47,21 +66,45 @@ class DeepFMEdl(nn.Module):
     # shard_map — the multi-process elastic plane, parallel/elastic.py)
     collective: bool = False
 
+    def _table_planes(self):
+        from elasticdl_tpu.nn.comm_plane import resolve_table_planes
+
+        if self.embedding_plane:
+            return resolve_table_planes(
+                self.embedding_plane, TABLES, hybrid_default=HYBRID_SPLIT
+            )
+        # legacy mode-wide switch, expressed through the same selector
+        legacy = (
+            "ps"
+            if (
+                self.mesh is None
+                and not self.force_hbm
+                and not self.collective
+            )
+            else "hbm"
+        )
+        return {t: legacy for t in TABLES}
+
     def _embedding(self, dim, name):
-        if (
-            self.mesh is None
-            and not self.force_hbm
-            and not self.collective
-        ):
-            return Embedding(output_dim=dim, mask_zero=True, name=name)
-        return HbmEmbedding(
+        from elasticdl_tpu.nn.comm_plane import make_embedding
+
+        plane = self._table_planes()[name]
+        if plane == "ps" and (self.collective or self.force_hbm):
+            raise ValueError(
+                "table %r rides the PS plane, which the collective/"
+                "host-twin elastic forms cannot serve — train PS-plane "
+                "tables on the parameter-server worker (hybrid mode)"
+                % name
+            )
+        return make_embedding(
+            plane,
+            output_dim=dim,
+            name=name,
             vocab_size=self.vocab_size,
-            features=dim,
             mesh=self.mesh,
             axis=self.table_axis,
             mask_zero=True,
             collective=self.collective,
-            name=name,
         )
 
     @nn.compact
@@ -92,13 +135,18 @@ class DeepFMEdl(nn.Module):
 
 
 def custom_model(
-    embedding_dim=64, input_length=10, fc_unit=64, vocab_size=VOCAB_SIZE
+    embedding_dim=64,
+    input_length=10,
+    fc_unit=64,
+    vocab_size=VOCAB_SIZE,
+    embedding_plane="",
 ):
     return DeepFMEdl(
         embedding_dim=embedding_dim,
         input_length=input_length,
         fc_unit=fc_unit,
         vocab_size=vocab_size,
+        embedding_plane=embedding_plane,
     )
 
 
@@ -122,21 +170,35 @@ def build_host_model(**params):
     return DeepFMEdl(force_hbm=True, **params)
 
 
-def param_shardings(mesh, table_axis="data", **_params):
+def param_shardings(mesh, table_axis="data", embedding_plane="", **_params):
     """PartitionSpecs for the HBM-resident tables; everything else
     (dense layers, optimizer moments of dense layers) replicates, and
     the tables' optimizer state co-shards with them automatically.
     PadDim0: vocab rows are inert beyond the declared size, so the
     elastic plane may zero-pad them to place on NON-DIVISOR world
-    sizes (a kill 8 -> 7 keeps training instead of erroring)."""
+    sizes (a kill 8 -> 7 keeps training instead of erroring).
+
+    Per-table planes: only hbm-resident tables ARE parameters, so only
+    they get specs — a ps-plane table lives in the PS store, not the
+    params pytree (the elastic plane refuses such configs at layer
+    construction; the PS worker's hybrid mode serves them)."""
     from jax.sharding import PartitionSpec as P
 
+    from elasticdl_tpu.nn.comm_plane import resolve_table_planes
     from elasticdl_tpu.parallel.elastic import PadDim0
 
+    planes = (
+        resolve_table_planes(
+            embedding_plane, TABLES, hybrid_default=HYBRID_SPLIT
+        )
+        if embedding_plane
+        else {t: "hbm" for t in TABLES}
+    )
     spec = PadDim0(P(table_axis, None))
     return {
-        "embedding": {"table": spec},
-        "id_bias": {"table": spec},
+        name: {"table": spec}
+        for name in TABLES
+        if planes[name] == "hbm"
     }
 
 

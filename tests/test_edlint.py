@@ -1179,6 +1179,46 @@ def test_r9_master_channel_stays_blocking(tmp_path):
     assert not good
 
 
+def test_r9_comm_plane_call_sites(tmp_path):
+    """The embedding-plane invariants (docs/embedding_planes.md),
+    statically enforced at Client call sites: a plane's PULL is
+    retriable (pull_embedding_vector is classified idempotent — a
+    replayed read is harmless), its sparse PUSH is never resent (an
+    async PS applies on receipt), in hybrid mode exactly like classic
+    PS mode."""
+    # a hand-rolled plane that retries its sparse push: flagged
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class SparsePlane:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=5.0, retries=2)\n"
+        "    def pull(self, req):\n"
+        "        return self._client.call('pull_embedding_vector', **req)\n"
+        "    def push(self, req):\n"
+        "        return self._client.call('push_gradient', **req)\n",
+        relpath="elasticdl_tpu/nn/plane_fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "push_gradient" in bad[0].message
+    # the shipped shape: pull retriable, push opted out
+    good = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class SparsePlane:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=5.0, retries=2)\n"
+        "    def pull(self, req):\n"
+        "        return self._client.call('pull_embedding_vector', **req)\n"
+        "    def push(self, req):\n"
+        "        return self._client.call(\n"
+        "            'push_gradient', _retriable=False, **req\n"
+        "        )\n",
+        relpath="elasticdl_tpu/nn/plane_fixture.py",
+    )
+    assert not good
+
+
 def test_r9_unclassified_rpc_is_a_finding(tmp_path):
     bad = _lint(
         tmp_path,
