@@ -1245,13 +1245,42 @@ def _ps_fleet_boot_code():
     ) % here
 
 
-def _launch_ps_fleet(err_dir, model_zoo, model_def, tag, extra_args=(), n=2):
+def _wait_ps_port(proc, err, port, deadline):
+    import socket
+
+    while True:
+        if proc.poll() is not None:
+            err.flush()
+            raise RuntimeError(
+                "PS exited rc=%d at boot: %s"
+                % (
+                    proc.returncode,
+                    open(err.name, "rb").read()[-2000:],
+                )
+            )
+        try:
+            with socket.create_connection(("localhost", port), 1.0):
+                return
+        except OSError:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "PS did not come up: %s"
+                    % open(err.name, "rb").read()[-2000:]
+                )
+            time.sleep(0.2)
+
+
+def _launch_ps_fleet_ex(
+    err_dir, model_zoo, model_def, tag, extra_args=(), n=2
+):
     """Launch ``n`` real async PS OS processes and wait for their ports.
 
-    Returns (procs, addrs); stop with :func:`_stop_ps_fleet`. The
-    bind-then-close port picking has a TOCTOU window; a lost race
-    surfaces through the per-process stderr files in ``err_dir``
-    instead of silently."""
+    Returns (procs, addrs, cmds, env) — ``cmds[i]`` is shard i's full
+    argv, so a chaos driver can relaunch a killed shard with the SAME
+    id/port (the instance-manager contract). Stop with
+    :func:`_stop_ps_fleet`. The bind-then-close port picking has a
+    TOCTOU window; a lost race surfaces through the per-process stderr
+    files in ``err_dir`` instead of silently."""
     import socket
     import subprocess
 
@@ -1265,23 +1294,25 @@ def _launch_ps_fleet(err_dir, model_zoo, model_def, tag, extra_args=(), n=2):
         s.bind(("localhost", 0))
         ports.append(s.getsockname()[1])
         s.close()
-    procs = []
+    procs, cmds = [], []
     for i, port in enumerate(ports):
         err = open(
-            os.path.join(err_dir, "ps-%s-%d.err" % (tag, i)), "wb"
+            os.path.join(err_dir, "ps-%s-%d.err" % (tag, i)), "ab"
         )
+        cmd = [
+            sys.executable, "-c", ps_boot,
+            "--ps_id", str(i),
+            "--port", str(port),
+            "--model_zoo", model_zoo,
+            "--model_def", model_def,
+            "--use_async", "true",
+            "--grads_to_wait", "1",
+        ] + list(extra_args)
+        cmds.append(cmd)
         procs.append(
             (
                 subprocess.Popen(
-                    [
-                        sys.executable, "-c", ps_boot,
-                        "--ps_id", str(i),
-                        "--port", str(port),
-                        "--model_zoo", model_zoo,
-                        "--model_def", model_def,
-                        "--use_async", "true",
-                        "--grads_to_wait", "1",
-                    ] + list(extra_args),
+                    cmd,
                     env=env,
                     stdout=subprocess.DEVNULL,
                     stderr=err,
@@ -1291,27 +1322,16 @@ def _launch_ps_fleet(err_dir, model_zoo, model_def, tag, extra_args=(), n=2):
         )
     deadline = time.time() + 60
     for (proc, err), port in zip(procs, ports):
-        while True:
-            if proc.poll() is not None:
-                err.flush()
-                raise RuntimeError(
-                    "PS exited rc=%d at boot: %s"
-                    % (
-                        proc.returncode,
-                        open(err.name, "rb").read()[-2000:],
-                    )
-                )
-            try:
-                with socket.create_connection(("localhost", port), 1.0):
-                    break
-            except OSError:
-                if time.time() > deadline:
-                    raise RuntimeError(
-                        "PS did not come up: %s"
-                        % open(err.name, "rb").read()[-2000:]
-                    )
-                time.sleep(0.2)
-    return procs, ["localhost:%d" % p for p in ports]
+        _wait_ps_port(proc, err, port, deadline)
+    return procs, ["localhost:%d" % p for p in ports], cmds, env
+
+
+def _launch_ps_fleet(err_dir, model_zoo, model_def, tag, extra_args=(), n=2):
+    """Historical (procs, addrs) form of :func:`_launch_ps_fleet_ex`."""
+    procs, addrs, _, _ = _launch_ps_fleet_ex(
+        err_dir, model_zoo, model_def, tag, extra_args=extra_args, n=n
+    )
+    return procs, addrs
 
 
 def _stop_ps_fleet(procs):
@@ -1615,6 +1635,354 @@ def _bench_ps_fanout_microbench(quick=False):
         "fanout_slowest_shard_s": slow_s,
         "fanout_shard_sum_s": fast_s * (shards - 1) + slow_s,
     }
+
+
+def bench_chaos(quick=False):
+    """Fleet chaos drive (docs/ps_recovery.md): the same deepfm job
+    against a 2-OS-process PS fleet, once fault-free and once with a
+    scripted SIGKILL of one shard mid-job under a versioned snapshot
+    cadence. The killed shard is relaunched with the same id/port; the
+    job must run to completion with the worker's reconnect protocol
+    (cache invalidated, in-flight push window dropped — never resent —
+    `ps_shard_failure`→`ps_shard_restore` telemetry emitted), and the
+    final dense parameters must sit within the snapshot-staleness bound
+    of the fault-free run — operationally gated as "far closer to the
+    fault-free params than to near-init params" (the silent-reinit
+    hazard this plane removes) plus a rollback depth <= the cadence.
+    CPU-forced subprocess, same containment as --ps."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench, json\n"
+        "print('CHAOSBENCH ' + json.dumps(bench._bench_chaos_impl(%r)))\n"
+    ) % (here, quick)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=1800,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(
+            "chaos bench timed out:\n%s" % str(e.stdout or "")[-2000:]
+        ) from e
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHAOSBENCH "):
+            return json.loads(line[len("CHAOSBENCH "):])
+    raise RuntimeError(
+        "chaos bench failed:\n"
+        + proc.stdout[-2000:]
+        + proc.stderr[-2000:]
+    )
+
+
+def _bench_chaos_impl(quick=False):
+    """Three arms on identical data/seed: fault-free; SIGKILL-one-shard
+    WITH the snapshot cadence (the recovery plane); SIGKILL-one-shard
+    WITHOUT durability (today's silent-reinit hazard — the shard comes
+    back empty and the worker's re-push restores only dense params and
+    table metadata, so trained EMBEDDING rows reset to init). The gate
+    compares each chaos arm's final state (dense params + every trained
+    embedding row) against the fault-free run: the restored arm must
+    land far closer than the reinit arm does."""
+    import tempfile
+    import threading
+
+    _force_cpu_backend()
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.tools.chaos import ChaosOp, FleetChaos
+    from elasticdl_tpu.utils import profiling
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    # Deterministic trajectory contract: the divergence gate compares
+    # three runs, so everything except the injected fault must be
+    # bit-reproducible. Two entropy sources are pinned here, in this
+    # CPU-forced bench subprocess only: (1) the zoo dataset_fn's
+    # unseeded shuffle becomes the identity (records train in file
+    # order — the file is already drawn from seeded pools), and (2)
+    # the worker runs the strictly-ordered client config
+    # (push_inflight=0, no hot-row cache) because the overlapped
+    # window/cache hit pattern is thread-timing-dependent and measured
+    # fault-free run-to-run L2 noise from it (~1.4) exceeded the
+    # restore-vs-reinit signal. The cache-invalidation and
+    # window-abandonment halves of the reconnect protocol are pinned
+    # by tests/test_chaos.py and tests/test_ps_fleet_recovery.py.
+    from elasticdl_tpu.data import dataset as _dataset_mod
+
+    _dataset_mod.Dataset.shuffle = (
+        lambda self, buffer_size, seed=None,
+        reshuffle_each_iteration=True: self
+    )
+
+    records = 512 if quick else 1536
+    batch = 32
+    cadence = 3 if quick else 4
+    # kill mid-job: right around the early->late pool handover below,
+    # so the early pool's rows see no organic retraining afterwards
+    kill_at_version = (records // batch) // 2 + 2
+    pool_size = 96
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
+    # the deepfm zoo's two PS tables; probed row-by-row for the gate
+    tables = ("embedding", "id_bias")
+
+    def pooled_frappe_file(n, tmp, name, pools):
+        """FRAPPE-schema file drawing ids from ``pools`` — one pool per
+        consecutive half of the records. The gate probes the EARLY
+        pool: its rows train many times before the mid-job kill and
+        (in the main file) never again after, so their final values
+        discriminate a restored table (rows keep their trained values
+        minus at most the cadence rollback) from a silently
+        re-initialized one (rows reset to fresh init) without the
+        wash-out of continued retraining."""
+        from elasticdl_tpu.data.example import encode_example
+        from elasticdl_tpu.data.recordio import RecordIOWriter
+
+        rng = np.random.default_rng(13)
+        path = os.path.join(tmp, "%s_%d.edlr" % (name, n))
+        per_pool = (n + len(pools) - 1) // len(pools)
+        with RecordIOWriter(path) as f:
+            for i in range(n):
+                pool = pools[min(i // per_pool, len(pools) - 1)]
+                f.write(
+                    encode_example(
+                        {
+                            "feature": rng.choice(
+                                pool, size=(10,)
+                            ).astype(np.int64),
+                            "label": np.array(
+                                [rng.integers(2)], dtype=np.int64
+                            ),
+                        }
+                    )
+                )
+        return path
+
+    def run_job(addrs, data, n):
+        shards = {data: (0, n)}
+        task_d = TaskDispatcher(shards, {}, {}, batch * 4, 1)
+        master = MasterServicer(
+            1,
+            batch,
+            None,
+            task_d,
+            checkpoint_service=CheckpointService("", 0, 0, False),
+            use_async=True,
+        )
+        ps_client = PSClient(
+            [
+                BoundPS(a, deadline_s=5.0, retries=2, backoff_s=0.2)
+                for a in addrs
+            ],
+            # strictly-ordered config: see the determinism note above
+            hot_row_cache_rows=0,
+            push_inflight=0,
+        )
+        worker = Worker(
+            worker_id=1,
+            job_type=JobType.TRAINING_ONLY,
+            minibatch_size=batch,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def=model_def,
+            model_params=model_params,
+            ps_client=ps_client,
+            seed=7,
+        )
+        worker._stub = InProcessMaster(master)
+        try:
+            worker.run()
+        finally:
+            ps_client.close()
+        if not task_d.finished():
+            raise RuntimeError("chaos bench job did not finish")
+
+    def fleet_state(addrs, probe_ids):
+        """(version, flat float64 vector of dense params + every probe
+        row of both tables) — the gate's comparison space."""
+        client = PSClient([BoundPS(a, deadline_s=10.0) for a in addrs])
+        try:
+            ok, version, named = client.pull_dense()
+            if not ok:
+                raise RuntimeError(
+                    "fleet reports uninitialized dense params"
+                )
+            rows = client.pull_embedding_vectors_multi(
+                {name: probe_ids for name in tables}
+            )
+        finally:
+            client.close()
+        parts = [
+            np.asarray(named[k], np.float64).ravel()
+            for k in sorted(named)
+        ]
+        parts += [
+            np.asarray(rows[name], np.float64).ravel() for name in tables
+        ]
+        return version, np.concatenate(parts)
+
+    def run_chaos_arm(tag, extra_args, data, warm):
+        """One kill-one-shard job; returns (results_dict, state)."""
+        procs, addrs, cmds, env = _launch_ps_fleet_ex(
+            tmp, MODEL_ZOO_PATH, model_def, tag, extra_args=extra_args
+        )
+        schedule = [ChaosOp("kill", 0, at_version=kill_at_version)]
+        relaunched = threading.Event()
+
+        class _Fleet:
+            """kill_ps = SIGKILL + relaunch with the same argv/port —
+            the LocalInstanceManager relaunch contract, driven by the
+            bench's own process table."""
+
+            def kill_ps(self, shard):
+                import subprocess
+
+                proc, err = procs[shard]
+                proc.kill()
+                proc.wait(timeout=10)
+                procs[shard] = (
+                    subprocess.Popen(
+                        cmds[shard],
+                        env=env,
+                        stdout=subprocess.DEVNULL,
+                        stderr=err,
+                    ),
+                    err,
+                )
+                relaunched.set()
+
+            terminate_ps = kill_ps
+
+        from elasticdl_tpu.rpc.core import Client
+
+        status_clients = [Client(a, deadline_s=2.0) for a in addrs]
+
+        def status_fn(shard):
+            return status_clients[shard].call("ps_status")
+
+        profiling.events.reset()
+        chaos = FleetChaos(
+            _Fleet(), status_fn, schedule, poll_s=0.2
+        ).start()
+        arm = {}
+        try:
+            run_job(addrs, warm, batch * 2)
+            run_job(addrs, data, records)
+            chaos.stop()
+            if not chaos.done():
+                raise RuntimeError(
+                    "chaos schedule did not execute (job finished "
+                    "before shard 0 reached version %d)"
+                    % kill_at_version
+                )
+            if not relaunched.wait(timeout=1):
+                raise RuntimeError("killed shard was never relaunched")
+            status0 = status_clients[0].call("ps_status")
+            arm["restored_version"] = int(
+                status0.get("restored_version", -1)
+            )
+            version, state = fleet_state(addrs, probe_ids)
+            arm["final_version"] = int(version)
+        finally:
+            chaos.stop()
+            for c in status_clients:
+                c.close()
+            _stop_ps_fleet(procs)
+        events = profiling.events.tail(4096)
+        restore_events = [
+            e for e in events if e["kind"] == "ps_shard_restore"
+        ]
+        arm["saw_shard_failure_event"] = any(
+            e["kind"] == "ps_shard_failure" for e in events
+        )
+        arm["saw_shard_restore_event"] = bool(restore_events)
+        arm["rollback_depth"] = max(
+            [int(e.get("rollback_depth") or 0) for e in restore_events],
+            default=-1,
+        )
+        return arm, state
+
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        id_rng = np.random.default_rng(29)
+        shuffled = id_rng.permutation(5383)
+        early_pool = shuffled[:pool_size]
+        late_pool = shuffled[pool_size : 2 * pool_size]
+        data = pooled_frappe_file(
+            records, tmp, "pool", (early_pool, late_pool)
+        )
+        warm = pooled_frappe_file(
+            batch * 2, tmp, "pool_warm", (early_pool,)
+        )
+        probe_ids = np.sort(early_pool).astype(np.int64)
+
+        # -- fault-free arm (same snapshot config, no faults) -----------
+        procs, addrs, _, _ = _launch_ps_fleet_ex(
+            tmp,
+            MODEL_ZOO_PATH,
+            model_def,
+            "chaos-clean",
+            extra_args=[
+                "--ps_snapshot_versions", str(cadence),
+                "--ps_snapshot_dir", os.path.join(tmp, "snap-clean"),
+            ],
+        )
+        try:
+            run_job(addrs, warm, batch * 2)
+            run_job(addrs, data, records)
+            clean_version, clean = fleet_state(addrs, probe_ids)
+        finally:
+            _stop_ps_fleet(procs)
+        results["clean_version"] = int(clean_version)
+
+        # -- chaos arm A: kill + relaunch WITH the snapshot cadence -----
+        restored_arm, restored_state = run_chaos_arm(
+            "chaos-restored",
+            [
+                "--ps_snapshot_versions", str(cadence),
+                "--ps_snapshot_dir", os.path.join(tmp, "snap-chaos"),
+            ],
+            data,
+            warm,
+        )
+        results.update(
+            {"restored_" + k: v for k, v in restored_arm.items()}
+        )
+
+        # -- chaos arm B: the same kill with durability OFF (the
+        # pre-recovery-plane hazard this PR removes): the relaunched
+        # shard boots empty, the worker re-pushes dense + infos, and
+        # every trained embedding row of that shard resets to init ----
+        reinit_arm, reinit_state = run_chaos_arm(
+            "chaos-reinit", [], data, warm
+        )
+        results.update({"reinit_" + k: v for k, v in reinit_arm.items()})
+
+        d_restored = float(np.linalg.norm(restored_state - clean))
+        d_reinit = float(np.linalg.norm(reinit_state - clean))
+        results.update(
+            {
+                "cadence": cadence,
+                "kill_at_version": kill_at_version,
+                "l2_restored_vs_clean": d_restored,
+                "l2_reinit_vs_clean": d_reinit,
+                "divergence_ratio": d_restored / max(d_reinit, 1e-12),
+            }
+        )
+    return results
 
 
 def bench_hybrid(quick=False):
@@ -3076,6 +3444,78 @@ def main(argv=None):
         )
         return 0
 
+    if "--chaos" in argv:
+        res = bench_chaos(quick)
+        problems = []
+        if not res.get("restored_saw_shard_restore_event"):
+            problems.append(
+                "no ps_shard_restore event: the worker never detected "
+                "the relaunched incarnation"
+            )
+        if not res.get("restored_saw_shard_failure_event"):
+            problems.append("no ps_shard_failure event recorded")
+        if res.get("restored_restored_version", -1) < 0:
+            problems.append(
+                "relaunched shard did not restore a snapshot "
+                "(restored_version=%r)"
+                % res.get("restored_restored_version")
+            )
+        if res.get("reinit_restored_version", -1) >= 0:
+            problems.append(
+                "durability-off control arm unexpectedly restored state"
+            )
+        if res.get("restored_rollback_depth", -1) > res["cadence"] + 1:
+            # +1: one version may land between the cadence capture and
+            # the kill observation
+            problems.append(
+                "rollback depth %d exceeds the snapshot cadence %d"
+                % (res.get("restored_rollback_depth", -1), res["cadence"])
+            )
+        ratio = res["divergence_ratio"]
+        if not ratio < 0.5:
+            problems.append(
+                "restored arm diverged %.3fx the reinit arm's distance "
+                "from the fault-free run (gate <0.5x: restoring the "
+                "snapshot must land the fleet far closer to the "
+                "fault-free params than the silent-reinit hazard does)"
+                % ratio
+            )
+        if problems:
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_chaos_recovery_divergence",
+                        "error": "; ".join(problems),
+                        "detail": res,
+                    }
+                )
+            )
+            return 1
+        _emit(
+            "ps_chaos_recovery_divergence",
+            round(max(ratio, 1e-4), 4),
+            "x L2 divergence of final fleet state (dense params + every "
+            "trained embedding row) from the fault-free run: "
+            "snapshot-restored relaunch vs the durability-off "
+            "silent-reinit control (lower=better; gate <0.5). SIGKILL "
+            "one of 2 PS shards at version %d, %d-version snapshot "
+            "cadence: restored arm rolled back %d <= cadence, restored "
+            "v%d, both chaos jobs completed, ps_shard_failure->"
+            "ps_shard_restore telemetry emitted (restored L2 %.4f vs "
+            "reinit L2 %.4f)"
+            % (
+                res["kill_at_version"],
+                res["cadence"],
+                res["restored_rollback_depth"],
+                res["restored_restored_version"],
+                res["l2_restored_vs_clean"],
+                res["l2_reinit_vs_clean"],
+            ),
+            update,
+            lower_is_better=True,
+        )
+        return 0
+
     if "--wire" in argv:
         res = bench_wire(quick)
         _emit(
@@ -3415,6 +3855,11 @@ def main(argv=None):
     section("wire_dense_roundtrip_speedup", ["--wire"], 300)
     section("ps_deepfm_examples_per_sec", ["--ps"], 900)
     section("ps_deepfm_examples_per_sec_hybrid", ["--hybrid"], 900)
+    # the recovery-plane gate (docs/ps_recovery.md): SIGKILL one PS
+    # shard mid-job under a snapshot cadence; the job must complete
+    # with the relaunched shard RESTORED and final dense params within
+    # the snapshot-staleness bound of the fault-free run
+    section("ps_chaos_recovery_divergence", ["--chaos"], 600)
     # device sections, cheapest diagnosis first (each shrinks its
     # workload and renames its metric _cpu when the backend is plain
     # CPU, so the suite fits the budget without an accelerator)

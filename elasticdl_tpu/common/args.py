@@ -248,6 +248,41 @@ def add_train_params(parser):
         False,
         "Modulate learning rate by 1/staleness in async mode",
     )
+    # accepted by the master too so the k8s instance manager's argv
+    # relay carries the durability config to every PS pod
+    add_ps_snapshot_params(parser)
+
+
+def add_ps_snapshot_params(parser):
+    """PS shard durability flags (docs/ps_recovery.md); shared by the
+    PS entry and the master (which relays them to PS pods)."""
+    parser.add_argument(
+        "--ps_snapshot_versions",
+        type=non_neg_int,
+        default=0,
+        help="Durability cadence (docs/ps_recovery.md): snapshot each "
+        "PS shard's dense params + embedding/slot tables every N "
+        "optimizer versions, off the apply path, and restore the "
+        "newest valid snapshot at (re)boot. 0 (default) disables; "
+        "requires --ps_snapshot_dir. A crash rolls the shard back at "
+        "most N versions instead of to step-0 init",
+    )
+    parser.add_argument(
+        "--ps_snapshot_dir",
+        default="",
+        help="Base directory for per-shard snapshot state (the shard "
+        "writes under <dir>/ps-<id>/). Must survive the pod relaunch "
+        "(a persistent volume on k8s; any local path for the "
+        "single-host instance manager)",
+    )
+    parser.add_argument(
+        "--ps_snapshot_keep",
+        type=pos_int,
+        default=2,
+        help="Snapshot ring retention: keep this many published "
+        "versions; older ones are evicted only after a newer one "
+        "published",
+    )
 
 
 def add_evaluate_params(parser):
@@ -551,6 +586,16 @@ def parse_ps_args(ps_args=None):
         "handler before serving it — models cross-pod network RTT on "
         "loopback fleets so overlap benchmarks measure what a real "
         "deployment would see. 0 (default) disables",
+    )
+    add_ps_snapshot_params(parser)
+    parser.add_argument(
+        "--telemetry_port",
+        type=int,
+        default=-1,
+        help="Serve this PS process's metric registry (RPC service "
+        "histograms, edl_ps_snapshot_age_seconds, ...) as Prometheus "
+        "text on /metrics at this port (0 = ephemeral). -1 (default) "
+        "disables",
     )
     parser.add_argument(
         "--log_level",

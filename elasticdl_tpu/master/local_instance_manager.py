@@ -264,6 +264,24 @@ class LocalInstanceManager:
                     new_id = self._start_worker()
                     logger.info("Relaunched worker as id %d", new_id)
         else:
+            if returncode == 75:  # EX_TEMPFAIL: graceful drain
+                # the PS drained a final shard snapshot under SIGTERM
+                # (ps/parameter_server.py) — benign, does NOT consume
+                # the crash-relaunch budget, mirroring the worker
+                # plane's preemption-drain contract
+                relaunch = False
+                with self._lock:
+                    relaunch = (
+                        not self._stopping
+                        and self._restart_policy != "Never"
+                    )
+                if relaunch:
+                    logger.info(
+                        "PS %d drained (exit 75); relaunching same id",
+                        instance_id,
+                    )
+                    self._spawn(key, self._ps_command(instance_id))
+                return
             logger.warning(
                 "PS %d exited with %d; relaunching same id",
                 instance_id,
@@ -316,6 +334,41 @@ class LocalInstanceManager:
             proc = self._procs.get(("worker", worker_id))
         if proc:
             proc.terminate()
+
+    def kill_ps(self, ps_id):
+        """Chaos/fault injection: SIGKILL one live PS process.
+
+        The hard-crash path — no drain snapshot runs, so the relaunch
+        restores the last CADENCE snapshot (or boots empty with
+        durability off). The watch loop relaunches the same id on the
+        crash budget, exactly like a k8s pod death
+        (tools/chaos.py drives this for the scripted fleet faults)."""
+        import signal
+
+        with self._lock:
+            proc = self._procs.get(("ps", ps_id))
+        if proc:
+            try:
+                proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+
+    def terminate_ps(self, ps_id):
+        """Graceful PS preemption (SIGTERM): the shard drains a final
+        snapshot and exits 75; the watch loop relaunches without
+        spending the crash budget."""
+        with self._lock:
+            proc = self._procs.get(("ps", ps_id))
+        if proc:
+            proc.terminate()
+
+    def live_ps(self):
+        with self._lock:
+            return [
+                k[1]
+                for k, p in self._procs.items()
+                if k[0] == "ps" and p.poll() is None
+            ]
 
     def live_workers(self):
         with self._lock:

@@ -179,6 +179,35 @@ class HotRowCache:
             if version > self._latest.get(shard, -1):
                 self._latest[shard] = version
 
+    def invalidate_shard(self, shard, version=None):
+        """Drop every entry tagged with ``shard`` and re-anchor its
+        version clock — the reconnect protocol's cache half
+        (docs/ps_recovery.md).
+
+        A relaunched shard restores an OLDER snapshot and mints a new
+        epoch: rows cached under the dead incarnation's tags are no
+        longer the shard's truth (the shard re-applies the rolled-back
+        window differently), and the max-only ``note_version`` clock
+        would otherwise hold the dead incarnation's high-water mark —
+        every freshly pulled row would tag below ``latest - window``
+        and miss forever (a permanent miss storm). ``version`` (the
+        restored shard's current version) re-anchors the clock;
+        ``None`` just forgets the shard. Returns the entry count
+        dropped."""
+        with self._mu:
+            victims = [
+                key
+                for key, (entry_shard, _, _) in self._rows.items()
+                if entry_shard == shard
+            ]
+            for key in victims:
+                del self._rows[key]
+            if version is not None and version >= 0:
+                self._latest[shard] = version
+            else:
+                self._latest.pop(shard, None)
+            return len(victims)
+
     def get(self, name, row_id):
         """The cached row, or None on miss/stale (stale entries drop)."""
         with self._mu:
@@ -290,6 +319,15 @@ class PsPlane(CommPlane):
     PR-1 hot-row cache in front; push rides the non-blocking push
     window (sparse-only — in hybrid mode dense gradients never touch
     the PS), and :meth:`drain` settles it at SSP boundaries.
+
+    Epoch-abandonment contract (docs/ps_recovery.md): when a PS shard
+    relaunches (its replies carry a new ``shard_epoch``), the client
+    behind this plane invalidates that shard's cache entries and
+    ABANDONS the in-flight push window — :meth:`drain` drops those
+    pushes' outcomes (never resends, never wedges on their failures),
+    exactly like the round-requeue contract drops a requeued task's
+    prefetched pull (:class:`EmbeddingPullPipeline.invalidate`): work
+    addressed to a dead incarnation is dropped once, not replayed.
     """
 
     name = "ps"

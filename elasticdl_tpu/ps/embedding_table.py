@@ -73,6 +73,40 @@ class EmbeddingTable:
         with self._lock:
             self.embedding_vectors.clear()
 
+    def snapshot(self):
+        """Consistent (ids, rows) copy of every materialized row.
+
+        Captured under the table lock, so a concurrent ``set`` from an
+        async apply can never tear one row across the copy. Returns
+        ``(ids int64 (n,), rows float32 (n, dim))`` — empty arrays for
+        a table no lookup has touched yet (lazy init means an untouched
+        table has nothing durable to lose)."""
+        with self._lock:
+            ids = np.fromiter(
+                self.embedding_vectors.keys(),
+                dtype=np.int64,
+                count=len(self.embedding_vectors),
+            )
+            if ids.size == 0:
+                rows = np.zeros((0, int(self.dim or 0)), np.float32)
+            else:
+                rows = np.stack(
+                    [
+                        np.asarray(v, dtype=np.float32)
+                        for v in self.embedding_vectors.values()
+                    ]
+                )
+        return ids, rows
+
+    def load_snapshot(self, ids, rows):
+        """Replace the row store with a snapshot's (ids, rows) — the
+        restore half of :meth:`snapshot` (PS shard relaunch)."""
+        rows = np.asarray(rows, dtype=np.float32)
+        with self._lock:
+            self.embedding_vectors = {
+                int(i): rows[pos].copy() for pos, i in enumerate(ids)
+            }
+
     def __len__(self):
         return len(self.embedding_vectors)
 

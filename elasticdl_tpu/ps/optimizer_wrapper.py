@@ -38,6 +38,11 @@ class OptimizerWrapper:
         self._opt = optimizer
         self._params = parameters
         self._lock = threading.Lock()
+        # every mutation of the store (dense AND sparse applies) runs
+        # under this lock; the shard snapshotter captures under it too,
+        # so a snapshot is always a between-applies cut (docs/
+        # ps_recovery.md), never a torn mid-apply mix
+        self.apply_lock = self._lock
         # per embedding layer: pytree paths of row-shaped state leaves and
         # the non-row residue of the optimizer state
         self._non_row_state = {}

@@ -4,8 +4,17 @@ Parity: reference ps/parameter_server.py + ps/main.py — loads the
 optimizer from the model-zoo module, serves the Pserver RPCs on a 64-thread
 gRPC server, then sleeps forever (the master relaunches dead PS pods with
 the same id/service DNS so workers re-resolve transparently).
+
+Durability (docs/ps_recovery.md): with ``--ps_snapshot_versions`` +
+``--ps_snapshot_dir`` set, the shard restores the newest valid snapshot
+BEFORE serving, mints a fresh ``shard_epoch`` (boot id) carried in every
+reply and in ``transport_hello``, snapshots every N optimizer versions
+off the apply path, and drains a final snapshot on SIGTERM before
+exiting 75 (EX_TEMPFAIL — the instance manager's graceful-drain code,
+which relaunches without consuming the crash budget).
 """
 
+import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
@@ -23,11 +32,45 @@ class ParameterServer:
         self._args = args
         self._server = None
         self._shm_registry = None
+        self._telemetry_http = None
+        self._draining = threading.Event()
         module = load_module(
             get_module_file_path(args.model_zoo, args.model_def)
         ).__dict__
         self._optimizer = module[args.optimizer]()
         self.parameters = Parameters()
+
+        # durability plane: build the per-shard snapshotter (a no-op
+        # object when the cadence/dir flags are unset), mint this
+        # boot's epoch, and restore the newest valid snapshot before
+        # the servicer exists — a restored shard must never serve a
+        # single RPC from its step-0 init
+        import os
+
+        from elasticdl_tpu.ps.snapshot import (
+            ShardSnapshotter,
+            mint_shard_epoch,
+        )
+
+        snap_dir = getattr(args, "ps_snapshot_dir", "") or ""
+        snap_every = int(getattr(args, "ps_snapshot_versions", 0) or 0)
+        shard_dir = (
+            os.path.join(snap_dir, "ps-%d" % args.ps_id)
+            if snap_dir
+            else None
+        )
+        self.shard_epoch = mint_shard_epoch(shard_dir)
+        self.snapshotter = ShardSnapshotter(
+            shard_dir or "",
+            ps_id=args.ps_id,
+            every_versions=snap_every if shard_dir else 0,
+            keep=int(getattr(args, "ps_snapshot_keep", 2) or 2),
+        )
+        self.snapshotter.set_shard_epoch(self.shard_epoch)
+        self.restored_version = self.snapshotter.restore_into(
+            self.parameters
+        )
+
         self.servicer = PserverServicer(
             self.parameters,
             args.grads_to_wait,
@@ -35,6 +78,9 @@ class ParameterServer:
             lr_staleness_modulation=bool(args.lr_staleness_modulation),
             use_async=args.use_async,
             wire_dtype=getattr(args, "wire_dtype", ""),
+            snapshotter=self.snapshotter if shard_dir else None,
+            shard_epoch=self.shard_epoch,
+            restored_version=self.restored_version,
         )
 
     def prepare(self):
@@ -59,11 +105,69 @@ class ParameterServer:
         # control round trip, not the slot reads.
         from elasticdl_tpu.rpc.shm_transport import install_shm_endpoint
 
-        methods, self._shm_registry = install_shm_endpoint(methods)
+        # the hello reply carries this incarnation's boot id too, so a
+        # reconnecting co-located client learns the epoch at negotiation
+        # time, before its first data-plane round (docs/ps_recovery.md)
+        methods, self._shm_registry = install_shm_endpoint(
+            methods, hello_extra={"shard_epoch": self.shard_epoch}
+        )
+        telemetry_port = getattr(self._args, "telemetry_port", -1)
+        if telemetry_port is not None and telemetry_port >= 0:
+            # the PR-6 /metrics plane, per PS pod: the process-wide
+            # registry (per-method service histograms, the snapshot-age
+            # gauge) + this process's event tail
+            from elasticdl_tpu.master.telemetry import (
+                TelemetryHTTPServer,
+            )
+            from elasticdl_tpu.utils import profiling
+
+            class _Registry:
+                @staticmethod
+                def prometheus_text():
+                    return profiling.metrics.prometheus_text()
+
+                @staticmethod
+                def events_tail(n=200):
+                    return profiling.events.tail(n)
+
+            self._telemetry_http = TelemetryHTTPServer(
+                _Registry(), port=telemetry_port
+            )
         self._server = serve(methods, self._args.port)
         logger.info(
-            "RPC server started on port %d", self._server._edl_port
+            "RPC server started on port %d (shard_epoch %d%s)",
+            self._server._edl_port,
+            self.shard_epoch,
+            (
+                ", restored snapshot v%d" % self.restored_version
+                if self.restored_version is not None
+                else ""
+            ),
         )
+
+    def install_drain_handler(self):
+        """SIGTERM = graceful preemption: drain one final snapshot and
+        exit 75 so the instance manager relaunches without spending the
+        crash budget. Installed only by the process entry (``main``) —
+        embedded/test ParameterServers keep their host's handlers."""
+        import signal
+        import sys
+
+        def _drain(signum, frame):
+            if self._draining.is_set():
+                return  # a second SIGTERM while draining: already going
+            self._draining.set()
+            logger.warning(
+                "SIGTERM: draining a final shard snapshot before exit"
+            )
+            try:
+                self.servicer.drain_snapshot()
+            except Exception as err:  # noqa: BLE001 — exit regardless
+                logger.error("drain snapshot failed: %s", err)
+            self.stop()
+            sys.exit(75)
+
+        signal.signal(signal.SIGTERM, _drain)
 
     def run(self):
         try:
@@ -78,12 +182,23 @@ class ParameterServer:
         if self._server:
             self._server.stop(grace=None)
             self._server = None
+        if self._telemetry_http is not None:
+            self._telemetry_http.close()
+            self._telemetry_http = None
         if self._shm_registry is not None:
             # reclaims every attached ring, including segments whose
             # creator worker was SIGKILLed mid-call (its atexit unlink
             # never ran — this is the orphan-reclamation path)
             self._shm_registry.close()
             self._shm_registry = None
+        if self.snapshotter is not None:
+            # settle queued cadence writes so a clean stop never drops
+            # an already-captured snapshot on the floor
+            try:
+                self.snapshotter.close()
+            except Exception as err:  # noqa: BLE001 — teardown
+                logger.warning("snapshotter close failed: %s", err)
+            self.snapshotter = None
 
 
 def main():
@@ -94,6 +209,7 @@ def main():
     args = parse_ps_args()
     server = ParameterServer(args)
     server.prepare()
+    server.install_drain_handler()
     server.run()
 
 

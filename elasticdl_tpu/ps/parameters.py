@@ -147,8 +147,79 @@ class Parameters:
                     self.embedding_params[key] = table
 
     def to_named_arrays(self):
-        """Dense params snapshot (for pull_variable / checkpoint)."""
+        """Dense params snapshot (for pull_variable / checkpoint).
+
+        Copies under ``_lock``: the async servicer's ``_apply`` rebinds
+        ``non_embedding_params`` and installs fresh arrays concurrently,
+        and an unguarded copy loop could hand back a torn mix of pre-
+        and post-apply values (half the dict from before the rebind,
+        half after) tagged with one version."""
+        with self._lock:
+            return {
+                name: arr.copy()
+                for name, arr in self.non_embedding_params.items()
+            }
+
+    # -- durability (ps/snapshot.py) ----------------------------------------
+
+    def snapshot_state(self):
+        """Capture everything a shard snapshot persists, copied.
+
+        Dense params + the stored version are captured together under
+        ``_lock`` (one atomic read of the pair the staleness machinery
+        relates); each embedding/slot table copies under its own lock
+        via :meth:`EmbeddingTable.snapshot`. The result is
+        self-contained host data safe to write on a background thread
+        while applies continue (the submit-time-snapshot discipline of
+        common/sharded_checkpoint.ShardedCheckpointManager)."""
+        with self._lock:
+            version = int(self.version)
+            initialized = bool(self.initialized)
+            dense = {
+                name: np.asarray(arr, dtype=np.float32).copy()
+                for name, arr in self.non_embedding_params.items()
+            }
+            tables = list(self.embedding_params.items())
+        table_snaps = {}
+        for name, table in tables:
+            ids, rows = table.snapshot()
+            table_snaps[name] = {
+                "ids": ids,
+                "rows": rows,
+                "dim": int(table.dim or 0),
+                "initializer": table.initializer_name,
+                "is_slot": bool(table.is_slot),
+            }
         return {
-            name: arr.copy()
-            for name, arr in self.non_embedding_params.items()
+            "version": version,
+            "initialized": initialized,
+            "dense": dense,
+            "tables": table_snaps,
         }
+
+    def restore_state(self, state):
+        """Install a :meth:`snapshot_state` capture (PS shard boot).
+
+        Rebuilds embedding/slot tables with their recorded
+        dim/initializer/is_slot so lazy init of NEW rows behaves exactly
+        as before the crash, and marks the store initialized — a
+        restored shard serves immediately instead of waiting for a
+        worker's first-write push."""
+        tables = {}
+        for name, snap in state["tables"].items():
+            table = EmbeddingTable(
+                name,
+                snap["dim"],
+                initializer=snap["initializer"],
+                is_slot=snap["is_slot"],
+            )
+            table.load_snapshot(snap["ids"], snap["rows"])
+            tables[name] = table
+        with self._lock:
+            self.non_embedding_params = {
+                name: np.asarray(arr, dtype=np.float32)
+                for name, arr in state["dense"].items()
+            }
+            self.embedding_params = tables
+            self.version = int(state["version"])
+            self.initialized = bool(state.get("initialized", True))

@@ -36,6 +36,9 @@ class PserverServicer:
         lr_staleness_modulation=False,
         use_async=False,
         wire_dtype="",
+        snapshotter=None,
+        shard_epoch=0,
+        restored_version=None,
     ):
         self._parameters = parameters
         self._grads_to_wait = grads_to_wait
@@ -52,6 +55,45 @@ class PserverServicer:
         self._dense_sum = {}
         self._indexed_sum = {}
         self._grad_n = 0
+        # durability plane (docs/ps_recovery.md): the per-shard cadence
+        # snapshotter (None = durability off), this incarnation's boot
+        # id, and the version the boot restored (-1 = booted fresh).
+        # Every reply carries shard_epoch so a client can detect the
+        # relaunch and run the reconnect protocol.
+        self._snapshotter = snapshotter
+        self._shard_epoch = int(shard_epoch)
+        self._restored_version = (
+            -1 if restored_version is None else int(restored_version)
+        )
+
+    @property
+    def shard_epoch(self):
+        return self._shard_epoch
+
+    def _reply(self, resp):
+        """Tag one reply dict with this incarnation's shard_epoch."""
+        resp["shard_epoch"] = self._shard_epoch
+        return resp
+
+    def _maybe_snapshot(self):
+        """Cadence hook, right after a version bump, OFF the apply path
+        (capture is a copy under the apply lock; disk IO is the
+        snapshotter's background thread)."""
+        if self._snapshotter is not None:
+            self._snapshotter.maybe_snapshot(
+                self._parameters, apply_lock=self._optimizer.apply_lock
+            )
+
+    def drain_snapshot(self):
+        """Final synchronous snapshot (the SIGTERM drain path): settle
+        queued cadence writes first so the drain snapshot publishes
+        newest-last, then capture+write whatever the store holds."""
+        if self._snapshotter is None:
+            return None
+        self._snapshotter.wait()
+        return self._snapshotter.snapshot_now(
+            self._parameters, apply_lock=self._optimizer.apply_lock
+        )
 
     # -- RPC methods --------------------------------------------------------
 
@@ -67,7 +109,7 @@ class PserverServicer:
         from elasticdl_tpu.rpc.wire_compression import compress_tensors
 
         if not self._parameters.initialized:
-            return {"model_init_status": False, "version": -1}
+            return self._reply({"model_init_status": False, "version": -1})
         lock = self._lock if not self._use_async else _NULL_LOCK
         with lock:
             named = self._parameters.to_named_arrays()
@@ -76,12 +118,12 @@ class PserverServicer:
             [Tensor(n, v) for n, v in sorted(named.items())],
             self._wire_dtype,
         )
-        return {
+        return self._reply({
             "model_init_status": True,
             "version": version,
             "params": params,
             "compressed_f32": compressed,
-        }
+        })
 
     def pull_embedding_vector(self, req):
         """Rows for req['ids'] of table req['name'] (lazy init).
@@ -93,12 +135,12 @@ class PserverServicer:
         version = self._parameters.version
         ids = np.asarray(req["ids"], dtype=np.int64)
         if ids.size == 0:
-            return {
+            return self._reply({
                 "rows": np.zeros((0, 0), np.float32),
                 "version": version,
-            }
+            })
         rows = self._parameters.get_embedding_param(req["name"], ids)
-        return {"rows": rows, "version": version}
+        return self._reply({"rows": rows, "version": version})
 
     def push_model(self, req):
         """First-write-wins model init (reference :70-79)."""
@@ -111,7 +153,7 @@ class PserverServicer:
             self._parameters.init_from_model(
                 req.get("version", 0), dense, infos
             )
-        return {}
+        return self._reply({})
 
     def push_embedding_info(self, req):
         with self._lock:
@@ -121,7 +163,7 @@ class PserverServicer:
                 )
                 for i in req.get("embedding_infos", [])
             )
-        return {}
+        return self._reply({})
 
     def push_gradient(self, req):
         """Sync/async gradient apply (reference :88-150)."""
@@ -133,7 +175,9 @@ class PserverServicer:
         )
         if self._use_async:
             self._apply(gradients, version)
-            return {"accepted": True, "version": self._parameters.version}
+            return self._reply(
+                {"accepted": True, "version": self._parameters.version}
+            )
 
         with self._lock:
             if version < self._parameters.version:
@@ -142,10 +186,10 @@ class PserverServicer:
                     version,
                     self._parameters.version,
                 )
-                return {
+                return self._reply({
                     "accepted": False,
                     "version": self._parameters.version,
-                }
+                })
             # AUDITED retention sites (docs/wire.md): sync accumulation
             # outlives this request, and the request's tensors are
             # zero-copy views into a wire buffer that may be a shm slot
@@ -186,7 +230,10 @@ class PserverServicer:
                 self._dense_sum.clear()
                 self._indexed_sum.clear()
                 self._grad_n = 0
-            return {"accepted": True, "version": self._parameters.version}
+                self._maybe_snapshot()
+            return self._reply(
+                {"accepted": True, "version": self._parameters.version}
+            )
 
     def _apply(self, gradients, request_version):
         # async applies consume the request's zero-copy views entirely
@@ -208,6 +255,26 @@ class PserverServicer:
         )
         with self._version_lock:
             self._parameters.version += 1
+        self._maybe_snapshot()
+
+    def ps_status(self, req):
+        """Shard liveness/identity probe (docs/ps_recovery.md).
+
+        Read-only and idempotent (edlint R9): clients probe it after a
+        data-plane failure to learn whether the shard came back as a
+        NEW incarnation (shard_epoch changed), how far its restored
+        state rolled back (version), and whether it needs the model
+        re-pushed (initialized False — relaunch with no snapshot)."""
+        return self._reply({
+            "version": self._parameters.version,
+            "initialized": bool(self._parameters.initialized),
+            "restored_version": self._restored_version,
+            "snapshot_every": (
+                self._snapshotter.every_versions
+                if self._snapshotter is not None
+                else 0
+            ),
+        })
 
     # -- rpc.core wiring ----------------------------------------------------
 
@@ -227,6 +294,7 @@ class PserverServicer:
                 "push_model": self.push_model,
                 "push_embedding_info": self.push_embedding_info,
                 "push_gradient": self.push_gradient,
+                "ps_status": self.ps_status,
             },
             role="ps",
         )

@@ -1,0 +1,430 @@
+"""PS shard durability: crash-consistent snapshots + relaunch restore.
+
+The elastic story covered only the worker plane: a dead worker's tasks
+requeue, but a relaunched PS pod booted with an EMPTY ``Parameters()``
+and ``init_from_model`` is first-write-wins — a mid-job PS crash reset
+that shard's trained dense params, embedding rows, and optimizer slot
+tables to step-0 init while everything else kept running ("Elastic
+Model Aggregation with Parameter Service", PAPERS.md 2204.03211, makes
+parameter-plane durability the precondition for elasticity). This
+module is the durability half of the recovery plane (docs/
+ps_recovery.md); the reconnect protocol lives in worker/ps_client.py.
+
+Design (the ShardedCheckpointManager discipline, per-shard):
+
+- **Submit-time capture.** ``maybe_snapshot`` copies the store's state
+  synchronously under the optimizer's apply lock
+  (``Parameters.snapshot_state``), so an in-flight snapshot never sees
+  a torn apply; only the disk IO rides the background
+  ``AsyncCheckpointer`` thread.
+- **Atomic publication.** Arrays + the versioned manifest are written
+  into a ``tmp-`` directory and ``os.replace``d to ``snap_v{N}`` in one
+  rename; the manifest is written last inside the temp dir, so a crash
+  mid-write leaves either a manifest-less temp (ignored and reclaimed
+  at boot) or nothing.
+- **Newest-valid restore.** Boot walks snapshot dirs newest first and
+  falls through on any load/validation error — a torn newest snapshot
+  must not wedge a restore while an older complete one sits behind it.
+- **Shard epochs.** Every boot mints a fresh ``shard_epoch`` (a boot
+  id, persisted as a counter when the shard has a durable dir) carried
+  in every RPC reply so clients can detect the relaunch and run the
+  reconnect protocol.
+
+Directory layout (one per shard; ``--ps_snapshot_dir/ps-{id}/``)::
+
+    epoch.json                  # boot counter (mint_shard_epoch)
+    snap_v{N}/
+      manifest.json             # version, dense names, table metadata
+      dense.npz                 # {name: float32 array}
+      table.{i}.npz             # ids + rows per embedding/slot table
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.utils import profiling
+
+_SNAP_PREFIX = "snap_v"
+_TMP_PREFIX = "tmp-"
+_MANIFEST = "manifest.json"
+_FORMAT_VERSION = 1
+
+
+def mint_shard_epoch(shard_dir=None):
+    """A fresh boot id for this PS incarnation, strictly different from
+    every previous one. With a durable ``shard_dir`` it is a persisted
+    counter (read, +1, atomic rewrite) so epochs stay small and
+    monotonic across relaunches; without one it falls back to a
+    time-derived id — still fresh per boot, just not dense."""
+    if not shard_dir:
+        return int(time.time_ns() % (1 << 53)) or 1
+    os.makedirs(shard_dir, exist_ok=True)
+    path = os.path.join(shard_dir, "epoch.json")
+    prev = 0
+    try:
+        with open(path) as f:
+            prev = int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError):
+        prev = 0
+    epoch = prev + 1
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": epoch}, f)
+    os.replace(tmp, path)
+    return epoch
+
+
+def _snapshot_versions(shard_dir):
+    """Versions with a published (renamed + manifest-bearing) dir."""
+    out = []
+    for d in glob.glob(os.path.join(shard_dir, _SNAP_PREFIX + "*")):
+        if not os.path.isfile(os.path.join(d, _MANIFEST)):
+            continue
+        try:
+            out.append(int(os.path.basename(d)[len(_SNAP_PREFIX):]))
+        except ValueError:
+            continue
+    return sorted(out)
+
+
+def write_shard_snapshot(shard_dir, state, ps_id=0, shard_epoch=0):
+    """Publish one captured ``Parameters.snapshot_state`` atomically.
+
+    Returns the published directory. Everything lands in a temp dir
+    first; the manifest is the LAST file written inside it, and the
+    single ``os.replace`` to ``snap_v{version}`` is the commit point —
+    readers either see a complete snapshot or none at all."""
+    version = int(state["version"])
+    final = os.path.join(shard_dir, "%s%d" % (_SNAP_PREFIX, version))
+    tmp = os.path.join(
+        shard_dir, "%s%s%d.%d" % (_TMP_PREFIX, _SNAP_PREFIX, version, os.getpid())
+    )
+    os.makedirs(tmp, exist_ok=True)
+    np.savez(
+        os.path.join(tmp, "dense.npz"),
+        **{name: arr for name, arr in state["dense"].items()}
+    )
+    tables_meta = []
+    for i, (name, snap) in enumerate(sorted(state["tables"].items())):
+        np.savez(
+            os.path.join(tmp, "table.%d.npz" % i),
+            ids=snap["ids"],
+            rows=snap["rows"],
+        )
+        tables_meta.append(
+            {
+                "name": name,
+                "file": "table.%d.npz" % i,
+                "dim": int(snap["dim"]),
+                "initializer": snap["initializer"],
+                "is_slot": bool(snap["is_slot"]),
+                "rows": int(np.asarray(snap["ids"]).size),
+            }
+        )
+    manifest = {
+        "format": _FORMAT_VERSION,
+        "version": version,
+        "initialized": bool(state.get("initialized", True)),
+        "ps_id": int(ps_id),
+        "shard_epoch": int(shard_epoch),
+        "dense": sorted(state["dense"]),
+        "tables": tables_meta,
+        "wrote_unix": round(time.time(), 3),
+    }
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+    if os.path.isdir(final):
+        # re-snapshot of the same version (e.g. a SIGTERM drain right
+        # after a cadence snapshot): the old dir must move out of the
+        # way for the rename to be atomic on every platform
+        _remove_dir(final)
+    os.replace(tmp, final)
+    return final
+
+
+def read_shard_snapshot(directory):
+    """Load one published snapshot dir back into snapshot_state form.
+
+    Raises on any missing/corrupt piece — callers fall through to the
+    next-older snapshot."""
+    with open(os.path.join(directory, _MANIFEST)) as f:
+        manifest = json.load(f)
+    with np.load(os.path.join(directory, "dense.npz")) as z:
+        dense = {name: z[name] for name in manifest["dense"]}
+    tables = {}
+    for meta in manifest["tables"]:
+        with np.load(os.path.join(directory, meta["file"])) as z:
+            ids, rows = z["ids"], z["rows"]
+        if ids.shape[0] != meta["rows"] or rows.shape[0] != meta["rows"]:
+            raise ValueError(
+                "snapshot table %s row count mismatch (%d ids, %d rows, "
+                "manifest %d)"
+                % (meta["name"], ids.shape[0], rows.shape[0], meta["rows"])
+            )
+        tables[meta["name"]] = {
+            "ids": ids,
+            "rows": rows,
+            "dim": meta["dim"],
+            "initializer": meta["initializer"],
+            "is_slot": meta["is_slot"],
+        }
+    return {
+        "version": int(manifest["version"]),
+        "initialized": bool(manifest.get("initialized", True)),
+        "dense": dense,
+        "tables": tables,
+    }
+
+
+def _remove_dir(directory):
+    for f in glob.glob(os.path.join(directory, "*")):
+        try:
+            os.remove(f)
+        except OSError:
+            pass
+    try:
+        os.rmdir(directory)
+    except OSError:
+        pass
+
+
+class ShardSnapshotter:
+    """Per-shard async snapshot manager for a ``ps.Parameters`` store.
+
+    ``every_versions`` > 0 enables cadence snapshots: the servicer calls
+    :meth:`maybe_snapshot` right after every optimizer version bump, and
+    every ``every_versions``-th version is captured (copy, synchronous,
+    under the caller-supplied apply lock) and written on the background
+    IO thread — the apply path never waits on disk. ``keep`` bounds
+    retention; eviction only ever runs after a NEWER snapshot published,
+    so the newest restorable state is never deleted.
+
+    The ``edl_ps_snapshot_age_seconds`` gauge (labeled by ps_id) reports
+    seconds since the last published snapshot — the live bound on how
+    much optimizer progress a crash right now would roll back.
+    """
+
+    def __init__(self, shard_dir, ps_id=0, every_versions=0, keep=2):
+        self._dir = shard_dir
+        self._ps_id = int(ps_id)
+        self._every = max(0, int(every_versions))
+        self._keep = max(1, int(keep))
+        self._mu = threading.Lock()
+        self._last_submitted = -1
+        self._last_published = -1.0  # unix time of last publish
+        self._shard_epoch = 0
+        self._async = None
+        # the age gauge is COLLECTOR-only (self._collect_age): a
+        # registered Gauge series written alongside it would emit a
+        # second sample under the same name+labels (stuck at its last
+        # .set value) and break strict Prometheus scrapes
+        if self._every:
+            os.makedirs(self._dir, exist_ok=True)
+            from elasticdl_tpu.common.async_checkpoint import (
+                AsyncCheckpointer,
+            )
+
+            self._async = AsyncCheckpointer(name="ps-snap-%d" % ps_id)
+            profiling.metrics.register_collector(self._collect_age)
+
+    @property
+    def every_versions(self):
+        return self._every
+
+    @property
+    def directory(self):
+        return self._dir
+
+    def set_shard_epoch(self, epoch):
+        # under _mu: the background IO thread reads it per write
+        with self._mu:
+            self._shard_epoch = int(epoch)
+
+    def is_enabled(self):
+        return bool(self._every) and self._async is not None
+
+    def _collect_age(self):
+        with self._mu:
+            last = self._last_published
+        if last <= 0:
+            return []
+        return [
+            (
+                "edl_ps_snapshot_age_seconds",
+                {"ps_id": str(self._ps_id)},
+                round(time.time() - last, 3),
+            )
+        ]
+
+    # -- the write side ------------------------------------------------------
+
+    def maybe_snapshot(self, parameters, apply_lock=None):
+        """Cadence hook, called right after a version bump.
+
+        Captures (synchronously, copies only) when the store's version
+        crossed the next cadence mark, then queues the disk write.
+        ``apply_lock``: the optimizer wrapper's apply lock — holding it
+        across the capture guarantees no apply is mid-flight, so the
+        snapshot is a consistent cut of rows + slots + dense params.
+        """
+        if not self.is_enabled():
+            return False
+        version = int(parameters.version)
+        with self._mu:
+            # interval trigger, NOT an exact-multiple check: in async
+            # mode the version bump and this hook are not atomic, so
+            # two concurrent applies can both observe the post-both
+            # version and an exact-multiple mark would be skipped —
+            # silently stretching the rollback bound past the cadence.
+            # version-since-last-capture >= every can never skip.
+            if version - max(0, self._last_submitted) < self._every:
+                return False
+            self._last_submitted = version
+        return self._snapshot(parameters, apply_lock)
+
+    def snapshot_now(self, parameters, apply_lock=None):
+        """Unconditional snapshot (the SIGTERM drain): capture whatever
+        the store holds right now, write it SYNCHRONOUSLY (the process
+        is about to exit — there is no background left to finish), and
+        publish. Returns the published dir or None when disabled."""
+        if not self.is_enabled():
+            return None
+        state = self._capture(parameters, apply_lock)
+        if not state.get("initialized"):
+            # a drain before the worker's first model push: there is
+            # nothing durable to save, and publishing an EMPTY snapshot
+            # would make the relaunch restore initialized=True with no
+            # params — first-write-wins would then ignore the worker's
+            # re-push forever
+            return None
+        with self._mu:
+            self._last_submitted = int(parameters.version)
+        return self._write(state)
+
+    def _capture(self, parameters, apply_lock):
+        import contextlib
+
+        lock = apply_lock if apply_lock is not None else contextlib.nullcontext()
+        with lock:
+            return parameters.snapshot_state()
+
+    def _snapshot(self, parameters, apply_lock):
+        state = self._capture(parameters, apply_lock)
+        if not state.get("initialized"):
+            return False  # nothing durable yet (see snapshot_now)
+
+        def _write():
+            self._write(state)
+
+        self._async.submit(_write, label="ps_snap_v%d" % state["version"])
+        return True
+
+    def _write(self, state):
+        t0 = time.perf_counter()
+        with self._mu:
+            epoch = self._shard_epoch
+        final = write_shard_snapshot(
+            self._dir, state, ps_id=self._ps_id, shard_epoch=epoch
+        )
+        with self._mu:
+            self._last_published = time.time()
+        self._evict()
+        profiling.events.emit(
+            "ps_shard_snapshot",
+            ps_id=self._ps_id,
+            version=state["version"],
+            write_s=round(time.perf_counter() - t0, 4),
+        )
+        logger.info(
+            "ps %d: published snapshot v%d to %s",
+            self._ps_id,
+            state["version"],
+            final,
+        )
+        return final
+
+    def _evict(self):
+        """Ring retention + temp-dir reclamation, on the IO thread.
+
+        A version is only evicted while a NEWER published snapshot
+        exists (the versions() list is publication-gated), so the last
+        restorable state always survives."""
+        versions = _snapshot_versions(self._dir)
+        while len(versions) > self._keep:
+            victim = versions.pop(0)
+            _remove_dir(
+                os.path.join(self._dir, "%s%d" % (_SNAP_PREFIX, victim))
+            )
+        for tmp in glob.glob(os.path.join(self._dir, _TMP_PREFIX + "*")):
+            # a crashed predecessor's torn write; never restorable
+            if os.path.isdir(tmp):
+                _remove_dir(tmp)
+
+    # -- the restore side ----------------------------------------------------
+
+    def restore_into(self, parameters):
+        """Boot-time restore: install the newest VALID snapshot.
+
+        Walks published versions newest first and falls through on any
+        read error (torn or corrupt snapshots are skipped, logged).
+        Returns the restored version, or None when nothing restorable
+        exists (fresh shard / durability disabled). A disabled
+        snapshotter (``--ps_snapshot_versions 0``) never restores even
+        when the directory holds a previous run's snapshots — booting a
+        durability-off job from stale state would silently ignore the
+        worker's model push (init is first-write-wins)."""
+        if not self.is_enabled():
+            return None
+        if not self._dir or not os.path.isdir(self._dir):
+            return None
+        t0 = time.perf_counter()
+        for version in reversed(_snapshot_versions(self._dir)):
+            directory = os.path.join(
+                self._dir, "%s%d" % (_SNAP_PREFIX, version)
+            )
+            try:
+                state = read_shard_snapshot(directory)
+            except Exception as err:  # noqa: BLE001 — fall through older
+                logger.warning(
+                    "ps %d: snapshot %s unreadable (%s); trying older",
+                    self._ps_id,
+                    directory,
+                    err,
+                )
+                continue
+            parameters.restore_state(state)
+            with self._mu:
+                self._last_submitted = version
+                self._last_published = time.time()
+            profiling.events.emit(
+                "ps_shard_restore_local",
+                ps_id=self._ps_id,
+                version=version,
+                restore_s=round(time.perf_counter() - t0, 4),
+            )
+            logger.info(
+                "ps %d: restored snapshot v%d (%d dense, %d tables)",
+                self._ps_id,
+                version,
+                len(state["dense"]),
+                len(state["tables"]),
+            )
+            return version
+        return None
+
+    def wait(self):
+        """Drain in-flight async writes (tests / pre-restore)."""
+        if self._async is not None:
+            self._async.wait()
+
+    def close(self):
+        if self._async is not None:
+            profiling.metrics.unregister_collector(self._collect_age)
+            self._async.close()
+            self._async = None

@@ -521,13 +521,28 @@ class ShmEndpointRegistry:
             ring.reclaim()
 
 
-def install_shm_endpoint(methods):
+def install_shm_endpoint(methods, hello_extra=None):
     """Wrap a ``{name: fn}`` RPC table with the shared-memory endpoint.
 
     Returns ``(methods, registry)`` where ``methods`` additionally
     serves ``transport_hello``; call ``registry.close()`` at server
-    stop to reclaim attached (including orphaned) rings."""
+    stop to reclaim attached (including orphaned) rings.
+
+    ``hello_extra``: extra fields merged into every hello reply —
+    the PS serves its ``shard_epoch`` boot id here so a reconnecting
+    co-located client learns the incarnation at negotiation time,
+    before its first data-plane round (docs/ps_recovery.md)."""
     registry = ShmEndpointRegistry()
     wrapped = {name: registry.wrap(fn) for name, fn in methods.items()}
-    wrapped["transport_hello"] = registry.hello
+    if hello_extra:
+        extra = dict(hello_extra)
+
+        def hello(req):
+            resp = dict(registry.hello(req) or {})
+            resp.update(extra)
+            return resp
+
+        wrapped["transport_hello"] = hello
+    else:
+        wrapped["transport_hello"] = registry.hello
     return wrapped, registry

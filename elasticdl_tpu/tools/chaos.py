@@ -1,0 +1,280 @@
+"""Deterministic scripted fault plane for PS-fleet chaos drives.
+
+The recovery plane (docs/ps_recovery.md) is only trustworthy if it is
+EXERCISED: this module turns "kill a pod and hope" into scripted,
+seeded, replayable fault schedules at two levels, matching the two ways
+tests drive the PS data plane (tests/fake_ps.py):
+
+- :class:`ScriptedFaultPS` wraps any in-process PS-interface object
+  with a deterministic per-call fault script — delay / partition
+  (error) / reject windows keyed by call index, and kill-at-version
+  keyed by the shard's reported optimizer version. Chaos tests use it
+  to replay exact interleavings (a partition window that opens during
+  an in-flight push, a kill exactly at a snapshot boundary).
+- :class:`FleetChaos` drives REAL fleets: a poller watches each
+  shard's ``ps_status`` version and executes :class:`ChaosOp` entries
+  (SIGKILL / SIGTERM at version) against a
+  :class:`~elasticdl_tpu.master.local_instance_manager.
+  LocalInstanceManager` — or any object with ``kill_ps``/
+  ``terminate_ps`` — logging every executed op for post-run asserts.
+  ``bench.py --chaos`` uses the same schedule format with its own
+  process management.
+
+:func:`seeded_schedule` derives a reproducible schedule from a seed so
+a failing chaos run is a (seed, schedule) pair anyone can replay.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class ChaosPartitionError(RuntimeError):
+    """Raised by a ScriptedFaultPS call landing in a partition window
+    (the in-process stand-in for a dead/unreachable pod; the real-RPC
+    analog is UNAVAILABLE/DEADLINE_EXCEEDED surfacing as PSRpcError)."""
+
+
+class ChaosOp:
+    """One scripted fault.
+
+    ``kind``: ``"kill"`` (SIGKILL, no drain) / ``"term"`` (SIGTERM,
+    drain snapshot + exit 75) for the fleet level; ``"delay"`` /
+    ``"partition"`` / ``"reject"`` for the in-process call level.
+    ``shard``: target PS id. ``at_version``: fleet ops fire when the
+    shard's reported version reaches this. ``at_call``/``n_calls``:
+    call-level ops apply to calls ``[at_call, at_call + n_calls)`` of
+    the wrapped shard. ``delay_s``: sleep for ``delay`` ops.
+    """
+
+    __slots__ = ("kind", "shard", "at_version", "at_call", "n_calls",
+                 "delay_s")
+
+    def __init__(self, kind, shard, at_version=None, at_call=None,
+                 n_calls=1, delay_s=0.0):
+        if kind not in ("kill", "term", "delay", "partition", "reject"):
+            raise ValueError("unknown chaos op kind %r" % kind)
+        self.kind = kind
+        self.shard = int(shard)
+        self.at_version = at_version
+        self.at_call = at_call
+        self.n_calls = int(n_calls)
+        self.delay_s = float(delay_s)
+
+    def __repr__(self):
+        return (
+            "ChaosOp(%r, shard=%d, at_version=%r, at_call=%r, "
+            "n_calls=%d, delay_s=%g)"
+            % (self.kind, self.shard, self.at_version, self.at_call,
+               self.n_calls, self.delay_s)
+        )
+
+
+def seeded_schedule(seed, num_ps, kinds=("kill",), max_version=16,
+                    n_ops=1):
+    """A reproducible fleet schedule: ``n_ops`` ops drawn from
+    ``kinds``, each targeting a seeded shard at a seeded version in
+    ``[2, max_version]``. Same seed -> same schedule, forever."""
+    rng = np.random.default_rng(seed)
+    ops = []
+    for _ in range(n_ops):
+        ops.append(
+            ChaosOp(
+                str(rng.choice(list(kinds))),
+                int(rng.integers(num_ps)),
+                at_version=int(rng.integers(2, max_version + 1)),
+            )
+        )
+    return ops
+
+
+class ScriptedFaultPS:
+    """Deterministic in-process fault wrapper (the chaos-test twin of
+    tests/fake_ps.FaultyPS, with windowed + version-keyed faults).
+
+    Call indices count EVERY forwarded method call of this shard, in
+    arrival order; with the client's fan-out pool a test that needs
+    exact windows drives the client single-threaded (fanout=False) or
+    keys faults on ``at_version`` instead. ``kill`` ops raise
+    :class:`ChaosPartitionError` from the first call AT/after the
+    shard's reported version crossing ``at_version`` — permanently,
+    until :meth:`revive` (the relaunch) is called.
+    """
+
+    def __init__(self, inner, ops=(), shard=0):
+        self._inner = inner
+        self._shard = shard
+        self._ops = [op for op in ops if op.shard == shard]
+        self._mu = threading.Lock()
+        self._n_calls = 0
+        self._killed = False
+        # version-keyed kill/term ops fire ONCE: without the latch,
+        # revive() would be re-killed immediately whenever the restored
+        # incarnation's version is still >= at_version (a cadence
+        # snapshot can publish exactly at the kill version)
+        self._fired = set()  # id(op) of executed one-shot ops
+        self.executed = []  # (op, call_index) log for asserts
+
+    def revive(self, inner=None):
+        """The relaunch: clear the kill latch (and optionally swap in
+        the restored incarnation's servicer)."""
+        with self._mu:
+            self._killed = False
+            if inner is not None:
+                self._inner = inner
+
+    @property
+    def inner(self):
+        return self._inner
+
+    def _version(self):
+        try:
+            status = self._inner.ps_status({})
+            return int(status.get("version", -1))
+        except Exception:  # noqa: BLE001 — stub without ps_status
+            return -1
+
+    def _forward(self, method, req):
+        if method == "ps_status":
+            # the reconnect protocol probes ps_status after every
+            # data-plane failure; letting probes consume call indices
+            # (or trip windowed faults) would make the scripted windows
+            # depend on how many probes the client happened to issue.
+            # The kill latch still applies — a dead pod answers nothing.
+            with self._mu:
+                if self._killed:
+                    raise ChaosPartitionError(
+                        "shard %d is killed (chaos script)" % self._shard
+                    )
+            return self._inner.ps_status(req)
+        with self._mu:
+            n = self._n_calls
+            self._n_calls += 1
+            killed = self._killed
+        if killed:
+            raise ChaosPartitionError(
+                "shard %d is killed (chaos script)" % self._shard
+            )
+        version = None
+        reject_op = None
+        for op in self._ops:
+            in_call_window = (
+                op.at_call is not None
+                and op.at_call <= n < op.at_call + op.n_calls
+            )
+            if op.kind in ("kill", "term") and op.at_version is not None:
+                if id(op) in self._fired:
+                    continue
+                if version is None:
+                    version = self._version()
+                if version >= op.at_version:
+                    with self._mu:
+                        self._killed = True
+                        self._fired.add(id(op))
+                    self.executed.append((op, n))
+                    raise ChaosPartitionError(
+                        "shard %d killed at version %d (chaos script %r)"
+                        % (self._shard, version, op)
+                    )
+            elif op.kind == "partition" and in_call_window:
+                self.executed.append((op, n))
+                raise ChaosPartitionError(
+                    "shard %d partitioned for call %d (chaos script %r)"
+                    % (self._shard, n, op)
+                )
+            elif op.kind == "delay" and in_call_window:
+                self.executed.append((op, n))
+                time.sleep(op.delay_s)
+            elif op.kind == "reject" and in_call_window:
+                reject_op = op
+        resp = getattr(self._inner, method)(req)
+        if reject_op is not None and method == "push_gradient":
+            self.executed.append((reject_op, n))
+            resp = dict(resp)
+            resp["accepted"] = False
+        return resp
+
+    def __getattr__(self, method):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(req):
+            return self._forward(method, req)
+
+        return call
+
+
+class FleetChaos:
+    """Executes a fleet-level schedule against live PS processes.
+
+    ``manager``: anything with ``kill_ps(id)`` / ``terminate_ps(id)``
+    (the LocalInstanceManager, or bench.py's own process table via a
+    small adapter). ``status_fn(shard) -> dict`` reads the shard's
+    ``ps_status`` (version + epoch); the poller fires each op ONCE when
+    its shard's version first reaches ``at_version``, then logs it in
+    :attr:`executed`. Deterministic given a deterministic version
+    stream: the op fires at the first poll observing the crossing, and
+    the at-version trigger itself does not depend on wall clock.
+    """
+
+    def __init__(self, manager, status_fn, schedule, poll_s=0.1):
+        self._manager = manager
+        self._status_fn = status_fn
+        self._schedule = list(schedule)
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread = None
+        self.executed = []  # (op, observed_version, unix_time)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="edl-fleet-chaos", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self):
+        pending = [
+            op for op in self._schedule if op.kind in ("kill", "term")
+        ]
+        while pending and not self._stop.is_set():
+            for op in list(pending):
+                try:
+                    status = self._status_fn(op.shard) or {}
+                except Exception:  # noqa: BLE001 — shard busy/down
+                    logger.debug(
+                        "chaos: status probe of shard %d failed; "
+                        "polling again",
+                        op.shard,
+                        exc_info=True,
+                    )
+                    continue
+                version = int(status.get("version", -1))
+                if op.at_version is not None and version >= op.at_version:
+                    logger.warning(
+                        "chaos: executing %r (observed version %d)",
+                        op,
+                        version,
+                    )
+                    if op.kind == "kill":
+                        self._manager.kill_ps(op.shard)
+                    else:
+                        self._manager.terminate_ps(op.shard)
+                    self.executed.append((op, version, time.time()))
+                    pending.remove(op)
+            self._stop.wait(self._poll_s)
+
+    def done(self):
+        """True once every scheduled fleet op has executed."""
+        return len(self.executed) == len(
+            [op for op in self._schedule if op.kind in ("kill", "term")]
+        )
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -912,8 +912,15 @@ RPC_IDEMPOTENT = frozenset(
         "pull_dense",
         "push_model",
         # shm ring negotiation (rpc/shm_transport): re-sending a hello
-        # re-registers the same ring (the registry pops the old attach)
+        # re-registers the same ring (the registry pops the old attach);
+        # the reply also carries the serving shard's boot epoch
+        # (docs/ps_recovery.md)
         "transport_hello",
+        # recovery-plane probe (ps/servicer.ps_status): a pure read of
+        # shard identity/version/initialized — replaying it is
+        # harmless, and the reconnect protocol NEEDS it retriable (it
+        # probes shards that just died)
+        "ps_status",
     )
 )
 RPC_NON_IDEMPOTENT = frozenset(

@@ -17,6 +17,7 @@ internal pools only ever run the per-shard legs and the queued pushes.
 """
 
 import threading
+import time
 from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -46,6 +47,7 @@ class PSClient:
         fanout=True,
         push_inflight=0,
         cache=None,
+        on_shard_reset=None,
     ):
         """``ps_stubs``: list of objects exposing the Pserver dict-RPC
         methods — rpc.core Clients bound with ``BoundPS`` below, or
@@ -99,11 +101,200 @@ class PSClient:
         # combined outcome of async pushes reaped since the last drain
         self._reaped_accepted = True
         self._last_push_version = -1
+        # -- reconnect protocol state (docs/ps_recovery.md) --
+        # Every PS reply carries the serving incarnation's shard_epoch
+        # (a boot id). A changed epoch means the shard died and came
+        # back — possibly restored to an OLDER version — and this
+        # client runs the reconnect protocol: invalidate that shard's
+        # cache entries, abandon the in-flight push window (the
+        # non-idempotent pushes raced the dead incarnation; they are
+        # dropped, never resent), and re-push model/embedding infos if
+        # the shard reports uninitialized. Detection can happen on
+        # fan-out/push threads, so the state rides its own lock.
+        self._epoch_mu = threading.Lock()
+        self._shard_epochs = {}  # shard -> last seen shard_epoch
+        self._seen_versions = {}  # shard -> newest version seen
+        self._reset_gen = 0  # bumps at every detected epoch change
+        self._shard_fail_t = {}  # shard -> first-failure monotonic time
+        self._last_probe_t = {}  # shard -> last ps_status probe time
+        self._needs_reinit = set()  # shards reporting uninitialized
+        self._on_shard_reset = on_shard_reset
 
     @property
     def hot_row_cache(self):
         """The HotRowCache (None when disabled) — stats live on it."""
         return self._cache
+
+    # -- the reconnect protocol (docs/ps_recovery.md) -----------------------
+
+    def set_on_shard_reset(self, callback):
+        """``callback(shards)`` runs on the next data-plane call after a
+        relaunched shard reported UNINITIALIZED state (relaunch with no
+        snapshot to restore): the worker re-pushes its model + embedding
+        infos (first-write-wins, so live shards ignore the re-push)."""
+        self._on_shard_reset = callback
+
+    @property
+    def shard_epochs(self):
+        """{shard: last seen shard_epoch} (diagnostics/tests)."""
+        with self._epoch_mu:
+            return dict(self._shard_epochs)
+
+    def _note_shard_reply(self, shard, resp):
+        """Track the replying incarnation; run the reset protocol on an
+        epoch change. Called from whichever thread processed the reply
+        (worker, fan-out, or push driver) — state rides _epoch_mu, and
+        the cache invalidation happens outside it (the cache has its
+        own lock; nesting would add a lock-order edge for nothing)."""
+        if not isinstance(resp, dict):
+            return
+        epoch = resp.get("shard_epoch")
+        if epoch is None:
+            return
+        version = resp.get("version")
+        with self._epoch_mu:
+            prev = self._shard_epochs.get(shard)
+            if prev is not None and epoch < prev:
+                # a DELAYED reply from the dead incarnation (its fan-out
+                # leg resolved after the relaunch was already detected):
+                # epochs are monotonic per shard, so this is stale —
+                # recording it would regress the epoch and spuriously
+                # re-run the reset against the live incarnation
+                return
+            self._shard_epochs[shard] = epoch
+            changed = prev is not None and epoch > prev
+            seen = self._seen_versions.get(shard, -1)
+            if changed:
+                self._reset_gen += 1
+                # re-anchor the version clock at the restored value:
+                # the dead incarnation's high-water mark is void
+                self._seen_versions[shard] = (
+                    int(version) if version is not None else -1
+                )
+                if (
+                    resp.get("initialized") is False
+                    or resp.get("model_init_status") is False
+                ):
+                    self._needs_reinit.add(shard)
+                fail_t = self._shard_fail_t.pop(shard, None)
+            else:
+                if version is not None and int(version) > seen:
+                    self._seen_versions[shard] = int(version)
+                # a healthy reply clears any stale failure stamp
+                self._shard_fail_t.pop(shard, None)
+        if not changed:
+            return
+        rollback = max(
+            0, seen - (int(version) if version is not None else seen)
+        )
+        dropped = 0
+        if self._cache is not None:
+            dropped = self._cache.invalidate_shard(shard, version=version)
+        from elasticdl_tpu.utils import profiling
+
+        profiling.events.emit(
+            "ps_shard_restore",
+            shard=shard,
+            old_epoch=prev,
+            new_epoch=epoch,
+            version=version,
+            rollback_depth=rollback,
+            cache_rows_invalidated=dropped,
+            restore_latency_s=(
+                round(time.monotonic() - fail_t, 3)
+                if fail_t is not None
+                else None
+            ),
+        )
+        from elasticdl_tpu.common.log_utils import default_logger
+
+        default_logger.warning(
+            "PS shard %s relaunched (epoch %s -> %s): version rolled "
+            "back %d to %s; %d cached rows invalidated, in-flight push "
+            "window abandoned",
+            shard,
+            prev,
+            epoch,
+            rollback,
+            version,
+            dropped,
+        )
+
+    def _note_shard_failures(self, shard_keys):
+        """Stamp first-failure times and probe the failing shards'
+        status (idempotent ``ps_status``): a shard that already came
+        back as a new incarnation is detected HERE — before the retry
+        machinery re-runs the batch — so the cache/window reset happens
+        ahead of the next pull, and an uninitialized relaunch gets
+        flagged for the model re-push instead of erroring forever on
+        its empty store."""
+        shards = set()
+        for key in shard_keys:
+            shard = key[1] if isinstance(key, tuple) else key
+            if isinstance(shard, (int, np.integer)):
+                shards.add(int(shard))
+        now = time.monotonic()
+        with self._epoch_mu:
+            for shard in shards:
+                self._shard_fail_t.setdefault(shard, now)
+            # throttle: the probe pays the data-plane deadline/retry
+            # budget against a possibly-dead endpoint, and failures can
+            # arrive once per minibatch — probing each shard at most
+            # once per second bounds the added failure-path latency
+            # without delaying relaunch detection meaningfully
+            shards = {
+                s
+                for s in shards
+                if now - self._last_probe_t.get(s, -10.0) >= 1.0
+            }
+            for shard in shards:
+                self._last_probe_t[shard] = now
+        for shard in shards:
+            try:
+                status = self._ps[shard].ps_status({})
+            except Exception:  # noqa: BLE001 — still down
+                from elasticdl_tpu.common.log_utils import default_logger
+
+                default_logger.debug(
+                    "ps_status probe of shard %s failed (still down); "
+                    "the next data-plane failure re-probes",
+                    shard,
+                    exc_info=True,
+                )
+                continue
+            self._note_shard_reply(shard, status)
+            if isinstance(status, dict):
+                release_message(status)
+
+    def _gen_snapshot(self):
+        with self._epoch_mu:
+            return self._reset_gen
+
+    def _service_reinit(self):
+        """Run the worker's re-push callback for shards that came back
+        empty. Runs on the thread entering a data-plane call (the
+        worker thread, or the prefetch pipeline's pull thread — both
+        only READ the model pytree, and push_model is first-write-wins
+        on every shard, so a racing re-push is harmless)."""
+        with self._epoch_mu:
+            if not self._needs_reinit:
+                return
+            shards = sorted(self._needs_reinit)
+            self._needs_reinit.clear()
+        cb = self._on_shard_reset
+        if cb is None:
+            return
+        try:
+            cb(shards)
+        except Exception:
+            # a transient re-push failure (the shard still flapping)
+            # must not LOSE the flag — nothing re-adds it until another
+            # epoch change, and the empty store would wedge every later
+            # pull. Re-arm and let the failure surface normally (the
+            # task retry re-enters here).
+            with self._epoch_mu:
+                self._needs_reinit.update(shards)
+            raise
 
     @property
     def num_ps(self):
@@ -146,7 +337,15 @@ class PSClient:
         if not calls:
             return {}
         if not self._fanout_enabled or len(calls) == 1:
-            return {shard: thunk() for shard, thunk in calls}
+            try:
+                return {shard: thunk() for shard, thunk in calls}
+            except Exception:  # noqa: BLE001 — probe, then re-raise
+                # serial legs run in-line, so the failing shard is not
+                # attributable here — probe every shard of the call
+                # (ps_status is an idempotent read; a healthy shard's
+                # probe just refreshes its epoch record)
+                self._note_shard_failures([shard for shard, _ in calls])
+                raise
         pool = self._get_fanout_pool()
         futs = [(shard, pool.submit(thunk)) for shard, thunk in calls]
         results, errors = {}, []
@@ -157,6 +356,9 @@ class PSClient:
                 errors.append((shard, err))
         if errors:
             errors.sort(key=lambda pair: pair[0])
+            # reconnect protocol: stamp + probe the failing shards so a
+            # relaunched incarnation is detected before the retry runs
+            self._note_shard_failures([shard for shard, _ in errors])
             raise errors[0][1]
         return results
 
@@ -213,7 +415,10 @@ class PSClient:
             calls.append(
                 (shard, lambda ps=ps, req=req: ps.push_model(req))
             )
-        for resp in self._run_sharded(calls).values():
+        for shard, resp in self._run_sharded(calls).items():
+            # the earliest epoch baseline: a later reply with a
+            # DIFFERENT epoch is then a detectable relaunch
+            self._note_shard_reply(shard, resp)
             release_message(resp)
 
     def push_embedding_info(self, embedding_infos):
@@ -232,7 +437,8 @@ class PSClient:
                 for shard, ps in enumerate(self._ps)
             ]
         )
-        for resp in resps.values():
+        for shard, resp in resps.items():
+            self._note_shard_reply(shard, resp)
             release_message(resp)
 
     def pull_dense(self):
@@ -248,6 +454,7 @@ class PSClient:
         """
         from elasticdl_tpu.rpc.wire_compression import decompress_tensors
 
+        self._service_reinit()
         self.drain()
         resps = self._run_sharded(
             [
@@ -260,6 +467,7 @@ class PSClient:
         try:
             for shard in range(self.num_ps):
                 resp = resps[shard]
+                self._note_shard_reply(shard, resp)
                 if not resp.get("model_init_status"):
                     return False, -1, {}
                 versions.append(resp["version"])
@@ -315,6 +523,7 @@ class PSClient:
                 t.values, t.indices, self.num_ps
             ).items():
                 reqs[shard].append(Tensor(t.name, values, indices=ids))
+        self._service_reinit()
         if self._push_inflight <= 0:
             return self._push_shards(reqs, version)
         while len(self._pending_pushes) >= self._push_inflight:
@@ -331,8 +540,14 @@ class PSClient:
                     thread_name_prefix="edl-ps-push",
                 )
             push_pool = self._push_pool
+        # each queued push remembers the reset generation it was
+        # submitted under: an epoch change detected before the reap
+        # ABANDONS it (outcome dropped, failure swallowed, never
+        # resent) — the window raced a dead incarnation and resolving
+        # it against the restored one would double-count or wedge
         self._pending_pushes.append(
-            push_pool.submit(self._push_shards, reqs, version)
+            (push_pool.submit(self._push_shards, reqs, version),
+             self._gen_snapshot())
         )
         return True, self._last_push_version
 
@@ -362,6 +577,7 @@ class PSClient:
         accepted, out_version = True, None
         for shard in range(self.num_ps):
             resp = resps[shard]
+            self._note_shard_reply(shard, resp)
             accepted = accepted and bool(resp["accepted"])
             out_version = (
                 resp["version"]
@@ -376,8 +592,34 @@ class PSClient:
             release_message(resp)  # scalar reply: its shm slot recycles
         return accepted, (-1 if out_version is None else out_version)
 
-    def _reap_push(self, fut):
-        accepted, version = fut.result()
+    def _reap_push(self, entry):
+        fut, gen = entry
+        try:
+            accepted, version = fut.result()
+        except Exception as err:  # noqa: BLE001 — re-raise unless abandoned
+            if self._gen_snapshot() != gen:
+                # epoch-abandonment: this push was in flight across a
+                # shard relaunch. Its gradient is part of the bounded
+                # rollback the restore already priced in; resending a
+                # non-idempotent push could double-apply on shards
+                # whose leg DID land, so the whole push is dropped.
+                from elasticdl_tpu.common.log_utils import default_logger
+                from elasticdl_tpu.utils import profiling
+
+                profiling.events.emit(
+                    "ps_push_window_dropped", reason=str(err)[:200]
+                )
+                default_logger.warning(
+                    "in-flight gradient push abandoned across a PS "
+                    "shard relaunch (dropped, not resent): %s",
+                    err,
+                )
+                return True, -1
+            raise
+        if self._gen_snapshot() != gen:
+            # the push resolved, but against a mix of incarnations: its
+            # combined accepted/version verdict is void — ignore it
+            return True, -1
         self._reaped_accepted = self._reaped_accepted and accepted
         if version >= 0:
             self._last_push_version = max(
@@ -392,9 +634,13 @@ class PSClient:
         since the previous drain — ``accepted`` is False if ANY shard
         of any push rejected, ``version`` is the newest version any
         push response reported (-1 when nothing completed). A shard
-        failure (e.g. deadline expiry on a dead pod) re-raises here.
-        Called automatically by ``pull_dense``; the worker also calls
-        it at task boundaries, before eval, and before checkpoints.
+        failure (e.g. deadline expiry on a dead pod) re-raises here —
+        UNLESS the failing push was abandoned by the reconnect protocol
+        (submitted before a detected shard relaunch): abandoned pushes
+        are dropped silently, never resent, and never wedge the drain
+        (docs/ps_recovery.md). Called automatically by ``pull_dense``;
+        the worker also calls it at task boundaries, before eval, and
+        before checkpoints.
         """
         while self._pending_pushes:
             self._reap_push(self._pending_pushes.popleft())
@@ -431,6 +677,7 @@ class PSClient:
         (the worker's batch prepare pulls all layers through here).
         Semantics per table are exactly :meth:`pull_embedding_vectors`;
         responses merge in sorted (table, shard) order."""
+        self._service_reinit()
         state = {}
         calls = []
         for name in ids_by_name:
@@ -482,6 +729,7 @@ class PSClient:
         resps = self._run_sharded(calls)
         for name, shard in sorted(resps):
             resp = resps[(name, shard)]
+            self._note_shard_reply(shard, resp)
             st = state[name]
             positions = st["positions"][shard]
             got = np.asarray(resp["rows"], dtype=np.float32)

@@ -154,6 +154,17 @@ class Worker:
             if ps_client is not None
             else MasterStorePlane(lambda: self._stub)
         )
+        if ps_client is not None and hasattr(
+            ps_client, "set_on_shard_reset"
+        ):
+            # reconnect protocol (docs/ps_recovery.md): a relaunched PS
+            # shard that came back EMPTY (no snapshot to restore) gets
+            # the model + embedding infos re-pushed before the next
+            # data-plane round — push_model is first-write-wins per
+            # shard, so live shards ignore it. Without this, a hybrid
+            # worker (which never pulls dense) would error forever
+            # against the empty store.
+            ps_client.set_on_shard_reset(self._on_ps_shard_reset)
         if embedding_prefetch is None:
             # the overlapped pull pays off exactly when the dense half
             # no longer serializes on the PS (hybrid); the classic PS
@@ -300,6 +311,16 @@ class Worker:
                 "get_model before local variable creation"
             )
         self._model_version = got_version
+
+    def _on_ps_shard_reset(self, shards):
+        """PSClient reconnect hook: shards came back uninitialized."""
+        if self._var_created and self._params is not None:
+            logger.warning(
+                "re-pushing model + embedding infos after PS shard(s) "
+                "%s relaunched without restorable state",
+                shards,
+            )
+            self.report_variable()
 
     def report_variable(self):
         named = pytree_to_named_arrays(self._params)

@@ -90,6 +90,8 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_edlint.py \
     tests/test_wire.py \
     tests/test_comm_plane.py \
+    tests/test_ps_snapshot.py \
+    tests/test_chaos.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 echo "check.sh: all gates green"

@@ -67,6 +67,8 @@ _LOCKTRACE_SUITES = {
     "test_telemetry",
     "test_wire",
     "test_comm_plane",
+    "test_ps_snapshot",
+    "test_chaos",
 }
 
 

@@ -1219,6 +1219,41 @@ def test_r9_comm_plane_call_sites(tmp_path):
     assert not good
 
 
+def test_r9_recovery_plane_rpcs_classified(tmp_path):
+    """ISSUE 10's recovery-plane RPCs carry explicit idempotency
+    decisions: ``ps_status`` (the reconnect probe) and
+    ``transport_hello`` (whose reply now carries the shard's boot
+    epoch) are reads — retriable is the DESIGN (the probe targets
+    shards that just died); any new restore-flavored RPC without a
+    classification stays a finding."""
+    good = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class ShardProbe:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=2.0, retries=2)\n"
+        "    def probe(self):\n"
+        "        return self._client.call('ps_status')\n"
+        "    def hello(self, req):\n"
+        "        return self._client.call('transport_hello', **req)\n",
+        relpath="elasticdl_tpu/worker/probe_fixture.py",
+    )
+    assert not good
+    # a hypothetical restore RPC that skipped the classification table
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class ShardProbe:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr)\n"
+        "    def restore(self):\n"
+        "        return self._client.call('ps_restore_state')\n",
+        relpath="elasticdl_tpu/worker/probe_fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "unclassified" in bad[0].message
+
+
 def test_r9_unclassified_rpc_is_a_finding(tmp_path):
     bad = _lint(
         tmp_path,

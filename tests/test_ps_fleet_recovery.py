@@ -1,0 +1,351 @@
+"""Live-fleet PS crash recovery: SIGKILL a real PS process, relaunch
+it same-id/same-port, and observe what workers see (docs/ps_recovery.md).
+
+Two regimes over real loopback gRPC, both driven through the worker's
+own data-plane client (PSClient + BoundPS):
+
+- **No durability flags** (the seed behavior, kept as the documented
+  no-snapshot contract): the relaunched shard boots EMPTY — it reports
+  uninitialized and a worker's re-push re-initializes dense params
+  while trained embedding rows are silently gone. This is the hazard
+  ISSUE 10 pinned before the recovery plane landed.
+- **With ``--ps_snapshot_versions``/``--ps_snapshot_dir``**: the
+  relaunched shard restores the newest snapshot BEFORE serving, mints
+  a fresh shard_epoch, and the client's reconnect protocol fires —
+  epoch change detected, that shard's hot-row cache entries
+  invalidated, ``ps_shard_restore`` telemetry with a bounded rollback.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+from elasticdl_tpu.utils import profiling
+from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+from tests.fake_ps import free_port
+from tests.test_utils import MODEL_ZOO_PATH
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL_DEF = "mnist_subclass.mnist_subclass.CustomModel"
+
+
+def _ps_cmd(ps_id, port, extra=()):
+    return [
+        sys.executable,
+        "-m",
+        "elasticdl_tpu.ps.main",
+        "--ps_id", str(ps_id),
+        "--port", str(port),
+        "--model_zoo", MODEL_ZOO_PATH,
+        "--model_def", MODEL_DEF,
+        "--use_async", "true",
+        "--grads_to_wait", "1",
+    ] + list(extra)
+
+
+def _spawn_ps(ps_id, port, extra=(), log_dir=None):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    out = subprocess.DEVNULL
+    if log_dir:
+        out = open(os.path.join(log_dir, "ps-%d.log" % ps_id), "ab")
+    proc = subprocess.Popen(
+        _ps_cmd(ps_id, port, extra), env=env, stdout=out, stderr=out
+    )
+    if log_dir:
+        out.close()
+    return proc
+
+
+def _wait_port(proc, port, timeout=90):
+    deadline = time.time() + timeout
+    while True:
+        assert proc.poll() is None, (
+            "PS exited rc=%d at boot" % proc.returncode
+        )
+        try:
+            with socket.create_connection(("localhost", port), 1.0):
+                return
+        except OSError:
+            assert time.time() < deadline, "PS did not come up"
+            time.sleep(0.2)
+
+
+def _stop(procs):
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def _client(ports, **kw):
+    return PSClient(
+        [
+            BoundPS(
+                "localhost:%d" % p,
+                deadline_s=5.0,
+                retries=2,
+                backoff_s=0.2,
+            )
+            for p in ports
+        ],
+        **kw
+    )
+
+
+def _train_fleet(client, n_pushes=4):
+    """Init the fleet and push a few sparse+dense gradients; returns
+    (dense snapshot, trained embedding rows, per-shard versions)."""
+    client.push_model(
+        {
+            "w_a": np.full((3, 3), 1.5, np.float32),
+            "w_b": np.full((2, 4), -0.5, np.float32),
+        },
+        [EmbeddingTableInfo("emb", 4)],
+    )
+    ids = np.arange(8, dtype=np.int64)
+    client.pull_embedding_vectors("emb", ids)  # materialize rows
+    for i in range(n_pushes):
+        client.push_gradient(
+            {"w_a": np.full((3, 3), 0.125, np.float32)},
+            [
+                Tensor(
+                    "emb",
+                    np.ones((8, 4), np.float32),
+                    indices=ids,
+                )
+            ],
+            i,
+        )
+    client.drain()
+    ok, version, dense = client.pull_dense()
+    assert ok and version >= 1
+    rows = client.pull_embedding_vectors("emb", ids)
+    return dense, rows, version
+
+
+def test_sigkill_without_durability_resets_shard_state(tmp_path):
+    """The pre-recovery-plane hazard, pinned as the documented
+    no-durability behavior: a SIGKILLed+relaunched shard reports
+    UNINITIALIZED and its trained state is gone."""
+    ports = [free_port(), free_port()]
+    procs = [_spawn_ps(i, p, log_dir=str(tmp_path)) for i, p in enumerate(ports)]
+    try:
+        for proc, port in zip(procs, ports):
+            _wait_port(proc, port)
+        client = _client(ports)
+        try:
+            _train_fleet(client)
+        finally:
+            client.close()
+
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        procs[0] = _spawn_ps(0, ports[0], log_dir=str(tmp_path))
+        _wait_port(procs[0], ports[0])
+
+        probe = _client([ports[0]])
+        try:
+            status = probe._ps[0].ps_status({})
+            assert status["initialized"] is False
+            assert status["restored_version"] == -1
+            resp = probe._ps[0].pull_variable({})
+            # the shard lost everything: it answers exactly like a
+            # freshly booted, never-pushed instance
+            assert resp["model_init_status"] is False
+            assert resp["version"] == -1
+        finally:
+            probe.close()
+    finally:
+        _stop(procs)
+
+
+def test_sigkill_with_durability_restores_and_reconnects(tmp_path):
+    """The recovery plane end to end on a live 2-shard fleet: the
+    relaunched shard restores its snapshot before serving, and the
+    worker-side client detects the new incarnation — cache entries for
+    that shard invalidated, ps_shard_restore emitted with a rollback
+    bounded by the cadence."""
+    snap_dir = str(tmp_path / "snaps")
+    tport = free_port()
+    extra = [
+        "--ps_snapshot_versions", "1",
+        "--ps_snapshot_dir", snap_dir,
+    ]
+    # only shard 0 serves the (per-pod) telemetry endpoint in this
+    # test; a shared port would fail the second shard's bind
+    extras = [
+        extra + ["--telemetry_port", str(tport)],
+        extra,
+    ]
+    ports = [free_port(), free_port()]
+    procs = [
+        _spawn_ps(i, p, extra=extras[i], log_dir=str(tmp_path))
+        for i, p in enumerate(ports)
+    ]
+    try:
+        for proc, port in zip(procs, ports):
+            _wait_port(proc, port)
+        client = _client(
+            ports, hot_row_cache_rows=64, staleness_window=8
+        )
+        try:
+            dense, rows, version = _train_fleet(client)
+            cached_before = len(client.hot_row_cache)
+            assert cached_before == 8
+            epoch_before = client.shard_epochs[0]
+
+            # the shard serves its own /metrics plane: the snapshot-age
+            # gauge is scrapeable per pod (docs/ps_recovery.md)
+            import urllib.request
+
+            body = urllib.request.urlopen(
+                "http://localhost:%d/metrics" % tport, timeout=5
+            ).read().decode("utf-8")
+            assert "edl_ps_snapshot_age_seconds" in body
+
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait(timeout=10)
+            procs[0] = _spawn_ps(
+                0, ports[0], extra=extra, log_dir=str(tmp_path)
+            )
+            _wait_port(procs[0], ports[0])
+
+            # the restore contract: the relaunched shard serves exactly
+            # its newest PUBLISHED snapshot (a SIGKILL may have caught
+            # the last async capture still queued — that version is the
+            # bounded rollback, not a failure), while the surviving
+            # shard's partition is untouched
+            import glob
+
+            from elasticdl_tpu.common.hash_utils import string_to_id
+            from elasticdl_tpu.ps.snapshot import read_shard_snapshot
+
+            snaps = sorted(
+                glob.glob(
+                    os.path.join(snap_dir, "ps-0", "snap_v*")
+                ),
+                key=lambda d: int(
+                    os.path.basename(d)[len("snap_v"):]
+                ),
+            )
+            assert snaps, "cadence snapshots must have published"
+            snap_state = read_shard_snapshot(snaps[-1])
+            assert version - snap_state["version"] <= 2
+
+            profiling.events.reset()
+            ok, got_version, dense_after = client.pull_dense()
+            assert ok, "restored shard must serve without a re-push"
+            # SSP sees the bounded rollback, not a wedge: the merged
+            # version is the min over shards, <= the pre-kill version
+            assert 0 <= got_version <= version
+            for name, arr in dense_after.items():
+                expect = (
+                    snap_state["dense"][name]
+                    if string_to_id(name, 2) == 0
+                    else dense[name]
+                )
+                np.testing.assert_allclose(
+                    arr, expect, rtol=0, atol=1e-6
+                )
+            rows_after = client.pull_embedding_vectors(
+                "emb", np.arange(8, dtype=np.int64)
+            )
+            snap_rows = dict(
+                zip(
+                    snap_state["tables"]["emb"]["ids"].tolist(),
+                    snap_state["tables"]["emb"]["rows"],
+                )
+            )
+            for i in range(8):
+                expect = snap_rows[i] if i % 2 == 0 else rows[i]
+                np.testing.assert_allclose(
+                    rows_after[i], expect, rtol=0, atol=1e-6
+                )
+
+            # reconnect protocol observables
+            assert client.shard_epochs[0] == epoch_before + 1
+            restore_events = [
+                e
+                for e in profiling.events.tail()
+                if e["kind"] == "ps_shard_restore"
+            ]
+            assert len(restore_events) == 1
+            ev = restore_events[0]
+            assert ev["shard"] == 0
+            # at most the in-flight captures can roll back (cadence 1,
+            # async writer queue depth 2)
+            assert 0 <= ev["rollback_depth"] <= 2
+            assert ev["cache_rows_invalidated"] >= 1
+
+            status = client._ps[0].ps_status({})
+            assert status["initialized"] is True
+            assert status["restored_version"] >= 1
+        finally:
+            client.close()
+    finally:
+        _stop(procs)
+
+
+@pytest.mark.slow
+def test_sigterm_drains_final_snapshot_and_exits_75(tmp_path):
+    """Graceful preemption: SIGTERM makes the shard drain ONE final
+    snapshot (even past the cadence) and exit 75 — the code the
+    instance manager relaunches without spending the crash budget."""
+    snap_dir = str(tmp_path / "snaps")
+    # cadence 1000: no cadence snapshot will ever fire — whatever the
+    # relaunch restores can only have come from the SIGTERM drain
+    extra = [
+        "--ps_snapshot_versions", "1000",
+        "--ps_snapshot_dir", snap_dir,
+    ]
+    port = free_port()
+    proc = _spawn_ps(0, port, extra=extra, log_dir=str(tmp_path))
+    try:
+        _wait_port(proc, port)
+        client = _client([port])
+        try:
+            client.push_model(
+                {"w": np.full((2, 2), 3.0, np.float32)},
+                [EmbeddingTableInfo("emb", 4)],
+            )
+            client.push_gradient(
+                {"w": np.ones((2, 2), np.float32)},
+                [],
+                0,
+            )
+        finally:
+            client.close()
+
+        proc.terminate()  # SIGTERM: drain + exit 75
+        assert proc.wait(timeout=30) == 75
+
+        proc = _spawn_ps(0, port, extra=extra, log_dir=str(tmp_path))
+        _wait_port(proc, port)
+        probe = _client([port])
+        try:
+            status = probe._ps[0].ps_status({})
+            assert status["initialized"] is True
+            assert status["restored_version"] == 1
+            ok, version, dense = probe.pull_dense()
+            assert ok and version == 1
+            # the drained state carries the applied gradient, not init
+            assert not np.allclose(
+                dense["w"], np.full((2, 2), 3.0, np.float32)
+            )
+        finally:
+            probe.close()
+    finally:
+        _stop([proc])
