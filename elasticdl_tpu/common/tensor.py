@@ -14,6 +14,14 @@ front and writes headers + payloads straight into one preallocated
 buffer through memoryview slices — one memcpy per payload, with any
 bf16 wire downcast (``Tensor.wire_dtype`` mark, set by
 rpc/wire_compression) FUSED into that same write via ``np.copyto``.
+``jax.Array`` payloads ride the same planner WITHOUT a host-staging
+materialization (the dlpack bridge): the plan reads only aval metadata
+(shape/dtype/size), and the frame write copies out of the device
+buffer through its dlpack/``__array_interface__`` view — on a CPU
+backend that view is zero-copy, so the frame write IS the single host
+copy; elsewhere it is the one D2H transfer, still fused with any bf16
+downcast. Wire-bound device trees therefore skip the
+``get_host_state``-style owned-copy staging entirely.
 Decoding returns READ-ONLY ``np.frombuffer`` views pinned to the
 received buffer; nothing is copied until a consumer that retains or
 mutates calls :meth:`Tensor.materialize` (the audited escape hatch).
@@ -39,6 +47,62 @@ _MAGIC = b"EDLT"
 _VERSION = 1
 
 
+def is_device_array(x):
+    """True for a ``jax.Array`` (duck-typed — no jax import at module
+    load): the wire planner treats these as framable payloads whose
+    host copy is deferred into the frame write (the dlpack bridge)."""
+    return hasattr(x, "aval") and hasattr(x, "__dlpack__")
+
+
+def _shard_covers_all(index, shape):
+    """True when one shard's index tuple spans the whole array."""
+    if len(index) != len(shape):
+        return False
+    return all(
+        (s.start or 0) == 0
+        and (s.stop is None or s.stop >= dim)
+        and (s.step is None or s.step == 1)
+        for s, dim in zip(index, shape)
+    )
+
+
+def device_host_view(arr):
+    """A host-side numpy view of a device array's buffer — the dlpack
+    bridge's copy source.
+
+    A fully-replicated array (per jax's own metadata — never inferred
+    from local shard indices, which lie in multi-process topologies)
+    or a single shard spanning the whole array exports that one device
+    buffer through dlpack / ``__array_interface__`` — zero-copy on a
+    CPU backend, the single D2H transfer elsewhere. Anything else
+    falls back to ``jax.device_get``, which assembles fully-addressable
+    sharded arrays — the one materialization dlpack cannot express
+    (edlint R10 ratchet) — and raises jax's own clear error for an
+    array this process cannot see all of (framing one is a caller
+    bug: the frame needs every byte). The returned view is read-only
+    where zero-copy; callers only ever ``np.copyto`` FROM it."""
+    shards = getattr(arr, "addressable_shards", None)
+    src = None
+    if shards:
+        if getattr(arr, "is_fully_replicated", False):
+            # every shard holds the whole value; any local one serves
+            src = shards[0].data
+        elif len(shards) == 1 and _shard_covers_all(
+            shards[0].index, arr.shape
+        ):
+            src = shards[0].data
+    if src is not None:
+        try:
+            return np.from_dlpack(src)
+        except (BufferError, RuntimeError, TypeError, ValueError):
+            # cross-device dlpack (a TPU/GPU buffer numpy cannot
+            # view); device_get below is then the one staged D2H
+            pass
+    import jax
+
+    return jax.device_get(arr)
+
+
 class Tensor:
     """A named ndarray, optionally sparse (values + row indices).
 
@@ -49,7 +113,14 @@ class Tensor:
 
     def __init__(self, name=None, values=None, indices=None):
         self.name = name
-        self.values = None if values is None else np.asarray(values)
+        if values is None or is_device_array(values):
+            # device arrays stay device arrays: the frame planner reads
+            # only their aval metadata, and the single host copy happens
+            # inside the frame write (dlpack bridge) — an np.asarray
+            # here would be the host-staging pass the bridge removes
+            self.values = values
+        else:
+            self.values = np.asarray(values)
         self.indices = (
             None if indices is None else np.asarray(indices, dtype=np.int64)
         )
@@ -113,7 +184,10 @@ class Tensor:
         (locally constructed, or already materialized) return ``self``
         unchanged, so the call is free everywhere but the decode edge.
         """
-        v_owned = self.values is None or self.values.flags.writeable
+        # device arrays count as owned: they are immutable device
+        # buffers, not views pinned to a wire arena
+        v_flags = getattr(self.values, "flags", None)
+        v_owned = v_flags is None or v_flags.writeable
         i_owned = self.indices is None or self.indices.flags.writeable
         if v_owned and i_owned:
             return self
@@ -165,7 +239,9 @@ def plan_tensor_frame(t):
     lets the caller preallocate one buffer for any number of frames,
     and the wire dtype carries the fused bf16 downcast decision — a
     marked f32 payload serializes narrow without an intermediate
-    ``astype`` array ever existing.
+    ``astype`` array ever existing. ``jax.Array`` values plan from
+    aval metadata alone (shape/dtype/size — no device interaction);
+    their single host copy happens inside :func:`write_tensor_frame`.
     """
     values = t.values
     wire = t.wire_dtype if getattr(t, "wire_dtype", None) is not None else None
@@ -194,9 +270,13 @@ def _write_array(buf, off, arr, dtype):
 
     ``np.copyto`` handles strided sources (so no ``ascontiguousarray``
     staging copy) and fuses any dtype narrowing (f32 -> bf16 wire
-    compression) into the same pass. Returns the new offset."""
+    compression) into the same pass. Device arrays copy out of their
+    dlpack/``__array_interface__`` view — the frame write is their one
+    host copy, downcast included. Returns the new offset."""
     nbytes = arr.size * dtype.itemsize
     if nbytes:
+        if is_device_array(arr):
+            arr = device_host_view(arr)
         dest = np.frombuffer(buf[off : off + nbytes], dtype=dtype)
         np.copyto(dest.reshape(arr.shape), arr, casting="unsafe")
     return off + nbytes
@@ -375,11 +455,25 @@ def _join_path(path):
     return "/".join(parts)
 
 
-def pytree_to_named_arrays(tree):
-    """Flatten a pytree of arrays into an ordered {path_name: np.ndarray}."""
+def pytree_to_named_arrays(tree, keep_device=False):
+    """Flatten a pytree of arrays into an ordered {path_name: array}.
+
+    ``keep_device=True`` leaves ``jax.Array`` leaves on device for a
+    WIRE-BOUND tree (gradient pushes, model pushes): the frame writer
+    copies straight out of the device buffer (dlpack bridge), so the
+    np.asarray host staging here would be a wasted full-payload pass.
+    Default (False) materializes host numpy — the checkpoint/export
+    contract, where callers index and retain the arrays."""
     import jax
 
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    if keep_device:
+        return {
+            _join_path(path): (
+                leaf if is_device_array(leaf) else np.asarray(leaf)
+            )
+            for path, leaf in flat
+        }
     return {_join_path(path): np.asarray(leaf) for path, leaf in flat}
 
 

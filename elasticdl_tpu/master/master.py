@@ -207,25 +207,32 @@ class Master:
             )
 
             stages = 0
+            tp = 0
             try:
-                stages = int(
-                    (
-                        get_dict_from_params_str(
-                            getattr(args, "model_params", "") or ""
-                        )
-                        or {}
-                    ).get("pipeline_stages", 0)
-                    or 0
+                mp = (
+                    get_dict_from_params_str(
+                        getattr(args, "model_params", "") or ""
+                    )
+                    or {}
                 )
+                stages = int(mp.get("pipeline_stages", 0) or 0)
+                # the pjit dense plane needs worlds whose device count
+                # divides the model axis the same way pipelining needs
+                # the stage multiple (mesh_axes raises on non-divisor
+                # worlds, which would otherwise crash-loop formation)
+                tp = int(mp.get("tensor_parallel", 0) or 0)
             except (TypeError, ValueError):
                 pass
             raw_workers = int(getattr(args, "num_workers", 0) or 0)
-            # the stage multiple models ONE DEVICE PER WORKER PROCESS
-            # (the k8s pod shape); a single-process job (num_workers
-            # <= 1, e.g. the local in-process mode) holds every local
-            # device in one mesh, where mesh_axes validates the stage
-            # fit at establish instead
-            multiple = stages if stages > 1 and raw_workers > 1 else 1
+            # the stage/tp multiple models ONE DEVICE PER WORKER
+            # PROCESS (the k8s pod shape); a single-process job
+            # (num_workers <= 1, e.g. the local in-process mode) holds
+            # every local device in one mesh, where mesh_axes validates
+            # the fit at establish instead. stages and tp cannot
+            # combine (the zoo hook rejects the pair), so max() picks
+            # whichever is in play.
+            need = max(stages, tp)
+            multiple = need if need > 1 and raw_workers > 1 else 1
             env_multiple = os.environ.get("EDL_WORLD_SIZE_MULTIPLE")
             if env_multiple:
                 multiple = max(1, int(env_multiple))
@@ -235,12 +242,13 @@ class Master:
                 # — a silent never-trains stall, not elasticity
                 raise ValueError(
                     "num_workers=%d cannot hold a world-size multiple "
-                    "of %d (pipeline_stages=%d would round every world "
-                    "down to 0 processes). Raise num_workers, lower "
-                    "pipeline_stages, or — on multi-device hosts where "
-                    "stages divide each worker's devices — set "
-                    "EDL_WORLD_SIZE_MULTIPLE to the true process "
-                    "multiple." % (num_workers, multiple, stages)
+                    "of %d (pipeline_stages=%d / tensor_parallel=%d "
+                    "would round every world down to 0 processes). "
+                    "Raise num_workers, lower the parallelism degree, "
+                    "or — on multi-device hosts where it divides each "
+                    "worker's devices — set EDL_WORLD_SIZE_MULTIPLE "
+                    "to the true process multiple."
+                    % (num_workers, multiple, stages, tp)
                 )
             self.membership = MembershipService(
                 expected_workers=num_workers,

@@ -56,6 +56,7 @@ from elasticdl_tpu.training.step import (
     TrainState,
     accumulate_gradients,
     aux_loss_total,
+    block_device_losses,
 )
 from elasticdl_tpu.utils import profiling
 
@@ -748,6 +749,121 @@ def make_elastic_train_step(
     return jax.jit(sharded)
 
 
+def specs_use_axis(sharded_paths, axis):
+    """True when any collected spec shards over ``axis`` — the pjit
+    dense-path trigger is ``specs_use_axis(paths, "model")``."""
+    return any(
+        axis in _spec_axes(spec) for spec in (sharded_paths or {}).values()
+    )
+
+
+def make_pjit_train_step(
+    module,
+    loss_fn,
+    optimizer,
+    mesh,
+    state_specs,
+    precision=None,
+    remat=False,
+):
+    """GSPMD weighted lockstep step — the pjit dense plane.
+
+    Same call signature and external semantics as
+    :func:`make_elastic_train_step` (``(ts, features, labels, weights,
+    epochs, rng) -> (ts', loss, n_active, epoch_consensus)``), but the
+    body is GLOBAL-semantics math under ``jax.jit`` with
+    ``NamedSharding`` out-shardings: XLA partitions the dense model per
+    the spec tree and inserts the tensor-parallel collectives itself —
+    the "Scalable Training of Language Models using JAX pjit and
+    TPUv4" blueprint (PAPERS.md 2204.06514) inside the elastic world.
+    The module is the PLAIN flax model (no raw in-step collectives, no
+    collective zoo form): correctness is placement-independent, so the
+    same module trains replicated or 2D ``data x model`` sharded and
+    the specs only decide layout.
+
+    Elasticity semantics carried over from the shard_map step:
+
+    - per-device participation ``weights`` scale each device block's
+      loss contribution INSIDE the differentiated function
+      (:func:`training.step.block_device_losses` recovers the
+      per-device granularity from the global batch), so tail batches
+      and drain-mode zero-weight devices weight gradients identically
+      to the replicated arm;
+    - ``epochs``' max is the membership-epoch consensus (the global
+      ``jnp.max`` IS the pmax — same collective, spelled globally);
+    - with zero live devices the state passes through unchanged and
+      ``version`` does not advance.
+
+    Differences, by design: dropout draws ONE global rng (no
+    per-device fold-in — parity for stochastic layers is per-batch,
+    not per-device), mutable model state (batch stats) updates from
+    the full global batch including weight-0 devices' stale rows (use
+    the replicated plane for batch-stat models), and the MoE aux loss
+    adds once globally rather than per device. No donation, same as
+    the elastic step: the pre-step state must survive a failed
+    collective for re-forms.
+    """
+    from elasticdl_tpu.training.precision import get_policy
+    from elasticdl_tpu.training.step import make_remat_forward
+
+    pol = get_policy(precision)
+    forward = make_remat_forward(module, remat)
+    n_dev = mesh.devices.size
+    rep = NamedSharding(mesh, P())
+    ts_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), state_specs
+    )
+
+    def step(ts, features, labels, weights, epochs, rng):
+        w = weights.astype(jnp.float32)  # (n_dev,)
+        n = jnp.sum((w > 0).astype(jnp.float32))
+        denom = jnp.maximum(jnp.sum(w), 1e-6)
+        epoch_seen = jnp.max(epochs)
+
+        def loss_of(p):
+            if pol is not None:
+                p = pol.cast_to_compute(p)
+                features_c = pol.cast_to_compute(features)
+            else:
+                features_c = features
+            output, new_state = forward(p, ts.state, features_c, rng)
+            if pol is not None:
+                output = pol.cast_output(output)
+            dev_raw = block_device_losses(loss_fn, output, labels, n_dev)
+            # the weight rides the loss so AD distributes it to every
+            # gradient contribution (the same trick as the shard_map
+            # step — there via scale, here via the weighted block sum)
+            raw = jnp.sum(dev_raw * w) / denom + aux_loss_total(new_state)
+            return raw, new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(ts.params)
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        params = optax.apply_updates(ts.params, updates)
+        live = n > 0
+
+        def select(new, old):
+            return jnp.where(live, new, old)
+
+        new_ts = TrainState(
+            params=jax.tree_util.tree_map(select, params, ts.params),
+            state=jax.tree_util.tree_map(select, new_state, ts.state),
+            opt_state=jax.tree_util.tree_map(
+                select, opt_state, ts.opt_state
+            ),
+            version=ts.version + live.astype(jnp.int32),
+        )
+        return new_ts, loss, n, epoch_seen
+
+    # out-shardings PIN the layout: without them XLA could silently
+    # re-replicate a sharded parameter on the way out and the "bigger
+    # than one device" property would evaporate after the first step
+    return jax.jit(
+        step, out_shardings=(ts_shardings, rep, rep, rep)
+    )
+
+
 class _BatchFeeder:
     """One-slot async H2D stager (the compile plane's step-overlap leg).
 
@@ -906,6 +1022,12 @@ class ElasticDPTrainer:
         self._paddable_spec_paths = set()
         self._logical_dim0 = {}  # padded leaves: path names -> true dim0
         self._state_specs = None
+        # pjit dense plane: specs shard over the "model" axis, the
+        # PLAIN module trains under make_pjit_train_step, and resizes
+        # re-solve the layout by moving state directly between old and
+        # new NamedShardings (docs/distributed.md)
+        self._pjit_dense = False
+        self._placed_epoch = None  # backend epoch the state was placed in
         self._mesh = None
         self._spec = None
         self._ts = None
@@ -972,8 +1094,11 @@ class ElasticDPTrainer:
 
     def _build_init_ts(self, example_batch):
         features = example_batch[0]
+        # slice before transfer: a device leaf would otherwise D2H the
+        # full batch just to keep one example (same fix as
+        # AllReduceTrainer.init_from_batch)
         host_one = jax.tree_util.tree_map(
-            lambda x: np.asarray(x)[:1], features
+            lambda x: np.asarray(x[:1]), features
         )
 
         def build():
@@ -1036,6 +1161,14 @@ class ElasticDPTrainer:
             self._paddable_spec_paths = collect_paddable_paths(
                 param_specs
             )
+        self._pjit_dense = specs_use_axis(self._sharded_paths, "model")
+        if self._pjit_dense and self._accum_steps > 1:
+            raise ValueError(
+                "accum_steps > 1 is not supported on the pjit dense "
+                "plane yet: global-batch microbatching would regroup "
+                "rows across devices and change the weighted-step "
+                "semantics — use the replicated plane, or accum_steps=1"
+            )
         self._check_optimizer_coupling()
         t_init = t_world
         if self._sharded_paths:
@@ -1074,6 +1207,7 @@ class ElasticDPTrainer:
             )
         t_place = _time.time()
         self._checked_ts = self._ts
+        self._placed_epoch = distributed.backend_epoch()
         self._spec_example = example_batch or self._last_local
         with profiling.annotate("elastic/establish/compile"):
             cache_hit = self._acquire_step_fn()
@@ -1186,10 +1320,23 @@ class ElasticDPTrainer:
             id(self._precision),
             int(self._accum_steps),
             str(self._remat),
+            # the pjit dense plane builds a DIFFERENT step callable for
+            # the same (module, specs): the flag must key the cache
+            bool(self._pjit_dense),
             compile_plane.spec_signature(state_specs),
         )
 
     def _build_step_fn(self, mesh, state_specs):
+        if self._pjit_dense:
+            return make_pjit_train_step(
+                self._module,
+                self._loss_fn,
+                self._optimizer,
+                mesh,
+                state_specs,
+                precision=self._precision,
+                remat=self._remat,
+            )
         return make_elastic_train_step(
             self._module,
             self._loss_fn,
@@ -1289,8 +1436,9 @@ class ElasticDPTrainer:
         ``mesh`` — shapes exactly as :meth:`train_step` will place them
         (padded rows derive from the worker's fixed minibatch)."""
         features, labels = example
-        leaf0 = np.asarray(jax.tree_util.tree_leaves(features)[0])
-        mb = self.default_minibatch_size or leaf0.shape[0]
+        # shape metadata only — no host materialization of the leaf
+        leaf0 = jax.tree_util.tree_leaves(features)[0]
+        mb = self.default_minibatch_size or int(leaf0.shape[0])
         rows = self.local_rows(mb)
         n_proc = self._spec.num_processes if self._spec else 1
         g_rows = rows * n_proc
@@ -1551,11 +1699,41 @@ class ElasticDPTrainer:
         if isinstance(candidates, str):
             candidates = [candidates]
         was_live = self._host_step > 0
-        self._ts = None
+        old_ts, self._ts = self._ts, None
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self._mesh, s), self._state_specs
         )
         floor = _max_checkpoint_version(candidates)
+        if (
+            self._pjit_dense
+            and old_ts is not None
+            and self._placed_epoch == distributed.backend_epoch()
+        ):
+            # layout re-solve on resize (ElasWave-style, PAPERS.md
+            # 2510.00606): the backend survived this membership change
+            # (single-backend resize), so the state moves DIRECTLY from
+            # the old placement to the new NamedShardings — the runtime
+            # relays buffers device-to-device, no host round trip, no
+            # disk. When the backend was torn down (a multi-process
+            # re-form), the old buffers are gone and the snapshot
+            # interchange below (sharded checkpoints) is the path.
+            try:
+                with profiling.annotate("elastic/resize/relayout"):
+                    self._ts = jax.tree_util.tree_map(
+                        jax.device_put, old_ts, shardings
+                    )
+                logger.info(
+                    "pjit dense plane re-laid out onto the new mesh "
+                    "(old -> new NamedShardings, state moved in place)"
+                )
+                return
+            except Exception:
+                self._ts = None
+                logger.warning(
+                    "direct layout re-solve failed; falling back to "
+                    "the snapshot interchange",
+                    exc_info=True,
+                )
         # COLLECTIVE attempts: mirror_enabled() answers from the job
         # args, so every rank takes the same branch; all further
         # decisions inside derive from the all-gathered summary
@@ -1884,6 +2062,27 @@ class ElasticDPTrainer:
             self._state_specs if self._state_specs is not None else P()
         )
         row_spec = row_partition_spec(self._mesh)
+        if self._pjit_dense:
+            # global-semantics forward: XLA partitions per the params'
+            # NamedShardings (same GSPMD discipline as the train step);
+            # the row-sharded out-sharding keeps each process's output
+            # rows on its own devices for the _local_block consumer
+            def global_fwd(ts, features):
+                params, state = ts.params, ts.state
+                if pol is not None:
+                    params = pol.cast_to_compute(params)
+                    features = pol.cast_to_compute(features)
+                output, _ = apply_model(
+                    module, params, state, features, training=False
+                )
+                if pol is not None:
+                    output = pol.cast_output(output)
+                return output
+
+            return jax.jit(
+                global_fwd,
+                out_shardings=NamedSharding(self._mesh, row_spec),
+            )
 
         def per_device(ts, features):
             params, state = ts.params, ts.state

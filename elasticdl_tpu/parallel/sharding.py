@@ -40,6 +40,34 @@ _TP_RULES = (
 )
 
 
+# The same rules as a nested suffix-spec dict — the shape zoo
+# ``param_shardings`` hooks emit and the elastic trainer's
+# collect_sharded_paths/spec_path_matches machinery consumes (a leaf
+# whose path ENDS WITH a key path gets the spec; optimizer slot trees
+# co-shard automatically). This is the promotion of the name-pattern TP
+# rules into the elastic world: a zoo returns ``tp_param_specs()`` and
+# ElasticDPTrainer places dense parameters via NamedSharding over the
+# 2D data x model mesh instead of replicating them everywhere
+# (docs/distributed.md).
+_TP_SUFFIX_SPECS = {
+    "query": {"kernel": P(None, "model", None)},
+    "key": {"kernel": P(None, "model", None)},
+    "value": {"kernel": P(None, "model", None)},
+    "out": {"kernel": P("model", None, None)},
+    "mlp_up": {"kernel": P(None, "model"), "bias": P("model")},
+    "mlp_down": {"kernel": P("model", None)},
+    "embed": {"embedding": P("model", None)},
+}
+
+
+def tp_param_specs():
+    """Nested {path segment: ... PartitionSpec} dict of the TP rules.
+
+    Returns a fresh copy each call so a caller merging extra specs in
+    cannot mutate the module-level table."""
+    return {k: dict(v) for k, v in _TP_SUFFIX_SPECS.items()}
+
+
 def _drop_missing_axes(spec, mesh):
     axes = set(mesh.axis_names)
     return P(*(a if a in axes else None for a in spec))

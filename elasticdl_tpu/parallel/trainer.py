@@ -150,8 +150,12 @@ class AllReduceTrainer:
             if isinstance(global_batch, tuple)
             else global_batch
         )
+        # slice BEFORE the host transfer: init only needs one example's
+        # shape, and np.asarray on the full leaf would D2H the whole
+        # batch (a device leaf slices on device; a numpy leaf stays a
+        # view either way)
         host_features = jax.tree_util.tree_map(
-            lambda x: np.asarray(x)[:1], features
+            lambda x: np.asarray(x[:1]), features
         )
         variables = init_variables(
             self._module, jax.random.PRNGKey(self._seed), host_features
@@ -210,8 +214,22 @@ class AllReduceTrainer:
                 self._ts = self._place(old_ts)
 
     def get_host_state(self):
-        """Pull the train state to host memory (for checkpointing)."""
-        return jax.tree_util.tree_map(np.asarray, self._ts)
+        """Pull the train state to host memory (for checkpointing).
+
+        Leaves come back as OWNED copies: ``np.asarray`` on a CPU
+        backend returns a zero-copy view of the device buffer, and this
+        trainer's step DONATES its state — a checkpoint thread reading
+        such a view races the next step recycling the buffer. Sharded
+        leaves gather through ``jax.device_get`` (assembling the
+        addressable shards) before the same owned-copy floor."""
+
+        def fetch(x):
+            if hasattr(x, "addressable_shards"):
+                x = jax.device_get(x)
+            # np.array(copy=True): never a view of device memory
+            return np.array(x, copy=True)
+
+        return jax.tree_util.tree_map(fetch, self._ts)
 
     def save_sharded(self, directory):
         """Per-shard checkpoint: HBM-sharded parameters (embedding

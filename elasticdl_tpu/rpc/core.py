@@ -24,6 +24,7 @@ from elasticdl_tpu.common.tensor import (
     Tensor,
     WireArena,
     deserialize_tensor,
+    is_device_array,
     plan_tensor_frame,
     write_tensor_frame,
 )
@@ -89,7 +90,11 @@ def plan_message(msg):
             continue  # decode-side lifetime handle, never a wire field
         if isinstance(value, Tensor):
             header[key] = {"t": "tensor", "i": add_frame(value)}
-        elif isinstance(value, np.ndarray):
+        elif isinstance(value, np.ndarray) or is_device_array(value):
+            # jax.Array payloads frame directly: the plan reads aval
+            # metadata only, the packer's frame write is the single
+            # host copy (dlpack bridge, docs/wire.md) — no np.asarray
+            # staging ever happens on this path
             header[key] = {"t": "array", "i": add_frame(Tensor(key, value))}
         elif (
             isinstance(value, (list, tuple))

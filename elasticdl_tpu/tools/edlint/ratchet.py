@@ -125,6 +125,20 @@ ALLOW = {
         },
     },
     "R10": {
+        "elasticdl_tpu/common/tensor.py": {
+            "max": 5,
+            "reason": "host-side codec normalizations + the bridge "
+            "fallback, none a device-payload staging: "
+            "Tensor.__init__'s bare asarray runs only on NON-device "
+            "values (device arrays bypass via is_device_array); "
+            "pytree_to_named_arrays' pair is the checkpoint/export "
+            "contract (keep_device=True is the wire path and skips "
+            "asarray for device leaves); named_arrays_to_pytree "
+            "restores host checkpoints. device_host_view's one "
+            "jax.device_get call is the bridge's own fallback — a "
+            "genuinely sharded or cross-device buffer dlpack cannot "
+            "view; it IS the single D2H",
+        },
         "elasticdl_tpu/rpc/core.py": {
             "max": 3,
             "reason": "the three contract-required materializations: "

@@ -1135,13 +1135,17 @@ class CopyOnWireRule(Rule):
         "the PS wire path is single-copy by contract (docs/wire.md): "
         "inside rpc/, common/tensor.py, and the PSClient/servicer "
         "data-plane methods, no .tobytes()/np.ascontiguousarray() "
-        "payload flattening, no .astype() on a held array, and no "
+        "payload flattening, no .astype() on a held array, no "
         "wholesale bytes(...) materialization (header-sized "
-        "json.loads(bytes(...)) decodes are exempt) — encode through "
-        "the scatter-gather frame planner, decode through read-only "
-        "frombuffer views, and Tensor.materialize() at the audited "
-        "retention sites; the transport-handoff copies that must "
-        "remain are reason-ratcheted"
+        "json.loads(bytes(...)) decodes are exempt), and — since the "
+        "dlpack bridge — no np.asarray()/jax.device_get() host "
+        "staging of a (possibly device-array) payload: a jax.Array "
+        "frames DIRECTLY, its single host copy fused into the frame "
+        "write. Encode through the scatter-gather frame planner, "
+        "decode through read-only frombuffer views, "
+        "Tensor.materialize() at the audited retention sites; the "
+        "transport-handoff copies and host-side normalizations that "
+        "must remain are reason-ratcheted"
     )
 
     SCOPE_PREFIXES = ("elasticdl_tpu/rpc/",)
@@ -1192,6 +1196,31 @@ class CopyOnWireRule(Rule):
                 return (
                     "dtype conversion allocates a full copy (fuse it "
                     "into the frame write via Tensor.wire_dtype)"
+                )
+            if (
+                tail == "asarray"
+                and d.split(".", 1)[0] in ("np", "numpy")
+                # dtype may be spelled keyword or positional
+                # (np.asarray(x, np.int64)) — both are the typed
+                # decode, not a staging pass
+                and not any(k.arg == "dtype" for k in node.keywords)
+                and len(node.args) < 2
+            ):
+                # a dtype-normalizing asarray (explicit dtype=) is the
+                # typed-decode idiom — a view unless the dtype really
+                # differs; BARE asarray of a payload is exactly the
+                # host-staging shape (host arrays already are ndarray,
+                # only a device array needs the call)
+                return (
+                    "np.asarray host-stages the value — a device "
+                    "array should frame directly (the dlpack bridge "
+                    "defers its one host copy into the frame write)"
+                )
+            if d == "jax.device_get":
+                return (
+                    "jax.device_get materializes a device array on "
+                    "the wire path — frame the jax.Array directly "
+                    "(dlpack bridge)"
                 )
             return None
         if (

@@ -109,6 +109,26 @@ def accumulate_gradients(
     return loss_sum * inv, grads, new_state
 
 
+def block_device_losses(loss_fn, output, labels, n_blocks):
+    """Per-device-block losses of a GLOBAL batch: ``(n_blocks,)``.
+
+    The global-semantics twin of the elastic shard_map step's
+    per-device loss — the leading batch dim reshapes into
+    ``(n_blocks, rows_per_block)`` and ``loss_fn`` vmaps over blocks,
+    so the pjit dense path (parallel/elastic.make_pjit_train_step) can
+    apply per-device participation weights at exactly the granularity
+    the replicated arm does. Requires the batch dim to divide
+    ``n_blocks`` (the trainer's row padding guarantees it)."""
+
+    def block(x):
+        return x.reshape((n_blocks, -1) + x.shape[1:])
+
+    return jax.vmap(loss_fn)(
+        jax.tree_util.tree_map(block, output),
+        jax.tree_util.tree_map(block, labels),
+    )
+
+
 def make_grad_fn(module, loss_fn, precision=None):
     """Jitted ``(params, state, features, labels, rng) ->
     (loss, grads, new_state, output)``.

@@ -229,7 +229,36 @@ class ElasticAllReduceWorker:
             self._model = zoo_module["build_distributed_model"](
                 mesh=None, **extra
             )
-        if (
+        pjit_dense = wants_sharded and self._zoo_wants_pjit_dense(
+            zoo_module, model_params
+        )
+        if pjit_dense and not self._serving_only:
+            # pjit dense plane (docs/distributed.md): the specs shard
+            # the PLAIN module over the 2D data x model mesh — no
+            # collective zoo form exists or is needed, XLA partitions
+            # the global-semantics model from the NamedShardings. The
+            # trainer detects the model-axis specs and routes the step
+            # through make_pjit_train_step. Serving-only jobs need
+            # none of this: they fall through to the degenerate
+            # (mesh=None) plain-module path below, whose scoring
+            # assembles FULL host arrays from the training job's
+            # sharded checkpoints via load_sharded_to_host — the TP
+            # shard files carry their slice metadata.
+            def builder(
+                mesh, _module=self._model, _zoo=zoo_module, _extra=extra
+            ):
+                return (
+                    _module,
+                    _zoo["param_shardings"](mesh, **_extra),
+                )
+
+            if "mesh_axes" in zoo_module:
+                mesh_axes_fn = (
+                    lambda n, _zoo=zoo_module, _extra=extra: _zoo[
+                        "mesh_axes"
+                    ](n, **_extra)
+                )
+        elif (
             "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
             and not self._serving_only
@@ -248,9 +277,13 @@ class ElasticAllReduceWorker:
                 "model_zoo/transformer_lm) or run the "
                 "single-process ALLREDUCE strategy" % model_def
             )
-        if "build_collective_model" in zoo_module and (
-            host_twin_serving
-            or (not self._serving_only and wants_sharded)
+        if (
+            "build_collective_model" in zoo_module
+            and not pjit_dense
+            and (
+                host_twin_serving
+                or (not self._serving_only and wants_sharded)
+            )
         ):
             # sharded parameters on the elastic plane (HBM vocab tables,
             # stacked pipeline stages): the model uses raw collectives
@@ -438,6 +471,36 @@ class ElasticAllReduceWorker:
         if threading.current_thread() is not threading.main_thread():
             return  # in-process test workers: signals stay with the host
         signal.signal(signal.SIGTERM, self.request_drain)
+
+    @staticmethod
+    def _zoo_wants_pjit_dense(zoo_module, model_params):
+        """Does this config shard the DENSE model over the ``model``
+        axis (the pjit/GSPMD path, plain module), rather than declaring
+        collective-form sharded parameters? Probed with mesh=None like
+        :meth:`_zoo_wants_sharded_params`."""
+        ps = zoo_module.get("param_shardings")
+        if ps is None:
+            return False
+        from elasticdl_tpu.common.model_utils import (
+            get_dict_from_params_str,
+        )
+        from elasticdl_tpu.parallel.elastic import (
+            collect_sharded_paths,
+            specs_use_axis,
+        )
+
+        try:
+            specs = ps(
+                None, **(get_dict_from_params_str(model_params) or {})
+            )
+            return specs_use_axis(collect_sharded_paths(specs), "model")
+        except Exception:
+            logger.debug(
+                "model ps() pjit probe failed; assuming collective "
+                "form",
+                exc_info=True,
+            )
+            return False
 
     @staticmethod
     def _zoo_wants_sharded_params(zoo_module, model_params):

@@ -323,7 +323,13 @@ class Worker:
             self.report_variable()
 
     def report_variable(self):
-        named = pytree_to_named_arrays(self._params)
+        # PS pushes ride the dlpack wire bridge: device leaves stay on
+        # device and the frame write is their single host copy
+        # (docs/wire.md) — the master stub keeps host numpy (in-process
+        # masters retain what they are handed)
+        named = pytree_to_named_arrays(
+            self._params, keep_device=self._ps_client is not None
+        )
         if self._ps_client is not None:
             infos = [
                 EmbeddingTableInfo(
@@ -339,7 +345,9 @@ class Worker:
 
     def report_gradient(self, grads, sparse_tensors=None):
         """Ship dense grads as named tensors (+ sparse embedding grads)."""
-        named = pytree_to_named_arrays(grads)
+        named = pytree_to_named_arrays(
+            grads, keep_device=self._ps_client is not None
+        )
         if self._ps_client is not None:
             return self._ps_client.push_gradient(
                 named, sparse_tensors, self._model_version

@@ -400,8 +400,10 @@ def build_distributed_model(
     :func:`param_shardings` and :func:`mesh_axes`); otherwise the plain
     model over the mesh."""
     stages = int(pipeline_stages)
-    # consumed by param_shardings (placement), not by the model itself
+    # consumed by param_shardings/mesh_axes (placement), not by the
+    # model itself
     params.pop("shard_vocab", None)
+    params.pop("tensor_parallel", None)
     if stages > 1:
         _check_pipeline_params(params)
         return PipelinedTransformerLM(
@@ -454,18 +456,48 @@ def build_collective_model(
     )
 
 
-def param_shardings(mesh, pipeline_stages=0, shard_vocab=False, **_params):
+def param_shardings(
+    mesh,
+    pipeline_stages=0,
+    shard_vocab=False,
+    tensor_parallel=0,
+    **_params,
+):
     """Stacked stage parameters shard leaf-dim-0 over ``pipe``; with
     ``shard_vocab`` the token-embedding table additionally row-shards
     its vocab over ``data`` (the weight-tied LM head then contracts a
     vocab-sharded table — XLA inserts the collectives from the
     placement, the HBM-embedding recipe applied to the LM family).
 
+    With ``tensor_parallel > 1`` the dense model itself shards over the
+    2D ``data x model`` mesh: the name-pattern TP rules of
+    parallel/sharding.py (qkv/out heads, MLP hidden, vocab) emitted as
+    real specs — the PLAIN module then trains under the elastic
+    trainer's pjit/GSPMD dense path, parameters placed by NamedSharding
+    instead of replicated everywhere (docs/distributed.md), unlocking
+    dense models bigger than one device's HBM inside the elastic world.
+
     ``mesh=None`` is the capability probe (does this config shard at
     all?) — answered from the params alone."""
     from jax.sharding import PartitionSpec as P
 
     specs = {}
+    tp = int(tensor_parallel)
+    if tp > 1 and int(pipeline_stages) > 1:
+        raise ValueError(
+            "tensor_parallel and pipeline_stages cannot combine yet: "
+            "the pjit dense path and the collective pipeline use "
+            "different step builders — pick one"
+        )
+    if tp > 1 and shard_vocab:
+        raise ValueError(
+            "shard_vocab is redundant with tensor_parallel (the TP "
+            "rules already vocab-shard the embed table, over 'model')"
+        )
+    if tp > 1 and (mesh is None or "model" in mesh.axis_names):
+        from elasticdl_tpu.parallel.sharding import tp_param_specs
+
+        specs.update(tp_param_specs())
     if int(pipeline_stages) > 1 and (
         mesh is None or "pipe" in mesh.axis_names
     ):
@@ -475,9 +507,23 @@ def param_shardings(mesh, pipeline_stages=0, shard_vocab=False, **_params):
     return specs or None
 
 
-def mesh_axes(n_devices, pipeline_stages=0, **_params):
+def mesh_axes(n_devices, pipeline_stages=0, tensor_parallel=0, **_params):
     """Zoo hook: mesh shape for this model's parallelism config."""
     stages = int(pipeline_stages)
+    tp = int(tensor_parallel)
+    if tp > 1:
+        if stages > 1:
+            raise ValueError(
+                "tensor_parallel does not combine with pipeline_stages"
+            )
+        if n_devices % tp:
+            raise ValueError(
+                "%d devices do not divide into tensor_parallel=%d"
+                % (n_devices, tp)
+            )
+        # row-major reshape: consecutive devices fill the model axis
+        # first, so each tp group is one contiguous device block
+        return {"data": n_devices // tp, "model": tp}
     if stages > 1:
         if n_devices % stages:
             raise ValueError(
@@ -504,10 +550,14 @@ def custom_model(
     moe_num_selected=1,
     moe_aux_loss_coef=0.01,
     # consumed by build_distributed_model (the ALLREDUCE job path swaps
-    # in PipelinedTransformerLM); accepted here so one --model_params
-    # string serves both the plain spec and the distributed hook
+    # in PipelinedTransformerLM) / param_shardings (tensor_parallel
+    # placement — the pjit dense path trains THIS plain module);
+    # accepted here so one --model_params string serves both the plain
+    # spec and the distributed hooks
     pipeline_stages=0,
     microbatches=0,
+    tensor_parallel=0,
+    shard_vocab=False,
 ):
     return TransformerLM(
         vocab_size=vocab_size,

@@ -89,6 +89,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_locktrace.py \
     tests/test_edlint.py \
     tests/test_wire.py \
+    tests/test_dense_sharding.py \
     tests/test_comm_plane.py \
     tests/test_ps_snapshot.py \
     tests/test_chaos.py \

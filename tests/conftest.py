@@ -66,6 +66,7 @@ _LOCKTRACE_SUITES = {
     "test_locktrace",
     "test_telemetry",
     "test_wire",
+    "test_dense_sharding",
     "test_comm_plane",
     "test_ps_snapshot",
     "test_chaos",

@@ -1377,6 +1377,81 @@ def test_r10_is_receiver_and_scope_typed(tmp_path):
     )
 
 
+R10_HOST_STAGED_DEVICE = '''
+import jax
+import numpy as np
+
+
+def plan_tensor_frame(t):
+    # the pre-bridge shape this extension keeps dead: host-staging a
+    # device payload before framing it (np.asarray pass + frame copy =
+    # two walks; the bridge's frame write is the single host copy)
+    values = np.asarray(t.values)
+    gathered = jax.device_get(t.values)
+    return values, gathered
+'''
+
+R10_DLPACK_BRIDGE = '''
+import numpy as np
+
+
+def is_device_array(x):
+    return hasattr(x, "aval") and hasattr(x, "__dlpack__")
+
+
+def write_frame(buf, off, arr):
+    # the bridged idiom: plan from aval metadata, copy out of the
+    # dlpack view straight into the frame — one pass, downcast fused
+    if is_device_array(arr):
+        arr = np.from_dlpack(arr)
+    dest = np.frombuffer(buf[off:off + arr.nbytes], dtype=arr.dtype)
+    np.copyto(dest.reshape(arr.shape), arr, casting="unsafe")
+    return off + arr.nbytes
+'''
+
+
+def test_r10_flags_host_staging_of_device_arrays(tmp_path):
+    # the dlpack-bridge extension: np.asarray / jax.device_get inside
+    # wire scope are findings (ratcheted where genuinely host-side)
+    bad = _lint(
+        tmp_path,
+        R10_HOST_STAGED_DEVICE,
+        relpath="elasticdl_tpu/rpc/fixture.py",
+    )
+    assert _rules_of(bad) == ["R10"] and len(bad) == 2, bad
+    messages = "\n".join(v.message for v in bad)
+    assert "np.asarray" in messages
+    assert "jax.device_get" in messages
+    assert "dlpack" in messages
+    # a dtype-normalizing asarray is the typed-decode idiom (view
+    # unless the dtype differs) — not a staging copy; keyword and
+    # positional dtype spellings are equivalent
+    assert not _lint(
+        tmp_path,
+        "import numpy as np\n"
+        "def pull_rows(req):\n"
+        "    a = np.asarray(req['ids'], dtype=np.int64)\n"
+        "    b = np.asarray(req['rows'], np.float32)\n"
+        "    return a, b\n",
+        relpath="elasticdl_tpu/rpc/fixture.py",
+    )
+    # the bridged idiom (np.from_dlpack view + copyto into the frame)
+    # is clean — from_dlpack is a view, not a copy
+    assert not _lint(
+        tmp_path,
+        R10_DLPACK_BRIDGE,
+        relpath="elasticdl_tpu/rpc/fixture.py",
+    )
+    # outside wire scope np.asarray stays none of this rule's business
+    assert not _lint(
+        tmp_path,
+        "import numpy as np\n"
+        "def batch_leaf(x):\n"
+        "    return np.asarray(x)[:1]\n",
+        relpath="elasticdl_tpu/parallel/fixture.py",
+    )
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: the AST cache and --json
 # ---------------------------------------------------------------------------
