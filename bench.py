@@ -1982,7 +1982,331 @@ def _bench_chaos_impl(quick=False):
                 "divergence_ratio": d_restored / max(d_reinit, 1e-12),
             }
         )
+
+        # ---- master recovery arms (docs/master_recovery.md) -----------
+        # the same deepfm fleet, now driven by a REAL master.main OS
+        # process with the dispatch journal on: fault-free twice under
+        # different task-shuffle seeds (their L2 distance is the
+        # ORGANIC task-order noise floor of this async job) and once
+        # with a scripted SIGKILL of the master at a journal done-count,
+        # relaunched same port + journal dir. The worker runs in this
+        # process on the failover channel and must ride the outage out.
+        results.update(_master_chaos_arms(tmp, quick))
     return results
+
+
+def _master_chaos_arms(tmp, quick):
+    import socket
+    import subprocess
+    import threading
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.journal import MasterJournal
+    from elasticdl_tpu.master.rpc_service import MasterClient
+    from elasticdl_tpu.rpc.core import Client
+    from elasticdl_tpu.tools.chaos import ChaosOp, FleetChaos
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    batch = 16
+    m_nmpt = 2  # records_per_task = 32: one master round trip per 2 batches
+    m_records = 512 if quick else 768
+    m_tasks = m_records // (batch * m_nmpt)
+    m_kill_at_done = 3
+    # pace the job with injected per-RPC RTT on the PS fleet so the
+    # scripted kill reliably lands MID-job (an unpaced CPU run drains
+    # the whole ledger inside one chaos poll interval)
+    m_rtt_ms = 30.0
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
+
+    # reuse the pooled-id FRAPPE schema (deterministic ids); the master
+    # reads shards from a DIRECTORY
+    rng = np.random.default_rng(31)
+    pool = rng.permutation(5383)[:96]
+    probe_ids = np.sort(pool).astype(np.int64)
+    mdata_dir = os.path.join(tmp, "mdata")
+    os.makedirs(mdata_dir, exist_ok=True)
+    from elasticdl_tpu.data.example import encode_example
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+
+    with RecordIOWriter(os.path.join(mdata_dir, "m.edlr")) as f:
+        for _ in range(m_records):
+            f.write(
+                encode_example(
+                    {
+                        "feature": rng.choice(pool, size=(10,)).astype(
+                            np.int64
+                        ),
+                        "label": np.array(
+                            [rng.integers(2)], dtype=np.int64
+                        ),
+                    }
+                )
+            )
+
+    def fleet_probe(addrs):
+        client = PSClient([BoundPS(a, deadline_s=10.0) for a in addrs])
+        try:
+            ok, version, named = client.pull_dense()
+            if not ok:
+                raise RuntimeError("fleet reports uninitialized params")
+            rows = client.pull_embedding_vectors_multi(
+                {name: probe_ids for name in ("embedding", "id_bias")}
+            )
+        finally:
+            client.close()
+        parts = [
+            np.asarray(named[k], np.float64).ravel()
+            for k in sorted(named)
+        ] + [
+            np.asarray(rows[name], np.float64).ravel()
+            for name in ("embedding", "id_bias")
+        ]
+        return int(version), np.concatenate(parts)
+
+    def _wait_tcp(proc_fn, port, what, timeout=120):
+        deadline = time.time() + timeout
+        while True:
+            proc = proc_fn()
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "%s exited rc=%s at boot" % (what, proc.returncode)
+                )
+            try:
+                with socket.create_connection(("localhost", port), 1.0):
+                    return
+            except OSError:
+                if time.time() > deadline:
+                    raise RuntimeError("%s did not come up" % what)
+                time.sleep(0.2)
+
+    def _mstatus(mport, timeout=90):
+        """master_status on a FRESH channel per attempt: a channel
+        that lived through the SIGKILL can wedge in gRPC's failure
+        state long after the relaunched master serves — probe channels
+        are disposable (the fleet-test discipline)."""
+        import grpc
+
+        deadline = time.time() + timeout
+        while True:
+            probe = Client("localhost:%d" % mport, deadline_s=5.0)
+            try:
+                return probe.call("master_status")
+            except grpc.RpcError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.3)
+            finally:
+                probe.close()
+
+    def run_master_arm(tag, seed, kill_at_done=None):
+        procs, addrs, _, env = _launch_ps_fleet_ex(
+            tmp,
+            MODEL_ZOO_PATH,
+            model_def,
+            tag,
+            extra_args=["--rpc_inject_delay_ms", str(m_rtt_ms)],
+        )
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        mport = s.getsockname()[1]
+        s.close()
+        journal_dir = os.path.join(tmp, "journal-" + tag)
+        mcmd = [
+            sys.executable, "-m", "elasticdl_tpu.master.main",
+            "--job_name", "chaos-" + tag,
+            "--port", str(mport),
+            "--model_zoo", MODEL_ZOO_PATH,
+            "--model_def", model_def,
+            "--model_params", model_params,
+            "--minibatch_size", str(batch),
+            "--num_minibatches_per_task", str(m_nmpt),
+            "--num_epochs", "1",
+            "--training_data", mdata_dir,
+            "--num_workers", "0",
+            "--num_ps_pods", "2",
+            "--use_async", "true",
+            "--grads_to_wait", "1",
+            "--master_journal_dir", journal_dir,
+            "--master_journal_fsync_ms", "20",
+        ]
+        menv = dict(env)
+        menv.update(
+            {
+                "EDL_MASTER_POLL_SECS": "1",
+                # the dispatcher shuffle is the one entropy source the
+                # divergence gate cannot pin from outside the process
+                "EDL_TASK_SHUFFLE_SEED": str(seed),
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        merr = open(os.path.join(tmp, "master-%s.err" % tag), "ab")
+
+        def spawn_master():
+            return subprocess.Popen(
+                mcmd,
+                env=menv,
+                stdout=subprocess.DEVNULL,
+                stderr=merr,
+            )
+
+        box = {"proc": spawn_master()}
+        _wait_tcp(lambda: box["proc"], mport, "master " + tag)
+        status_client = Client(
+            "localhost:%d" % mport,
+            deadline_s=2.0,
+            retries=3,
+            backoff_s=0.3,
+        )
+        chaos = None
+        relaunched = threading.Event()
+        arm = {}
+        try:
+            arm["epoch_initial"] = int(
+                _mstatus(mport)["master_epoch"]
+            )
+            if kill_at_done is not None:
+
+                class _MasterFleet:
+                    """kill_master = SIGKILL + relaunch with the same
+                    argv/port/journal — the LocalInstanceManager
+                    relaunch contract, driven by this arm's own
+                    process handle."""
+
+                    def kill_master(self):
+                        p = box["proc"]
+                        p.kill()
+                        p.wait(timeout=10)
+                        box["proc"] = spawn_master()
+                        relaunched.set()
+
+                    terminate_master = kill_master
+
+                chaos = FleetChaos(
+                    _MasterFleet(),
+                    lambda shard: {},
+                    [ChaosOp("kill_master", -1, at_done=kill_at_done)],
+                    poll_s=0.05,
+                    master_status_fn=lambda: status_client.call(
+                        "master_status"
+                    ),
+                ).start()
+            stub = MasterClient(
+                "localhost:%d" % mport, failover_s=240.0
+            )
+            ps_client = PSClient(
+                [
+                    BoundPS(
+                        a, deadline_s=5.0, retries=2, backoff_s=0.2
+                    )
+                    for a in addrs
+                ],
+                hot_row_cache_rows=0,
+                push_inflight=0,
+            )
+            worker = Worker(
+                worker_id=1,
+                job_type=JobType.TRAINING_ONLY,
+                minibatch_size=batch,
+                model_zoo=MODEL_ZOO_PATH,
+                model_def=model_def,
+                model_params=model_params,
+                stub=stub,
+                ps_client=ps_client,
+                seed=7,
+                # synchronous acks: the chaos trigger is the journal's
+                # done count, so completions must land promptly rather
+                # than in boundary-drain bursts
+                task_ack_queue=0,
+            )
+            try:
+                worker.run()
+                arm["worker_survived"] = True
+            finally:
+                try:
+                    ps_client.close()
+                finally:
+                    stub.close()
+            if chaos is not None:
+                chaos.stop()
+                if not chaos.done():
+                    raise RuntimeError(
+                        "master chaos schedule did not execute (job "
+                        "finished before %d done tasks)" % kill_at_done
+                    )
+                if not relaunched.wait(timeout=1):
+                    raise RuntimeError(
+                        "killed master was never relaunched"
+                    )
+                arm["kill_trigger_done"] = int(chaos.executed[0][1])
+                if arm["kill_trigger_done"] >= m_tasks:
+                    raise RuntimeError(
+                        "the kill landed after the ledger drained "
+                        "(done=%d of %d) — not a mid-job outage; "
+                        "raise the RTT pacing"
+                        % (arm["kill_trigger_done"], m_tasks)
+                    )
+            st = _mstatus(mport)
+            arm["epoch_final"] = int(st["master_epoch"])
+            # the master observes completion through its own poll and
+            # exits 0 — the whole point of the relaunch being a real
+            # member of the job, not a bystander
+            deadline = time.time() + 120
+            while (
+                box["proc"].poll() is None and time.time() < deadline
+            ):
+                time.sleep(0.2)
+            if box["proc"].poll() != 0:
+                raise RuntimeError(
+                    "master (%s) did not exit cleanly after "
+                    "completion (rc=%r)" % (tag, box["proc"].poll())
+                )
+            version, state = fleet_probe(addrs)
+            arm["final_version"] = version
+        finally:
+            if chaos is not None:
+                chaos.stop()
+            status_client.close()
+            p = box["proc"]
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    print(
+                        "WARN: master (%s) unreaped after SIGKILL" % tag
+                    )
+            merr.close()
+            _stop_ps_fleet(procs)
+        jstate = MasterJournal(journal_dir).replay()
+        arm["journal"] = dict(jstate.counters)
+        arm["journal"]["pending"] = len(jstate.pending)
+        return arm, state
+
+    clean_a, state_a = run_master_arm("mclean-a", seed=11)
+    clean_b, state_b = run_master_arm("mclean-b", seed=12)
+    chaos_arm, state_c = run_master_arm(
+        "mchaos", seed=11, kill_at_done=m_kill_at_done
+    )
+    noise = float(np.linalg.norm(state_a - state_b))
+    d_chaos = float(np.linalg.norm(state_c - state_a))
+    return {
+        "master_expected_tasks": m_tasks,
+        "master_kill_at_done": m_kill_at_done,
+        "master_clean_journal": clean_a["journal"],
+        "master_chaos_journal": chaos_arm["journal"],
+        "master_chaos_epoch_initial": chaos_arm["epoch_initial"],
+        "master_chaos_epoch_final": chaos_arm["epoch_final"],
+        "master_chaos_worker_survived": bool(
+            chaos_arm.get("worker_survived")
+        ),
+        "master_noise_l2": noise,
+        "master_chaos_l2": d_chaos,
+        "master_divergence_ratio": d_chaos / max(noise, 1e-12),
+    }
 
 
 def bench_hybrid(quick=False):
@@ -3846,6 +4170,54 @@ def main(argv=None):
                 "fault-free params than the silent-reinit hazard does)"
                 % ratio
             )
+        # -- master recovery arm gates (docs/master_recovery.md) -------
+        m_expected = res.get("master_expected_tasks", -1)
+        m_clean = res.get("master_clean_journal") or {}
+        m_chaos = res.get("master_chaos_journal") or {}
+        if (
+            m_clean.get("done") != m_expected
+            or m_clean.get("pending")
+        ):
+            problems.append(
+                "master fault-free arm accounting off: %r "
+                "(expected %d done, 0 pending)" % (m_clean, m_expected)
+            )
+        if m_chaos.get("done") != m_expected:
+            problems.append(
+                "master chaos arm lost or double-counted tasks: "
+                "journal done=%r, expected exactly %d"
+                % (m_chaos.get("done"), m_expected)
+            )
+        if m_chaos.get("pending"):
+            problems.append(
+                "master chaos arm left %r task(s) pending in the "
+                "journal" % m_chaos.get("pending")
+            )
+        if not res.get("master_chaos_worker_survived"):
+            problems.append(
+                "the worker did not survive the master outage"
+            )
+        if res.get("master_chaos_epoch_final") != res.get(
+            "master_chaos_epoch_initial", 0
+        ) + 1:
+            problems.append(
+                "master_epoch did not advance exactly once across the "
+                "kill: %r -> %r"
+                % (
+                    res.get("master_chaos_epoch_initial"),
+                    res.get("master_chaos_epoch_final"),
+                )
+            )
+        m_ratio = res.get("master_divergence_ratio")
+        if m_ratio is None or not m_ratio < 1.0:
+            problems.append(
+                "master chaos arm's final fleet state diverged %.3fx "
+                "the fault-free noise floor (L2 between two fault-free "
+                "runs under different task-shuffle seeds); gate <1.0x: "
+                "a master kill+replay must perturb the model no more "
+                "than an organic task reorder (measured ~0.03x)"
+                % (m_ratio if m_ratio is not None else float("nan"))
+            )
         if problems:
             print(
                 json.dumps(
@@ -3876,6 +4248,31 @@ def main(argv=None):
                 res["restored_restored_version"],
                 res["l2_restored_vs_clean"],
                 res["l2_reinit_vs_clean"],
+            ),
+            update,
+            lower_is_better=True,
+        )
+        _emit(
+            "master_chaos_recovery_divergence",
+            round(max(res["master_divergence_ratio"], 1e-4), 4),
+            "x L2 divergence of the final fleet state after a "
+            "SIGKILL-the-MASTER mid-job (journal replay + worker "
+            "failover, docs/master_recovery.md) vs the fault-free "
+            "noise floor (two fault-free runs under different "
+            "task-shuffle seeds; lower=better, gate <1.0). Kill at %d "
+            "of %d done tasks: journal counted every task done "
+            "exactly once (%d dispatched, %d requeued at recovery, "
+            "%d replayed ack(s) deduped, 0 pending), the in-process "
+            "worker rode the outage out on the failover channel, and "
+            "master_epoch advanced %d->%d across the relaunch"
+            % (
+                res.get("master_kill_at_done", -1),
+                res["master_expected_tasks"],
+                res["master_chaos_journal"].get("dispatched", -1),
+                res["master_chaos_journal"].get("requeued", -1),
+                res["master_chaos_journal"].get("deduped", -1),
+                res.get("master_chaos_epoch_initial", -1),
+                res.get("master_chaos_epoch_final", -1),
             ),
             update,
             lower_is_better=True,
@@ -4261,11 +4658,13 @@ def main(argv=None):
     section("sharded_dense_examples_per_sec", ["--sharded"], 600)
     section("ps_deepfm_examples_per_sec", ["--ps"], 900)
     section("ps_deepfm_examples_per_sec_hybrid", ["--hybrid"], 900)
-    # the recovery-plane gate (docs/ps_recovery.md): SIGKILL one PS
-    # shard mid-job under a snapshot cadence; the job must complete
-    # with the relaunched shard RESTORED and final dense params within
-    # the snapshot-staleness bound of the fault-free run
-    section("ps_chaos_recovery_divergence", ["--chaos"], 600)
+    # the recovery-plane gates: SIGKILL one PS shard mid-job under a
+    # snapshot cadence (docs/ps_recovery.md) AND SIGKILL the MASTER
+    # mid-job under the dispatch journal (docs/master_recovery.md);
+    # both jobs must complete — restored shard state within the
+    # snapshot-staleness bound, master-kill accounting exactly-once
+    # with the final state inside the fault-free noise floor
+    section("ps_chaos_recovery_divergence", ["--chaos"], 750)
     # device sections, cheapest diagnosis first (each shrinks its
     # workload and renames its metric _cpu when the backend is plain
     # CPU, so the suite fits the budget without an accelerator)

@@ -520,6 +520,18 @@ def add_common_args_between_master_and_worker(parser):
         "per-step logging of the reference is off the hot path. 0 "
         "disables loss logging",
     )
+    parser.add_argument(
+        "--master_failover_s",
+        type=float,
+        default=120.0,
+        help="Worker-side master failover budget in seconds "
+        "(docs/master_recovery.md): UNAVAILABLE master RPCs retry "
+        "with capped backoff for up to this long — the window a "
+        "SIGKILLed master needs to relaunch and replay its journal — "
+        "instead of killing the worker. Task acks replayed against "
+        "the new incarnation dedup by (trace_id, attempt). 0 restores "
+        "the historical die-on-outage behavior",
+    )
 
 
 def parse_master_args(master_args=None):
@@ -557,6 +569,37 @@ def parse_master_args(master_args=None):
         help="Allreduce-plane coordinator port base; each membership "
         "epoch binds base+epoch%%64 on rank 0's host. 0 picks ephemeral "
         "ports (single-host jobs)",
+    )
+    parser.add_argument(
+        "--master_journal_dir",
+        default="",
+        help="Master recovery plane (docs/master_recovery.md): append "
+        "a write-ahead journal of task lifecycle transitions, epoch "
+        "boundaries, the model-version clock, and membership changes "
+        "under this directory; a relaunched master (same args, same "
+        "dir) replays it before serving so done tasks stay done and "
+        "in-flight tasks requeue exactly once. Empty disables "
+        "durability (a master crash kills the job, the historical "
+        "behavior)",
+    )
+    parser.add_argument(
+        "--master_journal_fsync_ms",
+        type=float,
+        default=50.0,
+        help="Batched fsync cadence of the journal writer thread: "
+        "appends are enqueue-only on the RPC path and at most this "
+        "many milliseconds of accepted transitions can be lost to a "
+        "hard kill (a lost 'done' re-trains that task; accounting "
+        "stays exactly-once either way)",
+    )
+    parser.add_argument(
+        "--master_journal_segment_records",
+        type=pos_int,
+        default=4096,
+        help="Rotate + compact the journal after this many records: a "
+        "fresh segment opens with a state snapshot (write-to-temp + "
+        "atomic rename, the PR-10 manifest discipline) and the "
+        "superseded chain is unlinked, bounding replay time and disk",
     )
     add_common_params(parser)
     add_train_params(parser)

@@ -91,6 +91,13 @@ class TaskExecCounterKey:
     # the coordinating master (which applies no gradients itself) can
     # drive version-based triggers (evaluation cadence)
     MODEL_VERSION = "model_version"
+    # master recovery plane (docs/master_recovery.md): task acks carry
+    # the dispatcher's trace id + attempt so an ack replayed against a
+    # RELAUNCHED master (whose task ids are freshly minted) resolves to
+    # the journaled task and dedups if the dead incarnation already
+    # counted it
+    TRACE_ID = "trace_id"
+    ATTEMPT = "attempt"
 
 
 class ODPSConfig:

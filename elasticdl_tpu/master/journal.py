@@ -1,0 +1,545 @@
+"""Master recovery plane: durable dispatch journal + boot-time replay.
+
+The master is the one process whose death still killed the whole job:
+PR 10 made a PS crash a bounded rollback, but a master crash lost the
+task ledger (which record ranges trained, which were in flight), the
+model-version clock, and the membership epoch — and every worker died
+with it. This module is the durability half of the master recovery
+plane (docs/master_recovery.md); the worker-side failover protocol
+lives in rpc/failover.py + master/rpc_service.MasterClient.
+
+Design (the PR-10 snapshot discipline, applied to an append log):
+
+- **Write-ahead, off the hot path.** :meth:`MasterJournal.append` is an
+  enqueue under a small lock (dict build + list append — no IO); a
+  background writer drains the buffer on a batched fsync cadence
+  (``fsync_interval_s``), so the dispatcher's ledger lock is never held
+  across a disk write, let alone an fsync (edlint R5 / locktrace
+  discipline: lock order is dispatcher lock -> journal ``_mu``, and the
+  file IO happens under a separate ``_io`` lock only).
+- **Atomic segment rotation.** When the active segment passes
+  ``segment_records``, the writer serializes the journal's incrementally
+  maintained replay state into a fresh ``state`` record, writes it into
+  a ``tmp-`` file, fsyncs, and ``os.replace``s it to the next
+  ``seg-%08d.jsonl`` — the PR-10 write-to-temp + rename commit point.
+  Older segments are unlinked only after the rename; a crash mid-rotate
+  leaves either a manifest-less temp (ignored) or the old chain.
+- **Newest-valid replay.** Boot walks segments newest first looking for
+  one that OPENS with a valid ``state`` record, then applies everything
+  from there forward. A torn final line (the batch the crash caught
+  mid-write) is dropped with a warning; records behind a published
+  state are never needed. Replay is a pure fold — replaying the same
+  chain twice yields the same :class:`RecoveryState`.
+- **Epochs.** Every boot mints a ``master_epoch`` (persisted counter in
+  the journal dir, the ``shard_epoch`` pattern from ps/snapshot.py)
+  carried in every master RPC reply so workers detect the restart.
+
+Record kinds (one JSON object per line, ``k`` field)::
+
+    state     segment-opening compaction of everything below
+    epoch     a training epoch began (epoch)
+    dispatch  task handed to a worker (task, trace, attempt, key, worker)
+    done      task completed (trace, attempt, key)
+    requeue   task re-queued — worker failure or boot-time recovery
+    dup       a replayed ack deduped against an already-done trace
+    version   model-version advance (version)
+    member    membership change (event, worker, epoch)
+
+``key`` identifies a task by WHAT it covers — ``[type, epoch,
+shard_name, start, end]`` — not by its ``task_id``: task ids are minted
+per boot, but the record ranges are deterministic from the job args, so
+a relaunched master (same args, the instance-manager relaunch contract)
+regenerates the same key space and the journal's done-set maps onto it
+exactly. ``trace`` is the PR-6 lifecycle trace id, preserved across
+requeues AND across master boots, which makes it the dedup key for a
+``report_task_result`` replayed against the new incarnation.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.ps.snapshot import mint_shard_epoch
+
+_SEG_PREFIX = "seg-"
+_TMP_PREFIX = "tmp-"
+_FORMAT_VERSION = 1
+
+
+def mint_master_epoch(journal_dir=None):
+    """A fresh boot id for this master incarnation (persisted counter
+    when a journal dir exists, time-derived otherwise — the
+    ``mint_shard_epoch`` contract, shared implementation)."""
+    return mint_shard_epoch(journal_dir)
+
+
+def task_key(task_type, epoch, shard_name, start, end):
+    """The boot-stable identity of one task (see module docstring)."""
+    return (int(task_type), int(epoch), str(shard_name), int(start),
+            int(end))
+
+
+class RecoveryState:
+    """The fold of one journal chain: what the next boot must restore.
+
+    ``done_keys``: keys completed in the epoch in progress (earlier
+    training epochs completed wholesale — their keys recur next epoch
+    and are cleared at each ``epoch`` record). ``pending``: trace ->
+    (attempt, key, xc) for tasks dispatched but neither done nor still
+    resolvable — the in-flight-at-crash set the boot requeues exactly
+    once. ``done_traces``: the dedup set for replayed acks — a dict
+    trace -> (type, key_epoch) so rollovers can GC spent epochs' traces
+    (a rolled-over task's ack replay window is long gone, and an
+    unbounded set would grow every segment-head state record with the
+    job's total completed-task count).
+    """
+
+    def __init__(self):
+        self.epoch = 0
+        self.version = 0
+        self.trace_seq = 0
+        self.task_seq = 0  # highest task id any incarnation minted
+        self.member_epoch = 0
+        self.done_keys = set()
+        self.done_traces = {}  # trace -> (type, key_epoch)
+        # trace -> {"attempt": int, "key": tuple, "xc": dict|None}
+        # for dispatched-but-not-done tasks (inflight or requeued)
+        self.pending = {}
+        self.counters = {
+            "dispatched": 0,
+            "done": 0,
+            "requeued": 0,
+            "deduped": 0,
+        }
+
+    # -- the fold ------------------------------------------------------------
+
+    def apply(self, rec):
+        kind = rec.get("k")
+        if kind == "state":
+            self._load(rec)
+        elif kind == "epoch":
+            e = int(rec.get("epoch", 0))
+            if e > self.epoch:
+                self.epoch = e
+                # keys carry the epoch they were created in (key[1]):
+                # a rollover garbage-collects done keys of COMPLETED
+                # earlier epochs (those tasks can never regenerate),
+                # but pending entries survive — an epoch-0 straggler
+                # still in flight while epoch 1 runs must requeue at
+                # recovery like any other in-flight task
+                from elasticdl_tpu.common.constants import TaskType
+
+                train = int(TaskType.TRAINING)
+                self.done_keys = {
+                    key
+                    for key in self.done_keys
+                    if key[0] != train or key[1] >= e
+                }
+                self.done_traces = {
+                    t: te
+                    for t, te in self.done_traces.items()
+                    if te[0] != train or te[1] >= e
+                }
+        elif kind == "dispatch":
+            trace = rec["trace"]
+            self._note_trace(trace)
+            try:
+                self.task_seq = max(self.task_seq, int(rec.get("task", 0)))
+            except (TypeError, ValueError):
+                pass
+            if trace not in self.done_traces:
+                self.pending[trace] = {
+                    "attempt": int(rec.get("attempt", 0)),
+                    "key": tuple(rec["key"]),
+                    "xc": rec.get("xc"),
+                }
+            self.counters["dispatched"] += 1
+        elif kind == "done":
+            trace = rec["trace"]
+            self._note_trace(trace)
+            if trace not in self.done_traces:
+                key = tuple(rec["key"])
+                self.done_traces[trace] = (key[0], key[1])
+                self.done_keys.add(key)
+                self.pending.pop(trace, None)
+                self.counters["done"] += 1
+        elif kind == "requeue":
+            trace = rec["trace"]
+            self._note_trace(trace)
+            if trace in self.pending:
+                self.pending[trace]["attempt"] = int(
+                    rec.get("attempt", self.pending[trace]["attempt"])
+                )
+            self.counters["requeued"] += 1
+        elif kind == "dup":
+            self.counters["deduped"] += 1
+        elif kind == "version":
+            self.version = max(self.version, int(rec.get("version", 0)))
+        elif kind == "member":
+            self.member_epoch = max(
+                self.member_epoch, int(rec.get("epoch", 0))
+            )
+        # unknown kinds are skipped: a newer writer's informational
+        # records must not wedge an older reader's replay
+
+    def _note_trace(self, trace):
+        try:
+            self.trace_seq = max(self.trace_seq, int(str(trace)[1:]))
+        except (TypeError, ValueError):
+            pass
+
+    # -- (de)serialization for segment-opening state records -----------------
+
+    def to_record(self):
+        return {
+            "k": "state",
+            "format": _FORMAT_VERSION,
+            "epoch": self.epoch,
+            "version": self.version,
+            "trace_seq": self.trace_seq,
+            "task_seq": self.task_seq,
+            "member_epoch": self.member_epoch,
+            "counters": dict(self.counters),
+            "done_traces": sorted(
+                [t, te[0], te[1]] for t, te in self.done_traces.items()
+            ),
+            "done_keys": sorted(list(k) for k in self.done_keys),
+            "pending": [
+                [trace, p["attempt"], list(p["key"]), p["xc"]]
+                for trace, p in sorted(self.pending.items())
+            ],
+            "wrote_unix": round(time.time(), 3),
+        }
+
+    def _load(self, rec):
+        self.epoch = int(rec.get("epoch", 0))
+        self.version = int(rec.get("version", 0))
+        self.trace_seq = int(rec.get("trace_seq", 0))
+        self.task_seq = int(rec.get("task_seq", 0))
+        self.member_epoch = int(rec.get("member_epoch", 0))
+        self.counters.update(rec.get("counters") or {})
+        self.done_traces = {
+            t: (ty, ep) for t, ty, ep in rec.get("done_traces") or []
+        }
+        self.done_keys = {
+            tuple(key) for key in rec.get("done_keys") or []
+        }
+        self.pending = {
+            trace: {"attempt": int(a), "key": tuple(key), "xc": xc}
+            for trace, a, key, xc in rec.get("pending") or []
+        }
+
+
+def _segment_indices(journal_dir):
+    out = []
+    for path in glob.glob(
+        os.path.join(journal_dir, _SEG_PREFIX + "*.jsonl")
+    ):
+        stem = os.path.basename(path)[len(_SEG_PREFIX):-len(".jsonl")]
+        try:
+            out.append(int(stem))
+        except ValueError:
+            continue
+    return sorted(out)
+
+
+def _seg_path(journal_dir, idx):
+    return os.path.join(journal_dir, "%s%08d.jsonl" % (_SEG_PREFIX, idx))
+
+
+class MasterJournal:
+    """Write-ahead journal for one master's dispatch state.
+
+    Lifecycle: construct -> :meth:`replay` (read-only fold of the
+    on-disk chain) -> the dispatcher applies the recovery ->
+    :meth:`start` (opens a FRESH segment whose head ``state`` record is
+    the post-recovery compaction — the boot is itself a compaction
+    point — and starts the writer thread). ``append`` before ``start``
+    only folds into the in-memory state; the boot segment's head record
+    carries it.
+    """
+
+    def __init__(
+        self,
+        journal_dir,
+        fsync_interval_s=0.05,
+        segment_records=4096,
+    ):
+        self._dir = journal_dir
+        os.makedirs(journal_dir, exist_ok=True)
+        self._fsync_interval = max(0.001, float(fsync_interval_s))
+        self._segment_records = max(16, int(segment_records))
+        self._mu = threading.Lock()  # buffer + state + counters
+        self._io = threading.Lock()  # file handle + fsync
+        self._buf = []
+        self._state = RecoveryState()
+        self._records_in_segment = 0
+        self._seg_idx = 0
+        self._file = None
+        self._wake = threading.Event()
+        self._closed = threading.Event()
+        self._thread = None
+        self._append_seq = 0  # appends accepted (durability watermark)
+        self._flushed_seq = 0  # appends fsynced
+
+    @property
+    def directory(self):
+        return self._dir
+
+    # -- replay (boot, before serving) ---------------------------------------
+
+    def replay(self):
+        """Fold the on-disk chain into a :class:`RecoveryState`.
+
+        Starts from the NEWEST segment that opens with a valid
+        ``state`` record (older segments are superseded by it); falls
+        back to the oldest segment when none does (a first-generation
+        chain). A torn final line — the append batch the crash caught
+        mid-write — is dropped with a warning; a torn line anywhere
+        else ends the fold there (nothing after it is trustworthy).
+        The journal adopts the folded state, so the next rotation's
+        compaction includes it. Pure: replaying the same chain twice
+        yields an identical state.
+        """
+        indices = _segment_indices(self._dir)
+        start_at = 0
+        for pos in range(len(indices) - 1, -1, -1):
+            head = self._read_head(_seg_path(self._dir, indices[pos]))
+            if head is not None and head.get("k") == "state":
+                start_at = pos
+                break
+        state = RecoveryState()
+        torn = 0
+        for pos in range(start_at, len(indices)):
+            path = _seg_path(self._dir, indices[pos])
+            last_segment = pos == len(indices) - 1
+            with open(path, "rb") as f:
+                lines = f.read().split(b"\n")
+            if lines and lines[-1] == b"":
+                lines.pop()
+            for i, line in enumerate(lines):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    if last_segment and i == len(lines) - 1:
+                        logger.warning(
+                            "journal %s: dropping torn final record",
+                            path,
+                        )
+                    else:
+                        logger.warning(
+                            "journal %s: torn record at line %d; "
+                            "replay stops there",
+                            path,
+                            i + 1,
+                        )
+                        self._adopt(state, indices)
+                        return state
+                    continue
+                state.apply(rec)
+        self._adopt(state, indices)
+        return state
+
+    def _adopt(self, state, indices):
+        with self._mu:
+            self._state = state
+            self._seg_idx = (indices[-1] if indices else 0) + 1
+
+    @staticmethod
+    def _read_head(path):
+        try:
+            with open(path, "rb") as f:
+                line = f.readline()
+            return json.loads(line)
+        except (OSError, ValueError):
+            return None
+
+    # -- the write side ------------------------------------------------------
+
+    def start(self):
+        """Open the boot segment (head = the post-recovery compaction)
+        and start the writer thread. Idempotent."""
+        if self._thread is not None:
+            return self
+        self._rotate_locked_entry()
+        self._thread = threading.Thread(
+            target=self._writer_loop,
+            daemon=True,
+            name="edl-master-journal",
+        )
+        self._thread.start()
+        return self
+
+    def append(self, kind, **fields):
+        """Enqueue one record; never touches the disk (the writer
+        thread owns all IO). Safe under the dispatcher's ledger lock."""
+        rec = {"k": kind}
+        rec.update(fields)
+        with self._mu:
+            self._state.apply(rec)
+            self._buf.append(rec)
+            self._append_seq += 1
+        self._wake.set()
+
+    def flush(self):
+        """Synchronously drain + fsync everything appended so far (the
+        SIGTERM drain path and tests).
+
+        ``_io`` is taken BEFORE the buffer drain: a writer-thread
+        rotation between a drain and its write would fold the drained
+        records into the new segment's head state AND leave their lines
+        in the chain — double-applying them (inflated counters) on the
+        next replay. Holding ``_io`` across both pins the lines to the
+        pre-rotation segment, which the rotation then supersedes."""
+        with self._io:
+            with self._mu:
+                batch, self._buf = self._buf, []
+                seq = self._append_seq
+            self._write_io(batch)
+        with self._mu:
+            self._records_in_segment += len(batch)
+            self._flushed_seq = max(self._flushed_seq, seq)
+
+    def counts(self):
+        """Cumulative lifecycle counters + live pending size, for
+        ``master_status`` and the chaos gates."""
+        with self._mu:
+            out = dict(self._state.counters)
+            out["pending"] = len(self._state.pending)
+            out["unflushed"] = self._append_seq - self._flushed_seq
+        return out
+
+    def state_snapshot(self):
+        """A compaction record of the CURRENT in-memory fold (tests)."""
+        with self._mu:
+            return self._state.to_record()
+
+    def close(self):
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.flush()
+        with self._io:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    # -- writer internals ----------------------------------------------------
+
+    def _writer_loop(self):
+        while not self._closed.is_set():
+            self._wake.wait(self._fsync_interval)
+            self._wake.clear()
+            # batch everything queued since the last cadence tick into
+            # one write + one fsync
+            with self._mu:
+                batch, self._buf = self._buf, []
+                seq = self._append_seq
+                rotate = (
+                    self._records_in_segment + len(batch)
+                    > self._segment_records
+                )
+            if rotate:
+                # the compaction state (taken under _mu) already folds
+                # the drained batch — the fresh segment's head record
+                # supersedes it, so the batch itself is dropped (and
+                # rotation marks everything applied so far as flushed)
+                self._rotate_locked_entry()
+                continue
+            if batch:
+                self._write_batch(batch, seq)
+
+    def _write_batch(self, batch, seq):
+        with self._io:
+            self._write_io(batch)
+        with self._mu:
+            self._records_in_segment += len(batch)
+            self._flushed_seq = max(self._flushed_seq, seq)
+
+    def _write_io(self, batch):
+        # _io held by caller
+        f = self._ensure_file()
+        if batch:
+            f.write(
+                b"".join(
+                    json.dumps(rec, default=str).encode("utf-8") + b"\n"
+                    for rec in batch
+                )
+            )
+        f.flush()
+        os.fsync(f.fileno())
+
+    def _ensure_file(self):
+        # _io held by caller; _seg_idx is owned by _mu (lock order is
+        # always _io -> _mu, never the reverse: no path takes _io while
+        # holding _mu)
+        if self._file is None:
+            with self._mu:
+                idx = self._seg_idx
+            self._file = open(_seg_path(self._dir, idx), "ab")
+        return self._file
+
+    def _rotate_locked_entry(self):
+        """Publish a fresh segment opened by the current compaction
+        state, atomically (write-to-temp + rename), then unlink the
+        superseded chain.
+
+        ``_io`` is held across the WHOLE snapshot-and-publish (then
+        ``_mu`` inside — the fixed _io -> _mu order): a concurrent
+        flush() serializes entirely before or entirely after. Before:
+        its records hit the old segment, and the snapshot — taken
+        after — includes them, so unlinking the old chain loses
+        nothing. After: the snapshot already covers everything
+        flushable and flush drains only post-rotation appends into the
+        new segment. Without this hold, a record appended between the
+        snapshot and the publish could be flushed (reported durable!)
+        into the old segment that the publish then unlinks."""
+        with self._io:
+            with self._mu:
+                # any still-buffered records are folded into this
+                # snapshot; dropping them keeps the chain free of
+                # covered duplicates
+                self._buf = []
+                snap = self._state.to_record()
+                self._seg_idx += 1
+                next_idx = self._seg_idx
+                self._records_in_segment = 1
+                self._flushed_seq = self._append_seq
+            final = _seg_path(self._dir, next_idx)
+            tmp = os.path.join(
+                self._dir,
+                "%s%08d.%d.jsonl" % (_TMP_PREFIX, next_idx, os.getpid()),
+            )
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            with open(tmp, "wb") as f:
+                f.write(
+                    json.dumps(snap, default=str).encode("utf-8") + b"\n"
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+            self._file = open(final, "ab")
+        for idx in _segment_indices(self._dir):
+            if idx < next_idx:
+                try:
+                    os.remove(_seg_path(self._dir, idx))
+                except OSError:
+                    pass
+        for stale in glob.glob(
+            os.path.join(self._dir, _TMP_PREFIX + "*")
+        ):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass

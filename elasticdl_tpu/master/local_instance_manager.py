@@ -31,6 +31,7 @@ class LocalInstanceManager:
         membership=None,
         log_dir=None,
         num_standby=0,
+        master_command=None,
     ):
         """``worker_command(worker_id) -> argv``; ``ps_command(ps_id) ->
         argv``. Worker ids grow monotonically across relaunches like the
@@ -47,6 +48,13 @@ class LocalInstanceManager:
         self._worker_command = worker_command
         self._num_ps = num_ps
         self._ps_command = ps_command
+        # external-supervisor form (docs/master_recovery.md): when this
+        # manager runs OUTSIDE the master (the chaos harness / fleet
+        # tests / bench drive it from a driver process), it also owns
+        # the master process — SIGKILL relaunches on the crash budget,
+        # the rc-75 drain-journal-and-exit path relaunches budget-FREE
+        # (PS-plane parity). ``master_command() -> argv``.
+        self._master_command = master_command
         self._restart_policy = restart_policy
         self._max_relaunches = max_relaunches
         self._env = env
@@ -97,6 +105,16 @@ class LocalInstanceManager:
     def start_all_ps(self):
         for ps_id in range(self._num_ps):
             self._spawn(("ps", ps_id), self._ps_command(ps_id))
+
+    def start_master(self):
+        """Spawn the supervised master process (external-supervisor
+        form only; a master-resident manager never supervises itself)."""
+        if self._master_command is None:
+            raise ValueError(
+                "no master_command configured: this manager does not "
+                "supervise a master process"
+            )
+        self._spawn(("master", 0), self._master_command())
 
     def start_workers(self):
         for _ in range(self._num_workers):
@@ -263,6 +281,54 @@ class LocalInstanceManager:
                 else:
                     new_id = self._start_worker()
                     logger.info("Relaunched worker as id %d", new_id)
+        elif kind == "master":
+            if returncode == 0:
+                logger.info("Master completed (job finished)")
+                return
+            if returncode == 75:  # EX_TEMPFAIL: drain-journal-and-exit
+                # the master flushed its dispatch journal under SIGTERM
+                # (master.install_drain_handler) — benign, does NOT
+                # consume the crash-relaunch budget, exactly the PS
+                # plane's drain contract (docs/master_recovery.md)
+                relaunch = False
+                with self._lock:
+                    relaunch = (
+                        not self._stopping
+                        and self._restart_policy != "Never"
+                    )
+                if relaunch:
+                    logger.info(
+                        "Master drained (exit 75); relaunching "
+                        "(budget exempt)"
+                    )
+                    self._spawn(key, self._master_command())
+                return
+            spend = False
+            with self._lock:
+                if (
+                    not self._stopping
+                    and self._restart_policy != "Never"
+                    and self._relaunches < self._max_relaunches
+                ):
+                    self._relaunches += 1
+                    spend = True
+            if spend:
+                logger.warning(
+                    "Master exited with %d; relaunching to replay its "
+                    "journal",
+                    returncode,
+                )
+                self._spawn(key, self._master_command())
+            else:
+                # a log that claims a relaunch that never happens sends
+                # the operator hunting a boot that doesn't exist while
+                # workers burn their failover budgets against a dead port
+                logger.error(
+                    "Master exited with %d; relaunch budget exhausted "
+                    "(or stopping/Never policy) — NOT relaunching, the "
+                    "job is headless",
+                    returncode,
+                )
         else:
             if returncode == 75:  # EX_TEMPFAIL: graceful drain
                 # the PS drained a final shard snapshot under SIGTERM
@@ -361,6 +427,38 @@ class LocalInstanceManager:
             proc = self._procs.get(("ps", ps_id))
         if proc:
             proc.terminate()
+
+    def kill_master(self):
+        """Chaos/fault injection: SIGKILL the supervised master.
+
+        The hard-crash path — no journal drain runs, so the relaunch
+        replays whatever the batched-fsync cadence made durable (the
+        bounded-loss contract, docs/master_recovery.md). The watch loop
+        relaunches on the crash budget (tools/chaos.py drives this for
+        scripted master outages)."""
+        import signal
+
+        with self._lock:
+            proc = self._procs.get(("master", 0))
+        if proc:
+            try:
+                proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+
+    def terminate_master(self):
+        """Graceful master preemption (SIGTERM): the master drains its
+        dispatch journal and exits 75; the watch loop relaunches
+        without spending the crash budget."""
+        with self._lock:
+            proc = self._procs.get(("master", 0))
+        if proc:
+            proc.terminate()
+
+    def live_master(self):
+        with self._lock:
+            proc = self._procs.get(("master", 0))
+        return proc is not None and proc.poll() is None
 
     def live_ps(self):
         with self._lock:

@@ -40,6 +40,7 @@ def _make_task_dispatcher(
     records_per_task,
     num_epochs,
     data_reader_params=None,
+    journal=None,
 ):
     """Reference master.py:38-65."""
 
@@ -60,6 +61,7 @@ def _make_task_dispatcher(
         prediction_f_records,
         records_per_task,
         num_epochs,
+        journal=journal,
     )
 
 
@@ -94,6 +96,35 @@ class Master:
             get_dict_from_params_str,
         )
 
+        # master recovery plane (docs/master_recovery.md): the durable
+        # dispatch journal + this boot's epoch id. The journal is NOT
+        # replayed here — prepare() replays it behind a "restoring"
+        # /healthz before the RPC plane serves, so no worker ever talks
+        # to a half-restored ledger.
+        from elasticdl_tpu.master.journal import (
+            MasterJournal,
+            mint_master_epoch,
+        )
+
+        journal_dir = getattr(args, "master_journal_dir", "") or ""
+        self.journal = (
+            MasterJournal(
+                journal_dir,
+                fsync_interval_s=(
+                    float(getattr(args, "master_journal_fsync_ms", 50))
+                    / 1000.0
+                ),
+                segment_records=int(
+                    getattr(args, "master_journal_segment_records", 4096)
+                ),
+            )
+            if journal_dir
+            else None
+        )
+        self.master_epoch = mint_master_epoch(journal_dir or None)
+        self._health = "restoring"
+        self._stopped = False
+
         self.task_d = _make_task_dispatcher(
             getattr(args, "training_data", ""),
             getattr(args, "validation_data", ""),
@@ -103,6 +134,7 @@ class Master:
             get_dict_from_params_str(
                 getattr(args, "data_reader_params", "")
             ),
+            journal=self.journal,
         )
 
         model_module = load_module(
@@ -182,6 +214,7 @@ class Master:
             use_async=getattr(args, "use_async", False),
             coordinates_only=(strategy == DistributionStrategy.ALLREDUCE),
             telemetry=self.telemetry,
+            journal=self.journal,
         )
         # membership epochs for the elastic allreduce plane (the PS plane
         # needs no inter-worker world)
@@ -260,6 +293,7 @@ class Master:
                     os.environ.get("EDL_FORM_GRACE_SECS", "30")
                 ),
                 world_size_multiple=multiple,
+                journal=self.journal,
             )
         self._server = None
         self.instance_manager = self._create_instance_manager(args)
@@ -405,18 +439,65 @@ class Master:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def _recover_from_journal(self):
+        """Replay the dispatch journal and fast-forward the ledger —
+        BEFORE the RPC plane serves a single call, while /healthz says
+        "restoring" (docs/master_recovery.md)."""
+        if self.journal is None:
+            return
+        state = self.journal.replay()
+        self.task_d.apply_recovery(state)
+        self.master_servicer.restore_version(state.version)
+        if self.membership is not None and state.member_epoch > 0:
+            self.membership.seed_epoch(state.member_epoch)
+        # the boot is a compaction point: the journal reopens on a
+        # fresh segment headed by the post-recovery state and starts
+        # its batched-fsync writer thread
+        self.journal.start()
+
+    def _master_status(self):
+        """The ``master_status`` probe body (rpc_service wires it)."""
+        status = {
+            "state": self._health,
+            "finished": self.task_d.finished(),
+            "task_queues": self.task_d.queue_depths(),
+        }
+        if self.journal is not None:
+            status["journal"] = self.journal.counts()
+        return status
+
     def prepare(self):
+        # readiness first: a relaunch probe must see "restoring" (503)
+        # while the journal replays, not route traffic into a
+        # half-restored ledger — and the endpoint re-binds the fixed
+        # port its killed predecessor held (TelemetryHTTPServer._bind)
+        telemetry_port = getattr(self.args, "telemetry_port", None)
+        if telemetry_port is not None and telemetry_port >= 0:
+            from elasticdl_tpu.master.telemetry import (
+                TelemetryHTTPServer,
+            )
+
+            self._telemetry_http = TelemetryHTTPServer(
+                self.telemetry,
+                port=telemetry_port,
+                health_fn=lambda: self._health,
+            )
+            self.telemetry_port = self._telemetry_http.port
+        self._recover_from_journal()
         if self.evaluation_service:
             self.evaluation_service.start()
         from elasticdl_tpu.rpc.core import serve
         from elasticdl_tpu.rpc.shm_transport import install_shm_endpoint
 
         port = self.args.port if self.args.port is not None else 50001
-        methods = MasterRpcService(
+        self._rpc_service = MasterRpcService(
             self.master_servicer,
             membership=self.membership,
             wire_dtype=getattr(self.args, "wire_dtype", ""),
-        ).rpc_methods()
+            master_epoch=self.master_epoch,
+            status_fn=self._master_status,
+        )
+        methods = self._rpc_service.rpc_methods()
         # shared-memory reply path for co-located worker pods
         # (docs/wire.md): workers negotiate per channel via
         # transport_hello and route ONLY their get_model pulls through
@@ -425,17 +506,12 @@ class Master:
         methods, self._shm_registry = install_shm_endpoint(methods)
         self._server = serve(methods, port)
         self.port = self._server._edl_port
-        logger.info("Master RPC server started on port %d", self.port)
-        telemetry_port = getattr(self.args, "telemetry_port", None)
-        if telemetry_port is not None and telemetry_port >= 0:
-            from elasticdl_tpu.master.telemetry import (
-                TelemetryHTTPServer,
-            )
-
-            self._telemetry_http = TelemetryHTTPServer(
-                self.telemetry, port=telemetry_port
-            )
-            self.telemetry_port = self._telemetry_http.port
+        self._health = "serving"
+        logger.info(
+            "Master RPC server started on port %d (master_epoch %d)",
+            self.port,
+            self.master_epoch,
+        )
         logdir = getattr(self.args, "tensorboard_log_dir", "")
         if logdir:
             from elasticdl_tpu.master.telemetry import (
@@ -457,6 +533,7 @@ class Master:
                 if self.task_d.finished():
                     if self.task_d.invoke_deferred_callback():
                         continue  # a SAVE_MODEL task was just queued
+                    self._linger_for_pollers()
                     break
                 self._stop_requested.wait(poll_secs)
         except KeyboardInterrupt:
@@ -465,10 +542,38 @@ class Master:
             self.stop()
         return 0
 
+    def _linger_for_pollers(self):
+        """Serve briefly past the last ack when REMOTE workers exist.
+
+        An OS-process worker learns "no more tasks" only from a
+        get_task reply; a master that stops the instant the ledger
+        drains races the last poller into its failover retry loop —
+        burning the whole outage budget against a master that exited
+        SUCCESSFULLY, then dying nonzero on a finished job. In-process
+        jobs (the worker holds the servicer directly — api.py local
+        mode, tests) never set served_get_task and keep the instant
+        exit (docs/master_recovery.md)."""
+        import os as _os
+
+        grace = float(_os.environ.get("EDL_MASTER_EXIT_GRACE_S", "3"))
+        rpc_service = getattr(self, "_rpc_service", None)
+        if (
+            grace > 0
+            and rpc_service is not None
+            and rpc_service.served_get_task
+        ):
+            self._stop_requested.wait(grace)
+
     def request_stop(self):
         self._stop_requested.set()
 
     def stop(self):
+        if self._stopped:
+            # the SIGTERM drain path stops the master and then lets the
+            # run loop's finally reach here again — idempotent by flag
+            # (several closes below are not re-entrant on their own)
+            return
+        self._stopped = True
         if self.evaluation_service:
             self.evaluation_service.stop()
         if self.tb_service:
@@ -499,9 +604,39 @@ class Master:
             # segments included (their atexit unlink never ran)
             self._shm_registry.close()
             self._shm_registry = None
+        if self.journal is not None:
+            # settle every queued lifecycle record (flush + fsync) so a
+            # clean stop is always a consistent replay point
+            self.journal.close()
+
+    def install_drain_handler(self):
+        """SIGTERM = graceful preemption: drain the dispatch journal
+        (flush + fsync) and exit 75 — the budget-exempt code the
+        instance manager relaunches, PS-plane parity
+        (ps/parameter_server.install_drain_handler). Installed only by
+        the process entry; embedded masters keep their host's
+        handlers."""
+        import signal
+        import sys
+
+        def _drain(signum, frame):
+            logger.warning(
+                "SIGTERM: draining the dispatch journal before exit"
+            )
+            try:
+                if self.journal is not None:
+                    self.journal.flush()
+            except Exception as err:  # noqa: BLE001 — exit regardless
+                logger.error("journal drain failed: %s", err)
+            self.stop()
+            sys.exit(75)
+
+        signal.signal(signal.SIGTERM, _drain)
 
 
 def main():
+    import os as _os
+
     from elasticdl_tpu.common.args import parse_master_args
     from elasticdl_tpu.common.jax_platform import honor_jax_platforms_env
 
@@ -509,7 +644,10 @@ def main():
     args = parse_master_args()
     master = Master(args)
     master.prepare()
-    return master.run()
+    master.install_drain_handler()
+    return master.run(
+        poll_secs=float(_os.environ.get("EDL_MASTER_POLL_SECS", "30"))
+    )
 
 
 if __name__ == "__main__":

@@ -122,6 +122,7 @@ class MembershipService:
         confirm_timeout_secs=None,
         stale_form_secs=None,
         world_size_multiple=1,
+        journal=None,
     ):
         """``base_port=0`` picks ephemeral ports (single-host jobs, where
         the master and rank 0 share the host); on a cluster pass a fixed
@@ -172,7 +173,16 @@ class MembershipService:
         self._stale_form_secs = stale_form_secs
         self._lock = threading.Lock()
         self._live = {}  # worker_id -> advertised host
+        # a RELAUNCHED master re-seeds this past the journaled
+        # high-water mark via seed_epoch() (docs/master_recovery.md):
+        # survivors compare epochs for change detection, and a counter
+        # reset to 0 could collide with a worker's remembered epoch
+        # and hide the re-form
         self._epoch = 0
+        # membership changes append to the master journal (enqueue
+        # only; the journal thread owns all IO) so the next boot knows
+        # that high-water mark
+        self._journal = journal
         self._world = []  # [(worker_id, host)] of the current epoch
         self._coordinator = None
         self._formed_initial = False
@@ -216,6 +226,13 @@ class MembershipService:
     @property
     def epoch(self):
         return self._epoch
+
+    def seed_epoch(self, floor):
+        """Boot-time recovery: jump the epoch counter past a previous
+        incarnation's journaled high-water mark (called before the RPC
+        plane serves, so no poll races it)."""
+        with self._lock:
+            self._epoch = max(self._epoch, int(floor))
 
     def _formation_in_flight(self):
         """True while the current world is still coming up: either the
@@ -298,6 +315,13 @@ class MembershipService:
             from elasticdl_tpu.utils import profiling
 
             profiling.events.emit("worker_join", _ship=False, **join_event)
+            if self._journal is not None:
+                self._journal.append(
+                    "member",
+                    event="join",
+                    worker=worker_id,
+                    epoch=self._epoch,
+                )
 
     def _register_locked(self, worker_id, host):
         """The state transition; returns worker_join event fields when
@@ -404,6 +428,13 @@ class MembershipService:
             profiling.events.emit(
                 "worker_leave", _ship=False, **leave_event
             )
+            if self._journal is not None:
+                self._journal.append(
+                    "member",
+                    event="leave",
+                    worker=worker_id,
+                    epoch=self._epoch,
+                )
 
     def _remove_locked(
         self, worker_id, departing, defer_bump_secs, exit_code

@@ -9,6 +9,7 @@ reference achieves this with gRPC stubs + InProcessMaster duck-typing).
 import numpy as np
 
 from elasticdl_tpu.common.constants import GetModelMethod, TaskType
+from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.common.tensor import Tensor
 from elasticdl_tpu.master.servicer import TaskResponse
 from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
@@ -19,14 +20,38 @@ class MasterRpcService:
 
     ``wire_dtype="bfloat16"`` halves model-pull wire bytes (see
     rpc/wire_compression.py); gradient decompression is driven by the
-    request's own ``compressed_f32`` field, so it works regardless."""
+    request's own ``compressed_f32`` field, so it works regardless.
 
-    def __init__(self, servicer, membership=None, wire_dtype=""):
+    ``master_epoch``/``status_fn`` are the recovery plane's identity
+    surface (docs/master_recovery.md): every reply is stamped with this
+    incarnation's boot id — the ``shard_epoch`` pattern — so workers
+    detect a relaunch from ANY call, and the ``master_status`` probe
+    reports serving state + journal counters for relaunch probes and
+    the chaos harness."""
+
+    def __init__(
+        self,
+        servicer,
+        membership=None,
+        wire_dtype="",
+        master_epoch=0,
+        status_fn=None,
+    ):
         self._s = servicer
         self._membership = membership
         self._wire_dtype = wire_dtype
+        self._master_epoch = int(master_epoch)
+        self._status_fn = status_fn
+        # True once any REMOTE worker polled for work: the master's
+        # run loop uses it to linger briefly after the ledger drains,
+        # so the last poller learns "no more tasks" instead of burning
+        # its failover budget against a cleanly-exited master
+        # (docs/master_recovery.md). In-process jobs (worker holds the
+        # servicer directly) never set it and keep the instant exit.
+        self.served_get_task = False
 
     def get_task(self, req):
+        self.served_get_task = True
         task_type = req.get("task_type")
         res = self._s.get_task(
             req.get("worker_id", -1),
@@ -84,6 +109,26 @@ class MasterRpcService:
             req.get("exec_counters") or None,
         )
         return {}
+
+    def master_status(self, req):
+        """Recovery-plane probe (idempotent, edlint R9): this
+        incarnation's boot id, serving state, version, and journal
+        counters — what relaunch probes and the chaos harness poll."""
+        status = {
+            "master_epoch": self._master_epoch,
+            "state": "serving",
+            "version": self._s.get_model_version(),
+        }
+        if self._status_fn is not None:
+            try:
+                status.update(self._status_fn() or {})
+            except Exception:
+                # a probe must answer even mid-teardown; the identity
+                # fields above are still the load-bearing part
+                logger.warning(
+                    "master_status status_fn failed", exc_info=True
+                )
+        return status
 
     def report_telemetry(self, req):
         self._s.report_telemetry(req.get("snapshot") or {})
@@ -149,6 +194,20 @@ class MasterRpcService:
             )
         }
 
+    def _stamp_epoch(self, fn):
+        """Every reply carries the serving incarnation's boot id so a
+        worker detects a master relaunch from whatever call it makes
+        next (docs/master_recovery.md)."""
+        epoch = self._master_epoch
+
+        def handler(req):
+            reply = fn(req)
+            if isinstance(reply, dict) and "master_epoch" not in reply:
+                reply["master_epoch"] = epoch
+            return reply
+
+        return handler
+
     def rpc_methods(self):
         from elasticdl_tpu.utils.profiling import (
             instrument_service_methods,
@@ -159,18 +218,22 @@ class MasterRpcService:
         # histograms under edl_rpc_server_latency_seconds{role="master"}
         return instrument_service_methods(
             {
-                "get_task": self.get_task,
-                "get_comm_world": self.get_comm_world,
-                "leave_comm_world": self.leave_comm_world,
-                "standby_poll": self.standby_poll,
-                "get_model": self.get_model,
-                "report_variable": self.report_variable,
-                "report_gradient": self.report_gradient,
-                "report_task_result": self.report_task_result,
-                "report_telemetry": self.report_telemetry,
-                "report_evaluation_metrics": self.report_evaluation_metrics,
-                "push_embedding_info": self.push_embedding_info,
-                "pull_embedding_vectors": self.pull_embedding_vectors,
+                name: self._stamp_epoch(fn)
+                for name, fn in {
+                    "get_task": self.get_task,
+                    "get_comm_world": self.get_comm_world,
+                    "leave_comm_world": self.leave_comm_world,
+                    "standby_poll": self.standby_poll,
+                    "get_model": self.get_model,
+                    "master_status": self.master_status,
+                    "report_variable": self.report_variable,
+                    "report_gradient": self.report_gradient,
+                    "report_task_result": self.report_task_result,
+                    "report_telemetry": self.report_telemetry,
+                    "report_evaluation_metrics": self.report_evaluation_metrics,
+                    "push_embedding_info": self.push_embedding_info,
+                    "pull_embedding_vectors": self.pull_embedding_vectors,
+                }.items()
             },
             role="master",
         )
@@ -189,13 +252,32 @@ class MasterClient:
     those retentions would corrupt them — the PS servicer was audited
     for exactly this, the master's write path deliberately was not.
     Cross-host (or any attach failure) silently keeps the bytes path.
+
+    ``failover_s`` (docs/master_recovery.md): with a positive budget
+    the channel survives a master restart — UNAVAILABLE calls retry
+    with capped backoff through the outage (idempotent by
+    classification; ``report_task_result`` is journal-deduped by
+    (trace_id, attempt) on the new incarnation), every reply's
+    ``master_epoch`` is watched, and an epoch change fires the
+    ``set_on_master_epoch_change`` hook so the owner re-registers/
+    re-pushes instead of dying. 0 keeps the historical single-attempt
+    behavior (the epoch watch stays on).
     """
 
     def __init__(self, addr, wire_dtype="", shm="off", shm_slots=4,
-                 shm_slot_mb=8):
-        from elasticdl_tpu.rpc.core import Client
+                 shm_slot_mb=8, failover_s=0.0):
+        from elasticdl_tpu.rpc.failover import MasterFailoverChannel
 
-        self._client = Client(addr)
+        # ALL master traffic routes through the failover wrapper — the
+        # one audited place the control-plane channel may carry retry
+        # behavior (edlint R9); with failover_s=0 it is a pure
+        # pass-through that still watches the epoch
+        self._client = MasterFailoverChannel(
+            addr,
+            outage_budget_s=failover_s,
+            on_epoch_change=self._on_epoch_change,
+        )
+        self._epoch_change_cb = None
         self._wire_dtype = wire_dtype
         self._shm = None
         if shm in ("auto", "on"):
@@ -206,6 +288,23 @@ class MasterClient:
             )
         elif shm not in ("off", "", None, False):
             raise ValueError("shm must be 'auto', 'on' or 'off'")
+
+    @property
+    def master_epoch(self):
+        """The serving master's boot id, as last observed (None before
+        the first reply)."""
+        return self._client.master_epoch
+
+    def set_on_master_epoch_change(self, callback):
+        """``callback(old_epoch, new_epoch)`` fires once per observed
+        master restart — the worker-side reconnect hook (re-register
+        membership, re-push a first-write-wins model to a master-KV
+        incarnation that lost it)."""
+        self._epoch_change_cb = callback
+
+    def _on_epoch_change(self, old, new):
+        if self._epoch_change_cb is not None:
+            self._epoch_change_cb(old, new)
 
     def get_task(self, worker_id, task_type=None):
         resp = self._client.call(
@@ -232,6 +331,11 @@ class MasterClient:
         resp = channel.call(
             "get_model", version=int(version), method=int(method)
         )
+        if channel is not self._client:
+            # shm-slot replies decode outside the failover channel (its
+            # control reply only carries the slot spec) — feed the
+            # epoch watch by hand so a relaunch is still detected
+            self._client.note_reply(resp)
         params = decompress_tensors(
             resp.get("params", []), resp.get("compressed_f32")
         )
@@ -275,7 +379,20 @@ class MasterClient:
         )
 
     def report_telemetry(self, snapshot):
-        self._client.call("report_telemetry", snapshot=snapshot)
+        # telemetry is lossy-tolerant (failed snapshots requeue their
+        # events), so its outage budget is capped: a worker's final
+        # forced ship at job end must not park behind a master that
+        # already exited cleanly
+        self._client.call(
+            "report_telemetry",
+            snapshot=snapshot,
+            _budget_s=min(self._client.outage_budget_s, 10.0),
+        )
+
+    def master_status(self):
+        """The recovery-plane probe (single attempt: pollers own their
+        retry cadence)."""
+        return self._client.call("master_status", _budget_s=0.0)
 
     def report_evaluation_metrics(
         self, model_version, model_outputs, labels, scored_version=None

@@ -81,6 +81,7 @@ class MasterServicer:
         embedding_gradient_applier=None,
         coordinates_only=False,
         telemetry=None,
+        journal=None,
     ):
         """``optimizer`` is an optax GradientTransformation (or None for
         pure task-dispatch mode, e.g. ALLREDUCE jobs where the master only
@@ -91,6 +92,11 @@ class MasterServicer:
         # master-side fleet aggregator (master/telemetry.JobTelemetry);
         # None keeps report_telemetry a no-op for bare test fixtures
         self.telemetry = telemetry
+        # master recovery plane (docs/master_recovery.md): the version
+        # clock is journaled so a relaunched master resumes it instead
+        # of resetting the SSP/eval triggers to 0; appends are enqueue
+        # only (the journal's writer thread owns all IO)
+        self._journal = journal
         self._lock = threading.Lock()
         self._gradient_sum = {}
         self._gradient_sum_indexed = {}
@@ -310,6 +316,24 @@ class MasterServicer:
                     self._embedding_store.check_grad(tensor)
                     edl_embedding_gradients[name] = tensor
                     continue
+                if not self._model:
+                    # a dense gradient against an UNINITIALIZED model:
+                    # the shape a replayed push takes against a
+                    # relaunched master-KV incarnation whose store the
+                    # journal deliberately does not carry
+                    # (docs/master_recovery.md). Reject-not-raise: the
+                    # worker's minibatch retry re-pulls, the reply's
+                    # master_epoch fires its re-push hook
+                    # (first-write-wins re-init), and the next push
+                    # lands. Raising here instead surfaces as an
+                    # opaque transport-level application error that
+                    # kills the worker.
+                    logger.warning(
+                        "rejecting gradient for %s: model not "
+                        "initialized (worker re-push expected)",
+                        name,
+                    )
+                    return False, self._version
                 raise ValueError(
                     "Gradient key: %s is not part of model" % name
                 )
@@ -383,6 +407,8 @@ class MasterServicer:
             with self._lock:
                 advanced = reported > self._version
                 self._version = max(self._version, reported)
+            if advanced and self._journal is not None:
+                self._journal.append("version", version=reported)
             if advanced and self._evaluation_service:
                 # a coordinating master never applies gradients, so task
                 # reports are its only version heartbeat — drive the
@@ -529,6 +555,8 @@ class MasterServicer:
                 }
 
             self._version += 1
+            if self._journal is not None:
+                self._journal.append("version", version=self._version)
             self._update_evaluation()
             self._update_checkpoint()
         finally:
@@ -551,6 +579,16 @@ class MasterServicer:
 
     def get_model_version(self):
         return self._version
+
+    def restore_version(self, version):
+        """Boot-time recovery (docs/master_recovery.md): resume the
+        journaled version clock so SSP/eval triggers continue instead
+        of restarting at 0. The model PARAMETERS ride the existing
+        checkpoint plane (``--checkpoint_filename_for_init`` /
+        ``--checkpoint_dir``) — or the PS fleet, which a master crash
+        never touches; the journal only carries the clock."""
+        with self._lock:
+            self._version = max(self._version, int(version))
 
     def _get_model_no_lock(self):
         return self._version, {k: v.copy() for k, v in self._model.items()}

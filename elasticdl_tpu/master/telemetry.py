@@ -180,10 +180,13 @@ class JobTelemetry:
 
 
 class _TelemetryHandler(http.server.BaseHTTPRequestHandler):
-    # the server instance injects .telemetry on the handler class
+    # the server instance injects .telemetry (and optionally
+    # .health_fn) on the handler class
     telemetry = None
+    health_fn = None
 
     def do_GET(self):
+        code = 200
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
             body = self.telemetry.prometheus_text().encode("utf-8")
@@ -198,11 +201,23 @@ class _TelemetryHandler(http.server.BaseHTTPRequestHandler):
             ).encode("utf-8")
             ctype = "application/x-ndjson"
         elif path == "/healthz":
-            body, ctype = b"ok\n", "text/plain"
+            # recovery-plane readiness (docs/master_recovery.md): a
+            # relaunched master serves "restoring" (503) while its
+            # journal replays, so probes don't route traffic — or
+            # declare the pod dead — against a half-restored ledger;
+            # "serving" (200) only once the RPC plane is up
+            state = "serving"
+            if self.health_fn is not None:
+                try:
+                    state = str(self.health_fn())
+                except Exception:  # noqa: BLE001 — a probe must answer
+                    state = "unknown"
+            code = 200 if state in ("serving", "ok") else 503
+            body, ctype = (state + "\n").encode("utf-8"), "text/plain"
         else:
             self.send_error(404)
             return
-        self.send_response(200)
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -219,15 +234,23 @@ class TelemetryHTTPServer:
     serving thread is a daemon AND joined in :meth:`close` (edlint R4
     thread-ownership discipline)."""
 
-    def __init__(self, telemetry, port=0, host=""):
+    def __init__(self, telemetry, port=0, host="", health_fn=None):
         handler = type(
             "_BoundTelemetryHandler",
             (_TelemetryHandler,),
-            {"telemetry": telemetry},
+            {
+                "telemetry": telemetry,
+                # staticmethod: a bare function stored as a class attr
+                # would bind as a method and receive the handler as a
+                # spurious first argument
+                "health_fn": (
+                    staticmethod(health_fn)
+                    if health_fn is not None
+                    else None
+                ),
+            },
         )
-        self._server = http.server.ThreadingHTTPServer(
-            (host, port), handler
-        )
+        self._server = self._bind(host, port, handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -237,6 +260,28 @@ class TelemetryHTTPServer:
         )
         self._thread.start()
         logger.info("telemetry /metrics endpoint on port %d", self.port)
+
+    @staticmethod
+    def _bind(host, port, handler, retries=20, backoff_s=0.25):
+        """Bind, riding out a predecessor's lingering socket.
+
+        A RELAUNCHED master re-binds the same fixed telemetry port its
+        killed predecessor held; allow_reuse_address clears TIME_WAIT,
+        but the old process (or its half-dead kernel socket) can hold
+        the port for a beat longer — retry briefly instead of failing
+        the whole boot over a probe endpoint."""
+        last_err = None
+        for _ in range(max(1, retries)):
+            try:
+                return http.server.ThreadingHTTPServer(
+                    (host, port), handler
+                )
+            except OSError as err:
+                last_err = err
+                if port == 0:
+                    raise  # ephemeral bind failing is not a relaunch race
+                time.sleep(backoff_s)
+        raise last_err
 
     def close(self):
         self._server.shutdown()

@@ -1,0 +1,217 @@
+"""Master-channel failover: survive a master restart, not just a busy one.
+
+The control-plane invariant since PR 2 was "a Master* class never passes
+``deadline_s``/``retries`` — the channel blocks by design" (edlint R9):
+a worker parked on ``get_task`` against a busy master must wait, not
+error. That invariant said nothing about a DEAD master, and before the
+master recovery plane a dead master was unsurvivable anyway — every
+blocking call surfaced UNAVAILABLE and the worker died with it.
+
+:class:`MasterFailoverChannel` is the ONE audited place the master
+channel carries retry behavior (the R9 invariant is now "only through
+the failover-mode wrapper"). Semantics:
+
+- **Busy-master blocking preserved.** Attempts carry no deadline by
+  default (``attempt_deadline_s=0``): a slow reply still blocks, so the
+  historical contract holds. A finite attempt deadline is opt-in for
+  deployments where a vanished pod black-holes SYNs instead of
+  refusing them; DEADLINE_EXCEEDED is NEVER retried (a timed-out
+  ``get_task`` whose dispatch the live master processed would leak that
+  task in the doing-set — PR-2's reasoning, unchanged).
+- **UNAVAILABLE rides out the outage.** Connection refused / reset is
+  the shape a SIGKILLed-and-relaunching master presents; the wrapper
+  retries with doubling, capped backoff until ``outage_budget_s`` is
+  spent, then raises. The control-plane reads are pure, and the write
+  whose exactly-once-ness the job's ACCOUNTING depends on —
+  ``report_task_result`` — is deduplicated by the new master against
+  its journal by the ack's (trace_id, attempt). The one resend that
+  is NOT deduped: ``report_gradient`` against a master-KV master,
+  where a connection reset between the apply and the reply can land
+  one gradient twice — the same bounded-SSP-noise class as the PS
+  plane's drain-time drops (async mode already tolerates stale and
+  lost updates inside the window; docs/master_recovery.md). PS-mode
+  fleets never route gradients through this channel.
+- **Epoch detection.** Every master reply carries the serving
+  incarnation's ``master_epoch`` boot id (the ``shard_epoch`` pattern);
+  the wrapper watches it and fires ``on_epoch_change(old, new)`` once
+  per transition so the owner (MasterClient) can run its reconnect
+  protocol — re-register membership, re-push a first-write-wins model
+  to a master-KV incarnation that lost it.
+
+``outage_budget_s=0`` disables the retry loop entirely (single attempt,
+raise as before) while keeping the epoch watch — the wrapper is then a
+pure pass-through, which is why MasterClient always routes through it.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.utils import profiling
+
+# backoff shape for the outage retry loop: doubling from _BACKOFF_S,
+# capped — a fleet of workers hammering a booting master helps nobody,
+# and the master's journal replay is itself part of the outage window
+_BACKOFF_S = 0.2
+_BACKOFF_CAP_S = 2.0
+
+
+class MasterFailoverChannel:
+    """``call``-compatible wrapper around one ``rpc.core.Client``.
+
+    The audited R9 exemption: this class alone may hand the master
+    channel's Client a deadline, and its retry loop alone may resend a
+    master RPC — see the module docstring for why each is safe.
+    """
+
+    def __init__(
+        self,
+        addr,
+        outage_budget_s=0.0,
+        attempt_deadline_s=0.0,
+        on_epoch_change=None,
+    ):
+        from elasticdl_tpu.rpc.core import Client
+
+        self._addr = addr
+        self._attempt_deadline = (
+            attempt_deadline_s if attempt_deadline_s > 0 else None
+        )
+        self._client = Client(addr, deadline_s=self._attempt_deadline)
+        self._budget_s = max(0.0, float(outage_budget_s))
+        self._on_epoch_change = on_epoch_change
+        self._mu = threading.Lock()
+        self._epoch = None  # last master_epoch observed in any reply
+        self._outage_logged = False
+        self._c_retries = profiling.metrics.counter(
+            "edl_master_failover_retries_total",
+            "Master-channel calls resent through an outage window",
+            labels=("method",),
+        )
+
+    @property
+    def master_epoch(self):
+        with self._mu:
+            return self._epoch
+
+    @property
+    def outage_budget_s(self):
+        return self._budget_s
+
+    def call(self, rpc_name, _retriable=True, _budget_s=None, **fields):
+        """One logical master RPC, resent through an UNAVAILABLE window.
+
+        ``_budget_s`` overrides the channel's outage budget for this
+        call (telemetry shipping caps its own so a worker draining at
+        job end never parks behind a master that already exited).
+        """
+        import grpc
+
+        budget = self._budget_s if _budget_s is None else _budget_s
+        deadline = (
+            time.monotonic() + budget if budget > 0 else None
+        )
+        backoff = _BACKOFF_S
+        failures = 0
+        while True:
+            try:
+                # the inner client never retries itself (retries=0) —
+                # THIS loop owns resend policy; the guard keeps the R9
+                # dynamic-dispatch invariant visible at the call site
+                resp = self._client.call(
+                    rpc_name,
+                    _retriable=(rpc_name != "push_gradient"),
+                    **fields,
+                )
+            except grpc.RpcError as err:
+                code = (
+                    err.code()
+                    if callable(getattr(err, "code", None))
+                    else None
+                )
+                retriable = (
+                    _retriable
+                    and code == grpc.StatusCode.UNAVAILABLE
+                    and deadline is not None
+                    and time.monotonic() + backoff < deadline
+                )
+                if not retriable:
+                    raise
+                self._note_outage(rpc_name)
+                self._c_retries.inc(method=rpc_name)
+                failures += 1
+                if failures % 2 == 0:
+                    # gRPC parks a failed subchannel in
+                    # TRANSIENT_FAILURE under its OWN exponential
+                    # reconnect backoff (up to ~2 min) — longer than
+                    # the whole relaunch window, so retrying on the
+                    # same channel can spin against a cached failure
+                    # while the new master is already serving. A fresh
+                    # channel dials immediately.
+                    self._reconnect()
+                time.sleep(backoff)
+                backoff = min(backoff * 2, _BACKOFF_CAP_S)
+                continue
+            self.note_reply(resp)
+            return resp
+
+    def _reconnect(self):
+        """Swap in a fresh channel; the old one is DROPPED, not closed:
+        a concurrent call on another thread may still be blocked on it,
+        and grpc raises a non-RpcError ValueError on a closed channel —
+        which would escape every caller's retry machinery. The retired
+        channel's resources free when its last in-flight call drops the
+        reference (GC-closed; outage-bounded churn)."""
+        from elasticdl_tpu.rpc.core import Client
+
+        self._client = Client(
+            self._addr, deadline_s=self._attempt_deadline
+        )
+
+    def _note_outage(self, rpc_name):
+        with self._mu:
+            first = not self._outage_logged
+            self._outage_logged = True
+        if first:
+            logger.warning(
+                "master unreachable (%s); retrying through the outage "
+                "window with capped backoff",
+                rpc_name,
+            )
+            profiling.events.emit("master_unavailable", method=rpc_name)
+
+    def note_reply(self, resp):
+        """Watch ``master_epoch`` in a decoded reply. Public because
+        shm-slot replies decode OUTSIDE this channel (the control reply
+        only carries the slot spec) and the owner hands them back in."""
+        epoch = None
+        if isinstance(resp, dict):
+            epoch = resp.get("master_epoch")
+        changed = None
+        with self._mu:
+            self._outage_logged = False
+            if epoch is not None and epoch != self._epoch:
+                changed = (self._epoch, epoch)
+                self._epoch = epoch
+        if changed is not None and changed[0] is not None:
+            logger.warning(
+                "master epoch changed %s -> %s: a relaunched master is "
+                "serving; running the reconnect protocol",
+                changed[0],
+                changed[1],
+            )
+            profiling.events.emit(
+                "master_epoch_change",
+                old=changed[0],
+                new=changed[1],
+            )
+            if self._on_epoch_change is not None:
+                try:
+                    self._on_epoch_change(changed[0], changed[1])
+                except Exception:
+                    logger.warning(
+                        "master epoch-change hook failed", exc_info=True
+                    )
+
+    def close(self):
+        self._client.close()

@@ -42,34 +42,47 @@ class ChaosOp:
     """One scripted fault.
 
     ``kind``: ``"kill"`` (SIGKILL, no drain) / ``"term"`` (SIGTERM,
-    drain snapshot + exit 75) for the fleet level; ``"delay"`` /
+    drain snapshot + exit 75) for the PS fleet level;
+    ``"kill_master"`` / ``"term_master"`` for scripted MASTER outages
+    (docs/master_recovery.md — SIGKILL loses the un-fsynced journal
+    tail, SIGTERM drains it and exits 75); ``"delay"`` /
     ``"partition"`` / ``"reject"`` for the in-process call level.
-    ``shard``: target PS id. ``at_version``: fleet ops fire when the
-    shard's reported version reaches this. ``at_call``/``n_calls``:
-    call-level ops apply to calls ``[at_call, at_call + n_calls)`` of
-    the wrapped shard. ``delay_s``: sleep for ``delay`` ops.
+    ``shard``: target PS id (ignored by master ops — pass -1).
+    ``at_version``: fleet ops fire when the target's reported version
+    reaches this. ``at_done``: master ops may instead fire when the
+    master's journal counts this many DONE tasks — the natural
+    mid-job trigger for a control plane whose version clock idles in
+    PS-pod mode. ``at_call``/``n_calls``: call-level ops apply to
+    calls ``[at_call, at_call + n_calls)`` of the wrapped shard.
+    ``delay_s``: sleep for ``delay`` ops.
     """
 
-    __slots__ = ("kind", "shard", "at_version", "at_call", "n_calls",
-                 "delay_s")
+    __slots__ = ("kind", "shard", "at_version", "at_done", "at_call",
+                 "n_calls", "delay_s")
+
+    MASTER_KINDS = ("kill_master", "term_master")
 
     def __init__(self, kind, shard, at_version=None, at_call=None,
-                 n_calls=1, delay_s=0.0):
-        if kind not in ("kill", "term", "delay", "partition", "reject"):
+                 n_calls=1, delay_s=0.0, at_done=None):
+        if kind not in (
+            "kill", "term", "delay", "partition", "reject",
+            "kill_master", "term_master",
+        ):
             raise ValueError("unknown chaos op kind %r" % kind)
         self.kind = kind
         self.shard = int(shard)
         self.at_version = at_version
+        self.at_done = at_done
         self.at_call = at_call
         self.n_calls = int(n_calls)
         self.delay_s = float(delay_s)
 
     def __repr__(self):
         return (
-            "ChaosOp(%r, shard=%d, at_version=%r, at_call=%r, "
-            "n_calls=%d, delay_s=%g)"
-            % (self.kind, self.shard, self.at_version, self.at_call,
-               self.n_calls, self.delay_s)
+            "ChaosOp(%r, shard=%d, at_version=%r, at_done=%r, "
+            "at_call=%r, n_calls=%d, delay_s=%g)"
+            % (self.kind, self.shard, self.at_version, self.at_done,
+               self.at_call, self.n_calls, self.delay_s)
         )
 
 
@@ -208,26 +221,46 @@ class ScriptedFaultPS:
 
 
 class FleetChaos:
-    """Executes a fleet-level schedule against live PS processes.
+    """Executes a fleet-level schedule against live processes.
 
     ``manager``: anything with ``kill_ps(id)`` / ``terminate_ps(id)``
     (the LocalInstanceManager, or bench.py's own process table via a
-    small adapter). ``status_fn(shard) -> dict`` reads the shard's
-    ``ps_status`` (version + epoch); the poller fires each op ONCE when
-    its shard's version first reaches ``at_version``, then logs it in
-    :attr:`executed`. Deterministic given a deterministic version
-    stream: the op fires at the first poll observing the crossing, and
-    the at-version trigger itself does not depend on wall clock.
+    small adapter) — plus ``kill_master()`` / ``terminate_master()``
+    when the schedule carries master ops. ``status_fn(shard) -> dict``
+    reads a shard's ``ps_status`` (version + epoch);
+    ``master_status_fn() -> dict`` reads the master's ``master_status``
+    probe (version + journal counters) and is required only for master
+    ops. The poller fires each op ONCE when its trigger first crosses —
+    ``at_version`` against the target's reported version, ``at_done``
+    (master ops) against the journal's cumulative done-task count —
+    then logs it in :attr:`executed`. Deterministic given a
+    deterministic trigger stream: the op fires at the first poll
+    observing the crossing, and the trigger itself does not depend on
+    wall clock.
     """
 
-    def __init__(self, manager, status_fn, schedule, poll_s=0.1):
+    _FLEET_KINDS = ("kill", "term", "kill_master", "term_master")
+
+    def __init__(self, manager, status_fn, schedule, poll_s=0.1,
+                 master_status_fn=None):
         self._manager = manager
         self._status_fn = status_fn
+        self._master_status_fn = master_status_fn
         self._schedule = list(schedule)
+        if master_status_fn is None and any(
+            op.kind in ChaosOp.MASTER_KINDS for op in self._schedule
+        ):
+            # without the probe the trigger can never cross and the
+            # poller would spin silently until the harness times out
+            raise ValueError(
+                "schedule contains master ops but no master_status_fn "
+                "was provided (the at_done/at_version trigger polls "
+                "the master_status probe)"
+            )
         self._poll_s = poll_s
         self._stop = threading.Event()
         self._thread = None
-        self.executed = []  # (op, observed_version, unix_time)
+        self.executed = []  # (op, observed_trigger, unix_time)
 
     def start(self):
         self._thread = threading.Thread(
@@ -236,41 +269,73 @@ class FleetChaos:
         self._thread.start()
         return self
 
+    def _probe(self, op):
+        """(trigger_value, crossed) for ``op``, or None when the
+        target's probe failed (poll again)."""
+        if op.kind in ChaosOp.MASTER_KINDS:
+            status = self._master_status_fn() or {}
+            if op.at_done is not None:
+                done = int(
+                    (status.get("journal") or {}).get("done", -1)
+                )
+                return done, done >= op.at_done
+            version = int(status.get("version", -1))
+            return version, (
+                op.at_version is not None and version >= op.at_version
+            )
+        status = self._status_fn(op.shard) or {}
+        version = int(status.get("version", -1))
+        return version, (
+            op.at_version is not None and version >= op.at_version
+        )
+
+    def _execute(self, op):
+        if op.kind == "kill":
+            self._manager.kill_ps(op.shard)
+        elif op.kind == "term":
+            self._manager.terminate_ps(op.shard)
+        elif op.kind == "kill_master":
+            self._manager.kill_master()
+        else:
+            self._manager.terminate_master()
+
     def _run(self):
         pending = [
-            op for op in self._schedule if op.kind in ("kill", "term")
+            op
+            for op in self._schedule
+            if op.kind in self._FLEET_KINDS
         ]
         while pending and not self._stop.is_set():
             for op in list(pending):
                 try:
-                    status = self._status_fn(op.shard) or {}
-                except Exception:  # noqa: BLE001 — shard busy/down
+                    trigger, crossed = self._probe(op)
+                except Exception:  # noqa: BLE001 — target busy/down
                     logger.debug(
-                        "chaos: status probe of shard %d failed; "
-                        "polling again",
-                        op.shard,
+                        "chaos: status probe for %r failed; polling "
+                        "again",
+                        op,
                         exc_info=True,
                     )
                     continue
-                version = int(status.get("version", -1))
-                if op.at_version is not None and version >= op.at_version:
+                if crossed:
                     logger.warning(
-                        "chaos: executing %r (observed version %d)",
+                        "chaos: executing %r (observed trigger %d)",
                         op,
-                        version,
+                        trigger,
                     )
-                    if op.kind == "kill":
-                        self._manager.kill_ps(op.shard)
-                    else:
-                        self._manager.terminate_ps(op.shard)
-                    self.executed.append((op, version, time.time()))
+                    self._execute(op)
+                    self.executed.append((op, trigger, time.time()))
                     pending.remove(op)
             self._stop.wait(self._poll_s)
 
     def done(self):
         """True once every scheduled fleet op has executed."""
         return len(self.executed) == len(
-            [op for op in self._schedule if op.kind in ("kill", "term")]
+            [
+                op
+                for op in self._schedule
+                if op.kind in self._FLEET_KINDS
+            ]
         )
 
     def stop(self):

@@ -43,6 +43,11 @@ def _run(args):
             # negotiated shm ring; cross-host (or any attach failure)
             # silently keeps the bytes path (docs/wire.md)
             shm=getattr(args, "master_shm", "auto"),
+            # ride out a master SIGKILL/relaunch instead of dying with
+            # it: UNAVAILABLE retries through the outage window and
+            # acks dedup on the new incarnation's journal
+            # (docs/master_recovery.md)
+            failover_s=getattr(args, "master_failover_s", 120.0),
         )
         if args.master_addr
         else None

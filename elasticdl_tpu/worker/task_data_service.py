@@ -325,6 +325,20 @@ class TaskDataService:
             # task_done event carries both clocks
             counters = dict(counters or {})
             counters["consume_s"] = round(time.perf_counter() - t0, 6)
+        trace = (getattr(task, "extended_config", None) or {}).get(
+            "trace_id"
+        )
+        if trace is not None:
+            # master recovery plane (docs/master_recovery.md): the ack
+            # names the dispatcher's trace so a RELAUNCHED master (task
+            # ids re-minted, this ack replayed through the failover
+            # channel) can resolve it to the journaled task and dedup a
+            # completion the dead incarnation already counted
+            counters = dict(counters or {})
+            counters[TaskExecCounterKey.TRACE_ID] = trace
+            counters[TaskExecCounterKey.ATTEMPT] = task.extended_config.get(
+                "_attempt", 0
+            )
         if err_msg:
             logger.warning(
                 "task %d finished with %d/%d bad records; last error: %s",

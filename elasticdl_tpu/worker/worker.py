@@ -154,6 +154,17 @@ class Worker:
             if ps_client is not None
             else MasterStorePlane(lambda: self._stub)
         )
+        if stub is not None and hasattr(
+            stub, "set_on_master_epoch_change"
+        ):
+            # master reconnect protocol (docs/master_recovery.md): a
+            # relaunched master's journal restores the LEDGER, not the
+            # master-KV model store — in stub-held-model mode the
+            # worker re-pushes its replica (first-write-wins, so a
+            # master that kept its model ignores it). PS-mode dense
+            # state lives on the PS fleet, which a master crash never
+            # touches.
+            stub.set_on_master_epoch_change(self._on_master_epoch_change)
         if ps_client is not None and hasattr(
             ps_client, "set_on_shard_reset"
         ):
@@ -322,6 +333,52 @@ class Worker:
             )
             self.report_variable()
 
+    def _on_master_epoch_change(self, old_epoch, new_epoch):
+        """MasterClient reconnect hook: a relaunched master is serving.
+
+        Only the master-KV mode holds model state in the master; its
+        store is first-write-wins, so re-pushing is exactly right for
+        an incarnation that lost it and a no-op for one that did not
+        (docs/master_recovery.md). PS-mode state is on the PS fleet —
+        nothing to do beyond the ack dedup the channel already gets.
+        """
+        if (
+            self._ps_client is None
+            and self._var_created
+            and self._params is not None
+        ):
+            logger.warning(
+                "re-pushing model after master relaunch (epoch %s -> %s)",
+                old_epoch,
+                new_epoch,
+            )
+            try:
+                if self._embedding_dims:
+                    self._stub.push_embedding_info(
+                        self._embedding_table_infos()
+                    )
+                self.report_variable()
+            except Exception:
+                # the next get_model/report_gradient surfaces the real
+                # failure through the ordinary retry machinery
+                logger.warning(
+                    "model re-push after master relaunch failed",
+                    exc_info=True,
+                )
+
+    def _embedding_table_infos(self):
+        """The declared elastic-embedding tables, in wire form — ONE
+        builder for every push site (initial handshake, PS push_model,
+        the master-relaunch re-push)."""
+        return [
+            EmbeddingTableInfo(
+                path_name(path),
+                dim,
+                self._embedding_initializers.get(path, "uniform"),
+            )
+            for path, dim in self._embedding_dims.items()
+        ]
+
     def report_variable(self):
         # PS pushes ride the dlpack wire bridge: device leaves stay on
         # device and the frame write is their single host copy
@@ -331,15 +388,9 @@ class Worker:
             self._params, keep_device=self._ps_client is not None
         )
         if self._ps_client is not None:
-            infos = [
-                EmbeddingTableInfo(
-                    path_name(path),
-                    dim,
-                    self._embedding_initializers.get(path, "uniform"),
-                )
-                for path, dim in self._embedding_dims.items()
-            ]
-            self._ps_client.push_model(named, infos)
+            self._ps_client.push_model(
+                named, self._embedding_table_infos()
+            )
         else:
             self._stub.report_variable(named)
 
@@ -476,16 +527,7 @@ class Worker:
         if not self._var_created:
             if self._embedding_dims and self._ps_client is None:
                 self._stub.push_embedding_info(
-                    [
-                        EmbeddingTableInfo(
-                            path_name(path),
-                            dim,
-                            self._embedding_initializers.get(
-                                path, "uniform"
-                            ),
-                        )
-                        for path, dim in self._embedding_dims.items()
-                    ]
+                    self._embedding_table_infos()
                 )
             self.report_variable()
             self._var_created = True

@@ -93,6 +93,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_comm_plane.py \
     tests/test_ps_snapshot.py \
     tests/test_chaos.py \
+    tests/test_master_journal.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 echo "check.sh: all gates green"

@@ -70,6 +70,7 @@ _LOCKTRACE_SUITES = {
     "test_comm_plane",
     "test_ps_snapshot",
     "test_chaos",
+    "test_master_journal",
 }
 
 

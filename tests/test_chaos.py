@@ -480,3 +480,112 @@ def test_fleet_chaos_fires_once_at_version_crossing():
         assert killed == [("kill", 0)]
     finally:
         chaos.stop()
+
+
+def test_fleet_chaos_master_op_fires_at_done_count():
+    """Scripted master outages (docs/master_recovery.md): a
+    kill_master op triggers on the master journal's cumulative
+    done-task count, polled through master_status — and fires once."""
+    import time
+
+    executed = []
+
+    class Manager:
+        def kill_master(self):
+            executed.append("kill_master")
+
+        def terminate_master(self):
+            executed.append("term_master")
+
+    status = {"version": 0, "journal": {"done": 0}}
+    chaos = FleetChaos(
+        Manager(),
+        lambda shard: {},
+        [ChaosOp("kill_master", -1, at_done=3)],
+        poll_s=0.01,
+        master_status_fn=lambda: status,
+    ).start()
+    try:
+        time.sleep(0.05)
+        assert executed == []
+        status["journal"]["done"] = 3
+        deadline = time.monotonic() + 5
+        while not chaos.done() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert chaos.done()
+        time.sleep(0.05)
+        assert executed == ["kill_master"]
+    finally:
+        chaos.stop()
+
+
+def test_local_instance_manager_supervises_master(tmp_path):
+    """The external-supervisor form: SIGTERM's rc-75 drain relaunches
+    the master WITHOUT spending the crash budget (PS-plane parity);
+    SIGKILL relaunches on the budget."""
+    import sys
+    import time
+
+    from elasticdl_tpu.master.local_instance_manager import (
+        LocalInstanceManager,
+    )
+
+    ready = tmp_path / "master-ready"
+
+    def master_cmd():
+        return [
+            sys.executable,
+            "-c",
+            "import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))\n"
+            # readiness marker AFTER the handler is installed: the
+            # drain test must not SIGTERM a still-booting interpreter
+            "open(%r, 'w').close()\n"
+            "while True:\n"
+            "    time.sleep(0.1)\n" % str(ready),
+        ]
+
+    def wait_ready(deadline_s=15):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if ready.exists():
+                return True
+            time.sleep(0.05)
+        return False
+
+    class _NoTasks:
+        def recover_tasks(self, worker_id):
+            pass
+
+    lim = LocalInstanceManager(
+        _NoTasks(),
+        num_workers=0,
+        worker_command=lambda wid: [],
+        master_command=master_cmd,
+        max_relaunches=2,
+        log_dir=str(tmp_path),
+    )
+    try:
+        lim.start_master()
+        assert wait_ready(), "supervised master never came up"
+        assert lim.live_master()
+
+        # graceful drain: exit 75, relaunched, budget untouched
+        ready.unlink()
+        lim.terminate_master()
+        assert wait_ready(), "rc-75 drain must relaunch the master"
+        assert lim.live_master()
+        assert lim.exit_codes[("master", 0)] == 75
+        assert lim._relaunches == 0, "rc-75 must not spend the budget"
+
+        # hard kill: relaunched on the crash budget
+        ready.unlink()
+        lim.kill_master()
+        assert wait_ready(), "SIGKILL must relaunch the master"
+        assert lim.live_master()
+        deadline = time.monotonic() + 5
+        while lim._relaunches == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert lim._relaunches == 1
+    finally:
+        lim.stop_relaunch_and_remove_all_pods()

@@ -1179,6 +1179,79 @@ def test_r9_master_channel_stays_blocking(tmp_path):
     assert not good
 
 
+def test_r9_failover_wrapper_is_the_one_master_exemption(tmp_path):
+    """Master recovery plane invariant update (docs/master_recovery.md):
+    deadline/retries on the master channel are allowed ONLY inside the
+    audited failover-mode wrapper (rpc/failover.MasterFailoverChannel);
+    any OTHER Master* class carrying them still regresses the blocking
+    control-plane contract."""
+    wrapper_src = (
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class MasterFailoverChannel:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=30.0)\n"
+        "    def call(self, rpc_name, **fields):\n"
+        "        return self._client.call(\n"
+        "            rpc_name,\n"
+        "            _retriable=(rpc_name != 'push_gradient'),\n"
+        "            **fields,\n"
+        "        )\n"
+    )
+    good = _lint(
+        tmp_path, wrapper_src, relpath="elasticdl_tpu/rpc/failover.py"
+    )
+    assert not good
+    # the exemption is pinned to the wrapper's HOME MODULE: a
+    # same-named clone anywhere else must not inherit the audit
+    clone = _lint(
+        tmp_path,
+        wrapper_src,
+        relpath="elasticdl_tpu/worker/failover_clone.py",
+    )
+    assert _rules_of(clone) == ["R9"], clone
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class MasterRetryingClient:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=30.0, retries=4)\n"
+        "    def get_task(self, worker_id):\n"
+        "        return self._client.call('get_task', worker_id=worker_id)\n",
+        relpath="elasticdl_tpu/master/fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "failover" in bad[0].message
+
+
+def test_r9_master_status_probe_classified(tmp_path):
+    """The recovery-plane probe is idempotent by classification —
+    relaunch probes and the chaos harness poll it freely; an
+    UNclassified new probe name stays a finding."""
+    good = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class ChaosPoller:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=2.0, retries=2)\n"
+        "    def probe(self):\n"
+        "        return self._client.call('master_status')\n",
+        relpath="elasticdl_tpu/tools/poller_fixture.py",
+    )
+    assert not good
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class ChaosPoller:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=2.0)\n"
+        "    def probe(self):\n"
+        "        return self._client.call('master_relaunch_probe')\n",
+        relpath="elasticdl_tpu/tools/poller_fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "unclassified" in bad[0].message
+
+
 def test_r9_comm_plane_call_sites(tmp_path):
     """The embedding-plane invariants (docs/embedding_planes.md),
     statically enforced at Client call sites: a plane's PULL is

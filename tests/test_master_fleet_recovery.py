@@ -1,0 +1,308 @@
+"""Live-fleet master crash recovery: SIGKILL the REAL master process
+mid-job, relaunch it on the same port + journal dir, and assert the
+job completes with exactly-once task accounting while the worker and
+both PS shards ride the outage out (docs/master_recovery.md — the
+``test_ps_fleet_recovery.py`` shape, pointed at the control plane).
+
+The fleet is 4 OS processes over real loopback gRPC: master
+(``master.main`` with ``--master_journal_dir``), 2 PS shards
+(``ps.main``), and one worker (``worker.main`` with the default
+failover budget). Observables asserted:
+
+- the worker process NEVER exits during the outage (its master channel
+  retries UNAVAILABLE through the window; its held acks replay against
+  the new incarnation and dedup by trace),
+- ``master_epoch`` advances across the relaunch (probed via
+  ``master_status`` before and after),
+- /healthz answers "restoring" (503) or "serving" (200) around the
+  replay window, never routes-traffic-ok while half-restored,
+- the final journal counts every task done EXACTLY once: done ==
+  tasks-per-epoch x epochs, pending == 0 (requeue-exactly-once +
+  ack dedup, journal-counted),
+- both jobs exit 0: the relaunched master observes completion and the
+  worker drains cleanly.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+from elasticdl_tpu.master.journal import MasterJournal
+from tests.fake_ps import free_port
+from tests.test_utils import (
+    MODEL_ZOO_PATH,
+    DatasetName,
+    create_recordio_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL_DEF = "mnist_subclass.mnist_subclass.CustomModel"
+
+RECORDS = 256
+BATCH = 16
+MINIBATCHES_PER_TASK = 2  # records_per_task = 32 -> 8 tasks/epoch
+EPOCHS = 2
+EXPECTED_TASKS = (RECORDS // (BATCH * MINIBATCHES_PER_TASK)) * EPOCHS
+
+
+def _env():
+    env = dict(os.environ)
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            # fast finish detection so the test doesn't wait out the
+            # 30s poll default after the last ack lands
+            "EDL_MASTER_POLL_SECS": "1",
+            "EDL_TASK_SHUFFLE_SEED": "7",
+        }
+    )
+    return env
+
+
+def _spawn(cmd, log_path):
+    out = open(log_path, "ab")
+    proc = subprocess.Popen(cmd, env=_env(), stdout=out, stderr=out)
+    out.close()
+    return proc
+
+
+def _ps_cmd(ps_id, port):
+    return [
+        sys.executable, "-m", "elasticdl_tpu.ps.main",
+        "--ps_id", str(ps_id),
+        "--port", str(port),
+        "--model_zoo", MODEL_ZOO_PATH,
+        "--model_def", MODEL_DEF,
+        "--use_async", "true",
+        "--grads_to_wait", "1",
+    ]
+
+
+def _master_cmd(port, data_dir, journal_dir, telemetry_port):
+    return [
+        sys.executable, "-m", "elasticdl_tpu.master.main",
+        "--job_name", "master-recovery-test",
+        "--port", str(port),
+        "--model_zoo", MODEL_ZOO_PATH,
+        "--model_def", MODEL_DEF,
+        "--minibatch_size", str(BATCH),
+        "--num_minibatches_per_task", str(MINIBATCHES_PER_TASK),
+        "--num_epochs", str(EPOCHS),
+        "--training_data", data_dir,
+        "--num_workers", "0",
+        "--num_ps_pods", "2",
+        "--use_async", "true",
+        "--grads_to_wait", "1",
+        "--master_journal_dir", journal_dir,
+        "--master_journal_fsync_ms", "20",
+        "--telemetry_port", str(telemetry_port),
+    ]
+
+
+def _worker_cmd(worker_id, master_port, ps_ports):
+    return [
+        sys.executable, "-m", "elasticdl_tpu.worker.main",
+        "--worker_id", str(worker_id),
+        "--job_type", "training_only",
+        "--master_addr", "localhost:%d" % master_port,
+        "--ps_addrs", ",".join(
+            "localhost:%d" % p for p in ps_ports
+        ),
+        "--model_zoo", MODEL_ZOO_PATH,
+        "--model_def", MODEL_DEF,
+        "--minibatch_size", str(BATCH),
+        # survive the master outage: generous budget vs the master's
+        # relaunch + replay time on a loaded CI host
+        "--master_failover_s", "240",
+        # keep the boundary drains frequent so acks replay mid-test
+        "--task_ack_queue", "2",
+    ]
+
+
+def _wait_port(proc, port, what, timeout=120):
+    deadline = time.time() + timeout
+    while True:
+        assert proc.poll() is None, (
+            "%s exited rc=%s at boot" % (what, proc.returncode)
+        )
+        try:
+            with socket.create_connection(("localhost", port), 1.0):
+                return
+        except OSError:
+            assert time.time() < deadline, "%s did not come up" % what
+            time.sleep(0.2)
+
+
+def _stop(procs):
+    for proc in procs:
+        if proc and proc.poll() is None:
+            proc.kill()
+    for proc in procs:
+        if proc:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+
+
+def _status(port, timeout=30):
+    """Poll master_status on a FRESH channel per attempt: a channel
+    that lived through the SIGKILL can wedge in gRPC's failure state
+    ("FD Shutdown") long after the relaunched master serves, so probe
+    channels are disposable."""
+    import grpc
+
+    from elasticdl_tpu.rpc.core import Client
+
+    deadline = time.time() + timeout
+    while True:
+        client = Client("localhost:%d" % port, deadline_s=5.0)
+        try:
+            return client.call("master_status")
+        except grpc.RpcError:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.3)
+        finally:
+            client.close()
+
+
+def test_sigkill_master_midjob_bounded_recovery(tmp_path):
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    create_recordio_file(
+        RECORDS, DatasetName.IMAGE_DEFAULT, (28, 28),
+        temp_dir=str(data_dir), seed=5,
+    )
+    journal_dir = str(tmp_path / "journal")
+    master_port = free_port()
+    telemetry_port = free_port()
+    ps_ports = [free_port(), free_port()]
+
+    ps_procs = [
+        _spawn(_ps_cmd(i, p), str(tmp_path / ("ps-%d.log" % i)))
+        for i, p in enumerate(ps_ports)
+    ]
+    master = _spawn(
+        _master_cmd(
+            master_port, str(data_dir), journal_dir, telemetry_port
+        ),
+        str(tmp_path / "master-1.log"),
+    )
+    worker = None
+    try:
+        for proc, port in zip(ps_procs, ps_ports):
+            _wait_port(proc, port, "ps")
+        _wait_port(master, master_port, "master")
+        epoch_before = _status(master_port)["master_epoch"]
+
+        worker = _spawn(
+            _worker_cmd(1, master_port, ps_ports),
+            str(tmp_path / "worker.log"),
+        )
+
+        # let the job make real progress: at least 2 tasks counted
+        # done in the journal before the kill
+        deadline = time.time() + 240
+        while True:
+            assert worker.poll() is None, (
+                "worker died before the kill (rc=%s)" % worker.returncode
+            )
+            st = _status(master_port)
+            done = (st.get("journal") or {}).get("done", 0)
+            if done >= 2:
+                break
+            assert time.time() < deadline, (
+                "job made no progress before the kill (status %r)" % st
+            )
+            time.sleep(0.3)
+        assert st["state"] == "serving"
+
+        # -- the crash: SIGKILL, no drain — the journal tail within the
+        # fsync cadence is the only permissible loss ------------------
+        master.send_signal(signal.SIGKILL)
+        master.wait(timeout=10)
+
+        # the worker rides the outage: still alive while the master is
+        # gone (its channel is retrying UNAVAILABLE)
+        time.sleep(2.0)
+        assert worker.poll() is None, (
+            "worker died during the master outage (rc=%s)"
+            % worker.returncode
+        )
+
+        master = _spawn(
+            _master_cmd(
+                master_port, str(data_dir), journal_dir, telemetry_port
+            ),
+            str(tmp_path / "master-2.log"),
+        )
+        _wait_port(master, master_port, "relaunched master")
+
+        # /healthz flips to serving (200) once replay finished; the
+        # RPC plane only binds after replay, so by now it must say
+        # serving — and must NEVER have said so while restoring
+        body = urllib.request.urlopen(
+            "http://localhost:%d/healthz" % telemetry_port, timeout=5
+        )
+        assert body.status == 200
+        assert body.read().decode().strip() == "serving"
+
+        st = _status(master_port, timeout=60)
+        assert st["master_epoch"] == epoch_before + 1, (
+            "master_epoch must advance across the relaunch: %r" % st
+        )
+        assert st["state"] == "serving"
+
+        # -- completion: worker drains, both processes exit 0 ---------
+        assert worker.wait(timeout=300) == 0, "worker failed the job"
+        assert master.wait(timeout=60) == 0, (
+            "relaunched master did not observe completion"
+        )
+    finally:
+        _stop([worker, master] + ps_procs)
+
+    # -- exactly-once accounting, journal-counted ---------------------
+    state = MasterJournal(journal_dir).replay()
+    assert state.counters["done"] == EXPECTED_TASKS, (
+        "every task must count done exactly once: %r" % state.counters
+    )
+    assert len(state.pending) == 0, (
+        "no task may be left pending after completion: %r"
+        % state.pending
+    )
+    # progress genuinely spanned the kill: the second incarnation
+    # dispatched work (its boot segment starts at the recovery point)
+    assert state.counters["dispatched"] >= EXPECTED_TASKS
+
+
+def test_sigterm_master_drains_journal_and_exits_75(tmp_path):
+    """Graceful preemption parity with the PS plane: SIGTERM makes the
+    master flush its journal and exit 75 — the budget-exempt code the
+    instance manager relaunches."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    create_recordio_file(
+        64, DatasetName.IMAGE_DEFAULT, (28, 28),
+        temp_dir=str(data_dir), seed=5,
+    )
+    journal_dir = str(tmp_path / "journal")
+    port = free_port()
+    master = _spawn(
+        _master_cmd(port, str(data_dir), journal_dir, free_port()),
+        str(tmp_path / "master.log"),
+    )
+    try:
+        _wait_port(master, port, "master")
+        master.terminate()
+        assert master.wait(timeout=60) == 75
+    finally:
+        _stop([master])
+    # the drained journal replays cleanly
+    state = MasterJournal(journal_dir).replay()
+    assert state.counters["done"] == 0

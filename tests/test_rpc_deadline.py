@@ -210,12 +210,20 @@ def test_deadline_passed_to_stub():
 
 def test_master_control_plane_stays_blocking():
     """MasterClient must NOT pick up data-plane deadlines: get_task
-    parks legitimately while the master is busy/forming."""
+    parks legitimately while the master is busy/forming. All master
+    traffic routes through the audited failover wrapper
+    (docs/master_recovery.md), whose INNER channel stays blocking and
+    retry-free — outage retry is the wrapper's own loop, opt-in via
+    failover_s and UNAVAILABLE-only."""
     from elasticdl_tpu.master.rpc_service import MasterClient
+    from elasticdl_tpu.rpc.failover import MasterFailoverChannel
 
     mc = MasterClient("localhost:%d" % free_port())
-    assert mc._client._deadline_s is None
-    assert mc._client._retries == 0
+    assert isinstance(mc._client, MasterFailoverChannel)
+    assert mc._client._client._deadline_s is None
+    assert mc._client._client._retries == 0
+    # failover is opt-in: the default channel is a pure pass-through
+    assert mc._client.outage_budget_s == 0.0
     # while the PS data-plane default wiring DOES bound its calls
     from elasticdl_tpu.common.args import parse_worker_args
 
