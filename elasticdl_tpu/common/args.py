@@ -283,6 +283,20 @@ def add_ps_snapshot_params(parser):
         "versions; older ones are evicted only after a newer one "
         "published",
     )
+    parser.add_argument(
+        "--ps_telemetry_port",
+        type=int,
+        default=-1,
+        help="Serve each PS shard's own metric registry (RPC service "
+        "histograms under role=ps, edl_ps_snapshot_age_seconds, ...) "
+        "plus /events, /trace, and /healthz at this port — parity "
+        "with the master's TelemetryHTTPServer (docs/observability.md)"
+        ". 0 = ephemeral (exposed as ParameterServer."
+        "ps_telemetry_port); -1 (default) disables. Distinct from the "
+        "master's --telemetry_port on purpose: the master relays its "
+        "own flags to PS pods, and a shared name would make every "
+        "co-located shard fight the master for one port",
+    )
 
 
 def add_evaluate_params(parser):
@@ -631,15 +645,6 @@ def parse_ps_args(ps_args=None):
         "deployment would see. 0 (default) disables",
     )
     add_ps_snapshot_params(parser)
-    parser.add_argument(
-        "--telemetry_port",
-        type=int,
-        default=-1,
-        help="Serve this PS process's metric registry (RPC service "
-        "histograms, edl_ps_snapshot_age_seconds, ...) as Prometheus "
-        "text on /metrics at this port (0 = ephemeral). -1 (default) "
-        "disables",
-    )
     parser.add_argument(
         "--log_level",
         default="INFO",

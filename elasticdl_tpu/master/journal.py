@@ -63,6 +63,7 @@ import time
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.ps.snapshot import mint_shard_epoch
+from elasticdl_tpu.utils import profiling
 
 _SEG_PREFIX = "seg-"
 _TMP_PREFIX = "tmp-"
@@ -460,7 +461,14 @@ class MasterJournal:
 
     def _write_batch(self, batch, seq):
         with self._io:
-            self._write_io(batch)
+            # the journal's fsync cadence is the master plane's one
+            # recurring disk wait — a span per batch makes a slow disk
+            # visible in the same /trace timeline as the dispatch and
+            # report spans it can stall (docs/observability.md)
+            with profiling.span(
+                "master/journal_fsync", records=len(batch)
+            ):
+                self._write_io(batch)
         with self._mu:
             self._records_in_segment += len(batch)
             self._flushed_seq = max(self._flushed_seq, seq)

@@ -124,6 +124,22 @@ class Master:
         self.master_epoch = mint_master_epoch(journal_dir or None)
         self._health = "restoring"
         self._stopped = False
+        # crash flight recorder (docs/observability.md): postmortems
+        # land next to the dispatch journal (durable across the
+        # relaunch, like everything recovery depends on);
+        # EDL_FLIGHT_RECORDER_DIR overrides for journal-less masters
+        import os as _os
+
+        from elasticdl_tpu.utils import profiling as _profiling
+
+        fr_dir = _os.environ.get("EDL_FLIGHT_RECORDER_DIR") or (
+            _os.path.join(journal_dir, "postmortem")
+            if journal_dir
+            else ""
+        )
+        self._owns_flight_recorder = bool(fr_dir)
+        if fr_dir:
+            _profiling.flight_recorder.arm(fr_dir)
 
         self.task_d = _make_task_dispatcher(
             getattr(args, "training_data", ""),
@@ -594,6 +610,13 @@ class Master:
 
             profiling.events.close_file()
             self._owns_event_sink = False
+        if self._owns_flight_recorder:
+            # same process-global hygiene as the event sink: a later
+            # in-process job must not dump into this job's directory
+            from elasticdl_tpu.utils import profiling
+
+            profiling.flight_recorder.disarm()
+            self._owns_flight_recorder = False
         if self.instance_manager:
             self.instance_manager.stop_relaunch_and_remove_all_pods()
         if self._server:
@@ -639,9 +662,13 @@ def main():
 
     from elasticdl_tpu.common.args import parse_master_args
     from elasticdl_tpu.common.jax_platform import honor_jax_platforms_env
+    from elasticdl_tpu.utils import profiling
 
     honor_jax_platforms_env()
     args = parse_master_args()
+    # name this process in every span id / postmortem header (entry
+    # points only: in-process masters keep the owning process's tag)
+    profiling.spans.set_process("master")
     master = Master(args)
     master.prepare()
     master.install_drain_handler()

@@ -33,6 +33,7 @@ import http.server
 import json
 import threading
 import time
+import urllib.parse
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.utils import profiling
@@ -42,7 +43,40 @@ from elasticdl_tpu.utils import profiling
 STALE_WORKER_SECS = 60.0
 
 
-class JobTelemetry:
+class ProcessTelemetry:
+    """The single-process telemetry surface for TelemetryHTTPServer.
+
+    A PS shard (or any process with no fleet to aggregate) serves its
+    OWN registry/events/spans behind ``--ps_telemetry_port`` with this
+    adapter — /metrics, /events (with the ?since cursor), and /trace
+    all answer from the process-wide singletons in utils/profiling
+    (docs/observability.md, docs/ps_recovery.md). :class:`JobTelemetry`
+    extends it with fleet aggregation, so the master and PS endpoints
+    share one implementation of every read surface."""
+
+    def __init__(self, registry=None, event_log=None, span_log=None):
+        self._registry = registry or profiling.metrics
+        self._events = event_log or profiling.events
+        self._spans = span_log or profiling.spans
+
+    def prometheus_text(self):
+        return self._registry.prometheus_text()
+
+    def events_tail(self, n=200, since=None):
+        return self._events.tail(n, since=since)
+
+    def trace_events(self, trace_id=None, n=4096):
+        """The span ring as Chrome trace-event JSON — what ``GET
+        /trace`` serves and ``tools/tracetool.py`` decomposes into a
+        per-step critical-path breakdown. ``trace_id`` filters to one
+        task trace."""
+        recs = self._spans.tail(n)
+        if trace_id:
+            recs = [r for r in recs if r.get("trace") == trace_id]
+        return profiling.chrome_trace(recs)
+
+
+class JobTelemetry(ProcessTelemetry):
     """Aggregates worker telemetry snapshots into the metrics registry.
 
     ``task_dispatcher`` (optional) feeds the live task-queue-depth
@@ -50,9 +84,16 @@ class JobTelemetry:
     singletons in utils/profiling.
     """
 
-    def __init__(self, task_dispatcher=None, registry=None, event_log=None):
-        self._registry = registry or profiling.metrics
-        self._events = event_log or profiling.events
+    def __init__(
+        self,
+        task_dispatcher=None,
+        registry=None,
+        event_log=None,
+        span_log=None,
+    ):
+        super().__init__(
+            registry=registry, event_log=event_log, span_log=span_log
+        )
         self._task_d = task_dispatcher
         self._lock = threading.Lock()
         self._workers = {}  # worker_id -> (snapshot, monotonic recv time)
@@ -144,6 +185,12 @@ class JobTelemetry:
         shipped = snapshot.get("events")
         if shipped:
             self._events.ingest(shipped, worker=worker)
+        shipped_spans = snapshot.get("spans")
+        if shipped_spans:
+            # worker spans join the master's span ring (ids stay
+            # process-scoped unique), so /trace serves one job-wide
+            # timeline (docs/observability.md)
+            self._spans.ingest(shipped_spans)
         self._update_job_aggregates(now)
 
     def _update_job_aggregates(self, now):
@@ -174,9 +221,9 @@ class JobTelemetry:
     def prometheus_text(self):
         self._update_job_aggregates(time.monotonic())
         return self._registry.prometheus_text()
-
-    def events_tail(self, n=200):
-        return self._events.tail(n)
+    # events_tail / trace_events inherited from ProcessTelemetry: the
+    # master's span ring already holds its own + every worker's
+    # shipped spans (ingest above), so the read surface is identical
 
 
 class _TelemetryHandler(http.server.BaseHTTPRequestHandler):
@@ -187,19 +234,43 @@ class _TelemetryHandler(http.server.BaseHTTPRequestHandler):
 
     def do_GET(self):
         code = 200
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        params = urllib.parse.parse_qs(query)
         if path == "/metrics":
             body = self.telemetry.prometheus_text().encode("utf-8")
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/events":
+            # ?since=<id>: the EventLog's monotonic ids are the cursor,
+            # so a poller resumes from its last seen id instead of
+            # re-reading the whole ring each scrape
+            since = None
+            if "since" in params:
+                try:
+                    since = int(params["since"][0])
+                except (ValueError, IndexError):
+                    self.send_error(400, "since must be an integer id")
+                    return
             body = (
                 "\n".join(
                     json.dumps(e, default=str)
-                    for e in self.telemetry.events_tail()
+                    for e in self.telemetry.events_tail(since=since)
                 )
                 + "\n"
             ).encode("utf-8")
             ctype = "application/x-ndjson"
+        elif path == "/trace":
+            # Chrome trace-event JSON (open in Perfetto / chrome://
+            # tracing, or feed tools/tracetool.py); ?trace_id= filters
+            # to one task trace
+            if not hasattr(self.telemetry, "trace_events"):
+                self.send_error(404)
+                return
+            trace_id = (params.get("trace_id") or [None])[0]
+            body = json.dumps(
+                self.telemetry.trace_events(trace_id=trace_id),
+                default=str,
+            ).encode("utf-8")
+            ctype = "application/json"
         elif path == "/healthz":
             # recovery-plane readiness (docs/master_recovery.md): a
             # relaunched master serves "restoring" (503) while its
@@ -228,7 +299,8 @@ class _TelemetryHandler(http.server.BaseHTTPRequestHandler):
 
 
 class TelemetryHTTPServer:
-    """Serves /metrics (Prometheus text), /events (JSONL), /healthz.
+    """Serves /metrics (Prometheus text), /events (JSONL, ?since=id
+    cursor), /trace (Chrome trace-event JSON), /healthz.
 
     ``port=0`` binds an ephemeral port (exposed as ``.port``). The
     serving thread is a daemon AND joined in :meth:`close` (edlint R4

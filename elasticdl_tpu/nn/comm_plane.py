@@ -590,15 +590,18 @@ class EmbeddingPullPipeline:
                 )
             return self._pool
 
-    def submit(self, key_obj, plan, pull_fn):
+    def submit(self, key_obj, plan, pull_fn, trace_id=None):
         """Stage ``pull_fn()`` for the batch identified by ``key_obj``.
 
         ``plan`` rides alongside so the consumer gets back exactly the
         lookups the pull was planned from. Oldest entries beyond the
         lookahead depth are dropped (they can only belong to batches
-        the consumer already passed)."""
+        the consumer already passed). ``trace_id`` labels the
+        background pull's span so the overlapped fan-out shows inside
+        the same task trace as the step it hides behind
+        (docs/observability.md)."""
         pool = self._get_pool()
-        fut = pool.submit(pull_fn)
+        fut = pool.submit(self._traced_pull, pull_fn, trace_id)
         with self._mu:
             self._entries[id(key_obj)] = (key_obj, plan, fut)
             evicted = []
@@ -606,6 +609,18 @@ class EmbeddingPullPipeline:
                 evicted.append(self._entries.popitem(last=False))
         for _key, (_, _, old) in evicted:
             self._drop(old)
+
+    @staticmethod
+    def _traced_pull(pull_fn, trace_id):
+        """Run the staged pull under a span on the pipeline thread —
+        the overlap's other half in the trace timeline (the consumer's
+        ``step/embedding_pull`` span shows only the blocking tail)."""
+        from elasticdl_tpu.utils import profiling
+
+        with profiling.span(
+            "step/embedding_pull_bg", trace_id=trace_id, pipelined=True
+        ):
+            return pull_fn()
 
     def consume(self, key_obj):
         """(plan, pulled_rows) staged for this batch, or None.

@@ -34,6 +34,10 @@ class ParameterServer:
         self._shm_registry = None
         self._telemetry_http = None
         self._draining = threading.Event()
+        # /healthz state machine (master parity): "restoring" until the
+        # RPC plane serves, then "serving", "draining" through SIGTERM
+        self._health = "restoring"
+        self._owns_flight_recorder = False
         module = load_module(
             get_module_file_path(args.model_zoo, args.model_def)
         ).__dict__
@@ -67,6 +71,18 @@ class ParameterServer:
             keep=int(getattr(args, "ps_snapshot_keep", 2) or 2),
         )
         self.snapshotter.set_shard_epoch(self.shard_epoch)
+        # crash flight recorder (docs/observability.md): postmortem
+        # dumps land next to the shard's snapshots (durable across the
+        # relaunch); EDL_FLIGHT_RECORDER_DIR overrides for
+        # snapshot-less shards
+        from elasticdl_tpu.utils import profiling
+
+        fr_dir = os.environ.get("EDL_FLIGHT_RECORDER_DIR") or (
+            os.path.join(shard_dir, "postmortem") if shard_dir else ""
+        )
+        if fr_dir:
+            profiling.flight_recorder.arm(fr_dir)
+            self._owns_flight_recorder = True
         self.restored_version = self.snapshotter.restore_into(
             self.parameters
         )
@@ -111,29 +127,31 @@ class ParameterServer:
         methods, self._shm_registry = install_shm_endpoint(
             methods, hello_extra={"shard_epoch": self.shard_epoch}
         )
-        telemetry_port = getattr(self._args, "telemetry_port", -1)
+        telemetry_port = getattr(self._args, "ps_telemetry_port", None)
+        if telemetry_port is None:
+            # legacy attr name (pre-rename namespaces built by tests)
+            telemetry_port = getattr(self._args, "telemetry_port", -1)
         if telemetry_port is not None and telemetry_port >= 0:
-            # the PR-6 /metrics plane, per PS pod: the process-wide
-            # registry (per-method service histograms, the snapshot-age
-            # gauge) + this process's event tail
+            # the PR-6 /metrics plane, per PS pod — full parity with
+            # the master's endpoint (docs/observability.md): this
+            # process's registry (per-method service histograms under
+            # role=ps, the snapshot-age gauge), /events with the
+            # ?since cursor, /trace (the shard's span ring), and a
+            # /healthz that answers "restoring" 503 until the RPC
+            # plane serves
             from elasticdl_tpu.master.telemetry import (
+                ProcessTelemetry,
                 TelemetryHTTPServer,
             )
-            from elasticdl_tpu.utils import profiling
-
-            class _Registry:
-                @staticmethod
-                def prometheus_text():
-                    return profiling.metrics.prometheus_text()
-
-                @staticmethod
-                def events_tail(n=200):
-                    return profiling.events.tail(n)
 
             self._telemetry_http = TelemetryHTTPServer(
-                _Registry(), port=telemetry_port
+                ProcessTelemetry(),
+                port=telemetry_port,
+                health_fn=lambda: self._health,
             )
+            self.ps_telemetry_port = self._telemetry_http.port
         self._server = serve(methods, self._args.port)
+        self._health = "serving"
         logger.info(
             "RPC server started on port %d (shard_epoch %d%s)",
             self._server._edl_port,
@@ -157,6 +175,7 @@ class ParameterServer:
             if self._draining.is_set():
                 return  # a second SIGTERM while draining: already going
             self._draining.set()
+            self._health = "draining"
             logger.warning(
                 "SIGTERM: draining a final shard snapshot before exit"
             )
@@ -199,14 +218,25 @@ class ParameterServer:
             except Exception as err:  # noqa: BLE001 — teardown
                 logger.warning("snapshotter close failed: %s", err)
             self.snapshotter = None
+        if self._owns_flight_recorder:
+            # the recorder is process-global; embedded/test instances
+            # must not leave it pointed at a torn-down tmpdir
+            from elasticdl_tpu.utils import profiling
+
+            profiling.flight_recorder.disarm()
+            self._owns_flight_recorder = False
 
 
 def main():
     from elasticdl_tpu.common.args import parse_ps_args
     from elasticdl_tpu.common.jax_platform import honor_jax_platforms_env
+    from elasticdl_tpu.utils import profiling
 
     honor_jax_platforms_env()
     args = parse_ps_args()
+    # name this process in every span id / postmortem header (entry
+    # points only: embedded test instances keep the pid default)
+    profiling.spans.set_process("ps-%d" % args.ps_id)
     server = ParameterServer(args)
     server.prepare()
     server.install_drain_handler()

@@ -25,6 +25,7 @@ from elasticdl_tpu.master.learning_rate_modulator import (
 )
 from elasticdl_tpu.ps.optimizer_wrapper import OptimizerWrapper
 from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+from elasticdl_tpu.utils import profiling
 
 
 class PserverServicer:
@@ -80,9 +81,14 @@ class PserverServicer:
         (capture is a copy under the apply lock; disk IO is the
         snapshotter's background thread)."""
         if self._snapshotter is not None:
-            self._snapshotter.maybe_snapshot(
-                self._parameters, apply_lock=self._optimizer.apply_lock
-            )
+            # the span times the capture SUBMIT (the copy under the
+            # apply lock); the disk write runs on the snapshotter's
+            # background thread, off every trace
+            with profiling.span("ps/snapshot_submit"):
+                self._snapshotter.maybe_snapshot(
+                    self._parameters,
+                    apply_lock=self._optimizer.apply_lock,
+                )
 
     def drain_snapshot(self):
         """Final synchronous snapshot (the SIGTERM drain path): settle
@@ -90,10 +96,11 @@ class PserverServicer:
         newest-last, then capture+write whatever the store holds."""
         if self._snapshotter is None:
             return None
-        self._snapshotter.wait()
-        return self._snapshotter.snapshot_now(
-            self._parameters, apply_lock=self._optimizer.apply_lock
-        )
+        with profiling.span("ps/snapshot_drain"):
+            self._snapshotter.wait()
+            return self._snapshotter.snapshot_now(
+                self._parameters, apply_lock=self._optimizer.apply_lock
+            )
 
     # -- RPC methods --------------------------------------------------------
 
@@ -223,10 +230,12 @@ class PserverServicer:
                     k: v / self._grads_to_wait
                     for k, v in self._dense_sum.items()
                 }
-                self._optimizer.apply_gradients(
-                    dense_grads=dense, embedding_grads=self._indexed_sum
-                )
-                self._parameters.version += 1
+                with profiling.span("ps/apply", sync=True):
+                    self._optimizer.apply_gradients(
+                        dense_grads=dense,
+                        embedding_grads=self._indexed_sum,
+                    )
+                    self._parameters.version += 1
                 self._dense_sum.clear()
                 self._indexed_sum.clear()
                 self._grad_n = 0
@@ -250,11 +259,14 @@ class PserverServicer:
                 sparse[t.name] = t
             else:
                 dense[t.name] = t.values
-        self._optimizer.apply_gradients(
-            dense_grads=dense, embedding_grads=sparse
-        )
-        with self._version_lock:
-            self._parameters.version += 1
+        # nests under the rpc/push_gradient server span when the caller
+        # shipped its span context, so a trace shows wire vs apply time
+        with profiling.span("ps/apply"):
+            self._optimizer.apply_gradients(
+                dense_grads=dense, embedding_grads=sparse
+            )
+            with self._version_lock:
+                self._parameters.version += 1
         self._maybe_snapshot()
 
     def ps_status(self, req):

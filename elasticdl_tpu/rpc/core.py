@@ -319,6 +319,19 @@ class Client:
             # when two legs race the first call of a method (the loser
             # stub is garbage, never a torn entry)
             stub = self._stubs.setdefault(rpc_name, stub)
+        if _plan is None and "_sctx" not in fields and (
+            "_shm_req" not in fields
+        ):
+            # cross-process tracing (docs/observability.md): the
+            # innermost open span's [trace_id, span_id] rides as one
+            # small json field, so the serving process's rpc span joins
+            # the caller's trace. An already-built plan (the shm
+            # transport's oversize fallback) carries its own context,
+            # and a slot-riding call (_shm_req) already injected into
+            # the slot payload — the control message needs no copy.
+            sctx = profiling.wire_span_context()
+            if sctx is not None:
+                fields["_sctx"] = sctx
         plan = _plan if _plan is not None else plan_message(fields)
         buf = bytearray(plan.total)
         pack_message_into(plan, buf)

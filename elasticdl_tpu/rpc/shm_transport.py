@@ -342,6 +342,15 @@ class ShmChannel:
             plan_message,
             unpack_message,
         )
+        from elasticdl_tpu.utils import profiling
+
+        # span context rides the SLOT payload (the control message only
+        # carries the slot spec), so inject before planning; the inline
+        # fallbacks reuse these fields and Client.call skips its own
+        # injection when the key is already present
+        sctx = profiling.wire_span_context()
+        if sctx is not None and "_sctx" not in fields:
+            fields["_sctx"] = sctx
 
         if self._ensure() != "on":
             return self._inline(method, fields)

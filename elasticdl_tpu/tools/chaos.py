@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.utils import profiling
 
 
 class ChaosPartitionError(RuntimeError):
@@ -298,6 +299,17 @@ class FleetChaos:
             self._manager.kill_master()
         else:
             self._manager.terminate_master()
+        # the kill is itself a job event: it lands in the harness
+        # process's event log AND (chaos_kill/chaos_term are flight-
+        # recorder trigger kinds) freezes a postmortem timeline of the
+        # seconds before the kill — every chaos drill leaves a readable
+        # record of its own fault injection (docs/observability.md)
+        profiling.events.emit(
+            "chaos_kill" if "kill" in op.kind else "chaos_term",
+            op=op.kind,
+            shard=op.shard,
+            target="master" if op.kind in ChaosOp.MASTER_KINDS else "ps",
+        )
 
     def _run(self):
         pending = [

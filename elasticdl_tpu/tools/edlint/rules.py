@@ -679,11 +679,15 @@ _LOG_METHODS = (
 )
 # telemetry-plane receivers (utils/profiling: the Counters shim, the
 # metrics registry and its Counter/Gauge/Histogram objects, the event
-# log) and their record methods — a registry call inside traced code
-# fires once per TRACE, not per step, so the counter silently stops
-# counting after compilation (docs/observability.md)
-_TELEMETRY_RECEIVERS = ("counters", "metrics", "events", "profiling")
-_TELEMETRY_METHODS = ("inc", "observe", "set", "emit", "count", "add")
+# log, the tracing SpanLog) and their record methods — a registry call
+# inside traced code fires once per TRACE, not per step, so the counter
+# silently stops counting after compilation; a span opened there times
+# the TRACE, not the step, and records exactly once
+# (docs/observability.md)
+_TELEMETRY_RECEIVERS = ("counters", "metrics", "events", "profiling",
+                        "spans")
+_TELEMETRY_METHODS = ("inc", "observe", "set", "emit", "count", "add",
+                      "span", "begin")
 
 
 class JitPurityRule(Rule):
@@ -900,6 +904,14 @@ RPC_IDEMPOTENT = frozenset(
         "report_variable",
         "report_gradient",
         "report_task_result",
+        # report_telemetry also carries the tracing plane's payload
+        # (drained spans + events ride the snapshot; a failed ship
+        # requeues them). Resend-safe: SpanLog.ingest dedups by the
+        # process-scoped span ids, so a snapshot resent through a
+        # connection reset lands its spans exactly once; rates are
+        # last-write-wins gauges. NOTE for new telemetry RPCs: spans
+        # piggyback here ON PURPOSE so tracing adds no new wire
+        # surface; classify any future telemetry RPC the same way.
         "report_telemetry",
         "report_evaluation_metrics",
         "report_version",

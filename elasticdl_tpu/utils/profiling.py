@@ -27,15 +27,32 @@ them on without plumbing:
   ids, an optional JSONL file sink, and a bounded pending buffer that
   workers drain into their telemetry snapshots so the master's log
   aggregates the whole fleet.
+- :data:`spans` — the process-wide :class:`SpanLog`: job-wide
+  distributed tracing (docs/observability.md "Distributed tracing").
+  :func:`span` opens one timed operation with trace/span/parent ids;
+  span context propagates across threads via a per-thread stack and
+  across processes by riding the wire (``_sctx`` fields injected by
+  rpc clients, task ``trace_id``s as trace roots). Worker spans ship
+  to the master on the existing ``report_telemetry`` snapshots; the
+  master's ``/trace`` endpoint exports Chrome trace-event JSON
+  (:func:`chrome_trace`) loadable in Perfetto.
+- :data:`flight_recorder` — the crash :class:`FlightRecorder`: on a
+  triggering job event (PS shard failure, master epoch change, task
+  requeue, chaos kill) it freezes the last N spans + events to a
+  postmortem JSONL next to the journal/snapshots, so every kill leaves
+  a readable timeline of its own death.
 
 Env toggles (read by workers at startup): ``EDL_PROFILE_DIR`` enables
 tracing into that directory; ``EDL_XLA_DUMP_DIR`` enables HLO dumps;
 ``EDL_METRICS=0`` turns the telemetry instrumentation into no-ops (the
-bench's overhead A/B arm).
+bench's overhead A/B arm — spans, events, and the flight recorder all
+honor it); ``EDL_FLIGHT_RECORDER_DIR`` arms the flight recorder in any
+process (:func:`maybe_arm_flight_recorder`).
 """
 
 import bisect
 import contextlib
+import glob
 import json
 import os
 import re
@@ -499,12 +516,22 @@ def instrument_service_methods(methods, role, registry=None):
     )
 
     def wrap(name, fn):
+        rpc_span = "rpc/" + name
+
         def handler(*args, **kwargs):
             if not _metrics_on:
                 return fn(*args, **kwargs)
+            # cross-process tracing: a dict request carrying the
+            # caller's "_sctx" context gets a server span joined to the
+            # caller's trace (docs/observability.md); requests without
+            # context (or non-dict in-process calls) record nothing
+            sp = span_from_wire(
+                args[0] if args else None, rpc_span, role=role
+            )
             t0 = time.perf_counter()
             try:
-                return fn(*args, **kwargs)
+                with sp:
+                    return fn(*args, **kwargs)
             except Exception:
                 errors.inc(role=role, method=name)
                 raise
@@ -592,6 +619,11 @@ class EventLog:
                     except OSError:
                         pass
                     self._sink = None
+        # OUTSIDE the lock: a triggering kind (PS shard failure, master
+        # epoch change, task requeue, chaos kill) dumps the postmortem
+        # rings to disk — IO that must never run under the event lock
+        # (edlint R5), and the recorder re-reads the rings itself
+        flight_recorder.on_event(event)
         return event
 
     def ingest(self, shipped_events, **extra):
@@ -626,9 +658,22 @@ class EventLog:
         with self._lock:
             self._pending.extendleft(reversed(list(drained_events)))
 
-    def tail(self, n=100):
+    def tail(self, n=100, since=None):
+        """The last ``n`` events; with ``since`` only events whose
+        monotonic id is strictly greater — the ``/events?since=<id>``
+        cursor, so pollers stop re-reading the whole ring each scrape."""
         with self._lock:
-            return list(self._ring)[-n:]
+            out = list(self._ring)
+        if since is not None:
+            since = int(since)
+            out = [e for e in out if e.get("id", 0) > since]
+        return out[-n:]
+
+    def last_id(self):
+        """The newest assigned event id (0 before the first emit) —
+        what a ``?since=`` poller should resume from."""
+        with self._lock:
+            return self._next_id
 
     def reset(self):
         """Tests only: drop state, detach the sink, restart ids."""
@@ -643,6 +688,531 @@ class EventLog:
 
 
 events = EventLog()
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing: cross-process spans (docs/observability.md)
+# ---------------------------------------------------------------------------
+
+_span_stack = threading.local()  # per-thread stack of OPEN spans
+
+
+def _stack():
+    stack = getattr(_span_stack, "v", None)
+    if stack is None:
+        stack = _span_stack.v = []
+    return stack
+
+
+def _json_scalar(v):
+    return (
+        v
+        if isinstance(v, (str, int, float, bool, type(None)))
+        else str(v)
+    )
+
+
+class _NullSpan:
+    """The disabled-tracing span (EDL_METRICS=0): every operation is a
+    no-op, so call sites never branch on the kill switch themselves."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **fields):
+        return self
+
+    def set_trace(self, trace_id):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One timed operation inside a cross-process trace.
+
+    Identity: ``trace_id`` (the job-level correlation key — for task
+    work this is the dispatcher's PR-6 task trace id, stable across
+    requeues and a master relaunch), ``span_id`` (process-unique:
+    ``<proc>/<seq>``), ``parent_id``. Timestamps: ``ts`` is wall clock
+    at ``__enter__`` (what aligns processes in one timeline — same-host
+    fleets align exactly, cross-host to NTP skew), the duration is a
+    monotonic ``perf_counter`` pair. Use as a context manager; entering
+    pushes onto the per-thread context stack so nested spans inherit
+    trace and parent, and exiting records the finished span into the
+    owning :class:`SpanLog`."""
+
+    __slots__ = (
+        "name",
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "fields",
+        "_log",
+        "_ts",
+        "_t0",
+        "_thread",
+    )
+
+    def __init__(self, log, name, trace_id, span_id, parent_id, fields):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.fields = fields
+        self._log = log
+        self._ts = None
+        self._t0 = None
+        self._thread = None
+
+    def add(self, **fields):
+        """Attach fields to the (still open) span."""
+        self.fields.update(
+            (k, _json_scalar(v)) for k, v in fields.items()
+        )
+        return self
+
+    def set_trace(self, trace_id):
+        """Late trace binding: a dispatch span learns its task's trace
+        only after the stamp. First binding wins."""
+        if self.trace_id is None and trace_id is not None:
+            self.trace_id = trace_id
+        return self
+
+    def __enter__(self):
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        self._thread = threading.current_thread().name
+        _stack().append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        else:  # exotic exit order: drop this span wherever it sits
+            try:
+                stack.remove(self)
+            except ValueError:
+                pass
+        if exc_type is not None:
+            self.fields.setdefault("error", exc_type.__name__)
+        self._log._finish(self, dur)
+        return False
+
+
+class SpanLog:
+    """Process-wide span recorder: bounded ring + pending ship buffer.
+
+    Mirrors :class:`EventLog`'s shape on purpose: finished spans append
+    to a bounded in-memory ring (the ``/trace`` endpoint and the flight
+    recorder read it) and to a bounded *pending* buffer that the worker
+    telemetry snapshot drains — spans piggyback on the same
+    ``report_telemetry`` RPC as events, so no new wire surface exists
+    for tracing. Span records are plain JSON-safe dicts::
+
+        {"name", "trace", "span", "parent", "proc", "thread",
+         "ts" (wall secs), "dur" (secs), ...user fields}
+
+    ``set_process`` names this process in every span id and record
+    (``worker-3`` / ``ps-1`` / ``master``; default ``pid-<pid>``) —
+    process entry points set it, in-process test jobs keep the default.
+    """
+
+    def __init__(self, capacity=4096, pending_capacity=1024):
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=capacity)
+        self._pending = deque(maxlen=pending_capacity)
+        self._seq = 0
+        self._proc = "pid-%d" % os.getpid()
+        # ingest dedup: a worker's report_telemetry retried through an
+        # UNAVAILABLE-after-processing window re-ships the SAME spans;
+        # span ids are process-scoped unique, so remembering the last
+        # ring's worth of ingested ids makes ingestion idempotent
+        # (bounded: the deque evicts, the set mirrors it)
+        self._ingested_order = deque(maxlen=capacity)
+        self._ingested = set()
+
+    def set_process(self, proc):
+        with self._lock:
+            self._proc = str(proc)
+
+    @property
+    def process(self):
+        with self._lock:
+            return self._proc
+
+    def begin(self, name, trace_id=None, parent_id=None, **fields):
+        """Open a span; inherit trace/parent from the innermost open
+        span on THIS thread unless given explicitly. Prefer the
+        module-level :func:`span` (it honors the kill switch)."""
+        stack = _stack()
+        if stack:
+            top = stack[-1]
+            if parent_id is None:
+                parent_id = top.span_id
+            if trace_id is None:
+                trace_id = top.trace_id
+        with self._lock:
+            self._seq += 1
+            span_id = "%s/%d" % (self._proc, self._seq)
+        return Span(
+            self,
+            str(name),
+            trace_id if trace_id is None else str(trace_id),
+            span_id,
+            parent_id,
+            {k: _json_scalar(v) for k, v in fields.items()},
+        )
+
+    def _finish(self, span, dur):
+        rec = {
+            "name": span.name,
+            "trace": span.trace_id,
+            "span": span.span_id,
+            "parent": span.parent_id,
+            "thread": span._thread,
+            "ts": round(span._ts, 6),
+            "dur": round(dur, 6),
+        }
+        rec.update(span.fields)
+        with self._lock:
+            rec["proc"] = self._proc
+            self._ring.append(rec)
+            self._pending.append(rec)
+
+    def ingest(self, shipped_spans, **extra):
+        """Append spans shipped from another process to the ring (the
+        master aggregating its fleet). Span ids are process-scoped
+        unique, so records keep their identity; spans stamped with THIS
+        process's tag are skipped — in the in-process local mode the
+        worker and master share one SpanLog, and re-appending a drained
+        span would duplicate it in the timeline. Already-seen span ids
+        are skipped too: a snapshot resent through a connection-reset
+        window (report_telemetry is retriable) must not double its
+        spans into /trace and the tracetool breakdown."""
+        if not shipped_spans:
+            return
+        with self._lock:
+            own = self._proc
+            for s in shipped_spans:
+                if not isinstance(s, dict) or s.get("proc") == own:
+                    continue
+                sid = s.get("span")
+                if sid is not None:
+                    if sid in self._ingested:
+                        continue
+                    if len(self._ingested_order) == (
+                        self._ingested_order.maxlen
+                    ):
+                        self._ingested.discard(
+                            self._ingested_order.popleft()
+                        )
+                    self._ingested_order.append(sid)
+                    self._ingested.add(sid)
+                if extra:
+                    s = dict(s)
+                    s.update(extra)
+                self._ring.append(s)
+
+    def drain_pending(self, max_n=256):
+        """Pop up to ``max_n`` un-shipped spans (worker piggyback)."""
+        out = []
+        with self._lock:
+            while self._pending and len(out) < max_n:
+                out.append(self._pending.popleft())
+        return out
+
+    def requeue(self, drained_spans):
+        """Put drained-but-unshipped spans back (failed telemetry ship
+        must not lose them; same contract as EventLog.requeue)."""
+        if not drained_spans:
+            return
+        with self._lock:
+            self._pending.extendleft(reversed(list(drained_spans)))
+
+    def tail(self, n=4096):
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def reset(self):
+        """Tests only: drop state, restart ids (keeps the proc tag)."""
+        with self._lock:
+            self._ring.clear()
+            self._pending.clear()
+            self._ingested_order.clear()
+            self._ingested.clear()
+            self._seq = 0
+
+
+spans = SpanLog()
+
+
+def span(name, trace_id=None, parent_id=None, **fields):
+    """Open one timed span (context manager). Returns the no-op
+    :data:`NULL_SPAN` when telemetry is disabled (EDL_METRICS=0), so
+    the hot path pays one module-global read. Record around the jit
+    dispatch, never inside traced code (edlint R7)."""
+    if not _metrics_on:
+        return NULL_SPAN
+    return spans.begin(
+        name, trace_id=trace_id, parent_id=parent_id, **fields
+    )
+
+
+def current_span():
+    """The innermost open span on this thread, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def wire_span_context():
+    """``[trace_id, span_id]`` of the innermost open TRACED span, or
+    None — what rpc clients inject as the request's ``_sctx`` field so
+    the serving process's spans join the caller's trace."""
+    if not _metrics_on:
+        return None
+    stack = _stack()
+    if not stack:
+        return None
+    top = stack[-1]
+    if top.trace_id is None:
+        return None
+    return [top.trace_id, top.span_id]
+
+
+def span_from_wire(req, name, **fields):
+    """Server side of the propagation: a span parented on the request's
+    ``_sctx`` context (see :func:`wire_span_context`), or NULL_SPAN
+    when the request carries none — untraced RPCs record nothing, so
+    the server ring holds only spans that join a real trace."""
+    if not _metrics_on or not isinstance(req, dict):
+        return NULL_SPAN
+    sctx = req.get("_sctx")
+    if not (isinstance(sctx, (list, tuple)) and len(sctx) == 2):
+        return NULL_SPAN
+    return spans.begin(
+        name, trace_id=sctx[0], parent_id=sctx[1], **fields
+    )
+
+
+def chrome_trace(span_records):
+    """Span records -> a Chrome trace-event JSON document (the
+    Perfetto-loadable catapult format): one complete ``"X"`` event per
+    span (microsecond wall timestamps), with ``process_name`` /
+    ``thread_name`` metadata mapping the string proc/thread tags onto
+    the integer pids/tids the format requires."""
+    procs = {}
+    threads = {}
+    out = []
+    for rec in span_records:
+        if not isinstance(rec, dict):
+            continue
+        proc = str(rec.get("proc", "?"))
+        pid = procs.setdefault(proc, len(procs) + 1)
+        tname = str(rec.get("thread", "main"))
+        tid = threads.setdefault((proc, tname), len(threads) + 1)
+        args = {
+            k: v
+            for k, v in rec.items()
+            if k not in ("name", "ts", "dur", "proc", "thread")
+        }
+        out.append(
+            {
+                "name": rec.get("name", "?"),
+                "cat": "edl",
+                "ph": "X",
+                "ts": round(float(rec.get("ts", 0.0)) * 1e6, 3),
+                "dur": round(float(rec.get("dur", 0.0)) * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            }
+        )
+    meta = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": proc},
+        }
+        for proc, pid in procs.items()
+    ] + [
+        {
+            "ph": "M",
+            "name": "thread_name",
+            "pid": procs[proc],
+            "tid": tid,
+            "args": {"name": tname},
+        }
+        for (proc, tname), tid in threads.items()
+    ]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Freezes the last N spans + events to a postmortem JSONL when a
+    failure-shaped job event fires (docs/observability.md "The crash
+    flight recorder").
+
+    Armed per process with a directory "next to the journal/snapshots"
+    (the master arms ``<journal_dir>/postmortem``, a PS shard
+    ``<snapshot_dir>/ps-<id>/postmortem``, any process via
+    ``EDL_FLIGHT_RECORDER_DIR``). :meth:`on_event` is called by
+    ``EventLog.emit`` AFTER its lock drops; a triggering kind dumps one
+    ``postmortem-<seq>-<reason>.jsonl``: a header line, then the event
+    tail, then the span tail — every line independently
+    ``json.loads``-able. Dumps are rate-limited (``min_interval_s``)
+    so a requeue storm cannot spam the disk, and pruned to ``keep``
+    files newest-last."""
+
+    TRIGGER_KINDS = frozenset(
+        (
+            "ps_shard_failure",
+            "master_epoch_change",
+            "master_recovery",
+            "task_requeued",
+            "chaos_kill",
+            "chaos_term",
+        )
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dir = None
+        self._keep = 8
+        self._min_interval = 5.0
+        self._tail = 256
+        self._seq = 0
+        self._last_mono = None
+
+    def arm(self, directory, keep=8, min_interval_s=5.0, tail=256):
+        """Point the recorder at ``directory`` (created if missing)."""
+        directory = str(directory)
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            self._dir = directory
+            self._keep = max(1, int(keep))
+            self._min_interval = max(0.0, float(min_interval_s))
+            self._tail = max(1, int(tail))
+            # a fresh arming is a fresh session: the rate limiter must
+            # not carry a previous job's last-dump clock
+            self._last_mono = None
+        return self
+
+    def disarm(self):
+        with self._lock:
+            self._dir = None
+
+    @property
+    def armed(self):
+        with self._lock:
+            return self._dir is not None
+
+    def on_event(self, event):
+        """EventLog.emit hook (runs OUTSIDE the event lock)."""
+        if event and event.get("kind") in self.TRIGGER_KINDS:
+            self.trigger(event.get("kind"), event)
+
+    def trigger(self, reason, trigger_event=None):
+        """Dump one postmortem now; returns its path (None when
+        disarmed, rate-limited, or the write failed)."""
+        if not _metrics_on:
+            return None
+        with self._lock:
+            d = self._dir
+            if d is None:
+                return None
+            now = time.monotonic()
+            if (
+                self._last_mono is not None
+                and now - self._last_mono < self._min_interval
+            ):
+                return None
+            self._last_mono = now
+            self._seq += 1
+            seq = self._seq
+            keep = self._keep
+            tail = self._tail
+        # all IO below runs OUTSIDE the recorder lock (edlint R5); the
+        # ring tails are independently consistent snapshots
+        safe_reason = _NAME_SANITIZE.sub("_", str(reason))[:40]
+        path = os.path.join(
+            d, "postmortem-%03d-%s.jsonl" % (seq, safe_reason)
+        )
+        header = {
+            "postmortem": str(reason),
+            "ts": round(time.time(), 6),
+            "proc": spans.process,
+            "seq": seq,
+        }
+        if trigger_event is not None:
+            header["trigger"] = {
+                k: _json_scalar(v) for k, v in trigger_event.items()
+            }
+        event_tail = events.tail(tail)
+        span_tail = spans.tail(tail)
+        lines = [header]
+        lines.extend({"type": "event", **e} for e in event_tail)
+        lines.extend({"type": "span", **s} for s in span_tail)
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                for obj in lines:
+                    f.write(json.dumps(obj, default=str) + "\n")
+        except OSError:
+            logger.warning(
+                "flight recorder dump to %s failed", path, exc_info=True
+            )
+            return None
+        self._prune(d, keep)
+        logger.warning(
+            "flight recorder: %s -> %s (%d events, %d spans)",
+            reason,
+            path,
+            len(event_tail),
+            len(span_tail),
+        )
+        return path
+
+    @staticmethod
+    def _prune(directory, keep):
+        dumps = sorted(
+            glob.glob(os.path.join(directory, "postmortem-*.jsonl"))
+        )
+        for stale in dumps[:-keep]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+
+
+flight_recorder = FlightRecorder()
+
+
+def maybe_arm_flight_recorder(directory=None):
+    """Arm the process flight recorder from ``directory`` or the
+    ``EDL_FLIGHT_RECORDER_DIR`` env (worker pods have no durable
+    directory of their own, so the env is their switch). Returns
+    whether the recorder is armed."""
+    d = directory or os.environ.get("EDL_FLIGHT_RECORDER_DIR")
+    if d:
+        flight_recorder.arm(d)
+    return flight_recorder.armed
 
 
 class Counters:

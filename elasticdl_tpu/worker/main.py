@@ -34,6 +34,14 @@ def main():
 
 
 def _run(args):
+    from elasticdl_tpu.utils import profiling
+
+    # tracing identity: every span id / postmortem header from this
+    # process names the worker; the flight recorder arms only from the
+    # env (worker pods own no durable directory — the operator points
+    # EDL_FLIGHT_RECORDER_DIR at one) (docs/observability.md)
+    profiling.spans.set_process("worker-%d" % args.worker_id)
+    profiling.maybe_arm_flight_recorder()
     wire_dtype = getattr(args, "wire_dtype", "")
     stub = (
         MasterClient(

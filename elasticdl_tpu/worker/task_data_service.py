@@ -44,6 +44,7 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.data.data_reader import create_data_reader
 from elasticdl_tpu.data.dataset import Dataset, create_dataset_from_tasks
 from elasticdl_tpu.data.input_stats import InputPlaneStats
+from elasticdl_tpu.utils import profiling
 
 _ABANDON_MSG = "round abandoned (spare park)"
 _SENTINEL = object()
@@ -377,7 +378,10 @@ class TaskDataService:
                 if not self._ack_queue:
                     return
                 task_id, err_msg, counters = self._ack_queue.popleft()
-            with self.stats.timed("ack_s"):
+            with profiling.span(
+                "task/ack",
+                trace_id=(counters or {}).get(TaskExecCounterKey.TRACE_ID),
+            ), self.stats.timed("ack_s"):
                 self._worker.report_task_result(
                     task_id, err_msg, exec_counters=counters
                 )
@@ -410,7 +414,10 @@ class TaskDataService:
         # double-report them, and the RPC no longer serializes the
         # fetcher/requeue paths behind a master round trip
         for task_id, msg, counters in outbox:
-            with self.stats.timed("ack_s"):
+            with profiling.span(
+                "task/ack",
+                trace_id=(counters or {}).get(TaskExecCounterKey.TRACE_ID),
+            ), self.stats.timed("ack_s"):
                 self._worker.report_task_result(
                     task_id, msg, exec_counters=counters
                 )
@@ -545,9 +552,9 @@ class TaskDataService:
         trace_id = (getattr(task, "extended_config", None) or {}).get(
             "trace_id", "untraced"
         )
-        with annotate("edl/task/%s/warm" % trace_id), self.stats.timed(
-            "read_s"
-        ):
+        with annotate("edl/task/%s/warm" % trace_id), profiling.span(
+            "task/warm", trace_id=trace_id, records=warm
+        ), self.stats.timed("read_s"):
             for _ in range(max(0, warm)):
                 rec = next(it, _SENTINEL)
                 if rec is _SENTINEL:
@@ -624,7 +631,9 @@ class TaskDataService:
             with self._ledger_lock:
                 task, self._primed_task = self._primed_task, None
             if task is None:
-                with self.stats.timed("task_starved_s"):
+                with profiling.span("task/wait"), self.stats.timed(
+                    "task_starved_s"
+                ):
                     task = self._worker.get_task()
             if self._round_id != gen_id:
                 # the round was abandoned (spare park) while this
@@ -662,7 +671,9 @@ class TaskDataService:
         fetcher.start()
         try:
             while True:
-                with self.stats.timed("task_starved_s"):
+                with profiling.span("task/wait"), self.stats.timed(
+                    "task_starved_s"
+                ):
                     item = fetcher.next_item()
                 if item is None:
                     return  # round shut down under us

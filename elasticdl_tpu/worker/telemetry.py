@@ -130,6 +130,13 @@ class WorkerTelemetry:
         hit_rate = self._hot_row_hit_rate()
         if hit_rate is not None:
             snap["hot_row_hit_rate"] = round(hit_rate, 4)
+        shipped_spans = profiling.spans.drain_pending()
+        if shipped_spans:
+            # span records are JSON-safe by construction (SpanLog
+            # coerces fields at finish), so they ride the snapshot
+            # as-is; the master's JobTelemetry ingests them into its
+            # own SpanLog for the /trace export
+            snap["spans"] = shipped_spans
         shipped = profiling.events.drain_pending()
         if shipped:
             # the wire codec json.dumps's the header with no default=,
@@ -174,8 +181,9 @@ class WorkerTelemetry:
             return True
         except Exception:
             # the snapshot's rates are recomputed next interval, but the
-            # drained events exist nowhere else — put them back
+            # drained events/spans exist nowhere else — put them back
             profiling.events.requeue(snap.get("events"))
+            profiling.spans.requeue(snap.get("spans"))
             from elasticdl_tpu.common.log_utils import (
                 default_logger as logger,
             )

@@ -58,6 +58,7 @@ from elasticdl_tpu.training.step import (
     make_forward_fn,
     make_grad_fn,
 )
+from elasticdl_tpu.utils import profiling
 from elasticdl_tpu.utils.profiling import annotate
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -284,6 +285,10 @@ class Worker:
         """
         if self._dense_local:
             return
+        with profiling.span("step/pull_model"):
+            return self._pull_model(version, method)
+
+    def _pull_model(self, version, method):
         if self._ps_client is not None:
             initialized, got_version, named = self._ps_client.pull_dense()
             if not initialized and self._params is not None:
@@ -428,11 +433,12 @@ class Worker:
         if plane is None and not hasattr(self._ps_client, "drain"):
             return
         try:
-            accepted, _ = (
-                plane.drain()
-                if plane is not None
-                else self._ps_client.drain()
-            )
+            with profiling.span("task/push_drain"):
+                accepted, _ = (
+                    plane.drain()
+                    if plane is not None
+                    else self._ps_client.drain()
+                )
         except RuntimeError as err:
             # a PS failure surfacing HERE (a boundary, not a minibatch)
             # means an already-reported batch's gradient was lost on
@@ -627,10 +633,20 @@ class Worker:
                 exc_info=True,
             )
             return
+        # the background pull's span carries the CURRENT task's trace
+        # (the lookahead batch almost always belongs to the same task;
+        # at worst the span lands one trace early — documented)
+        cur = self._task_data_service.get_current_task()
+        trace_id = (
+            (cur.extended_config or {}).get("trace_id")
+            if cur is not None
+            else None
+        )
         self._emb_pipeline.submit(
             features,
             lookups,
             lambda lookups=lookups: self._pull_embedding_rows(lookups),
+            trace_id=trace_id,
         )
 
     def _prepare_embedding_batch(self, features):
@@ -644,16 +660,21 @@ class Worker:
         rejection — which WANTS fresh rows — or an invalidated round)
         the pull runs inline.
         """
-        pre = (
-            self._emb_pipeline.consume(features)
-            if self._emb_pipeline is not None
-            else None
-        )
-        if pre is not None:
-            lookups, pulled = pre
-        else:
-            lookups = self._plan_embedding_lookups(features)
-            pulled = self._pull_embedding_rows(lookups)
+        with profiling.span("step/embedding_pull") as sp:
+            pre = (
+                self._emb_pipeline.consume(features)
+                if self._emb_pipeline is not None
+                else None
+            )
+            if pre is not None:
+                # the wait here is the TAIL of the overlapped round
+                # trip; the fan-out itself shows as the pipeline
+                # thread's step/embedding_pull_bg span
+                sp.add(pipelined=True)
+                lookups, pulled = pre
+            else:
+                lookups = self._plan_embedding_lookups(features)
+                pulled = self._pull_embedding_rows(lookups)
         rows_by_path, idx_by_path, plan = {}, {}, {}
         for path, (unique, idxs, bucket) in lookups.items():
             rows_by_path[path] = self._sparse_plane.scatter(
@@ -687,18 +708,29 @@ class Worker:
             jax.random.PRNGKey(self._seed * 100003 + self._worker_id),
             self._step_count,
         )
-        if self._embedding_dims:
-            rows, idx, plan = self._prepare_embedding_batch(features)
-            loss, grads, row_grads, new_state, _ = self._emb_grad_fn(
-                self._params, rows, self._state, idx, features, labels, rng
+        # step/compute = the host-blocking side of the jitted step:
+        # embedding prep (which nests step/embedding_pull) + the grad
+        # dispatch. The async device work that outlives the dispatch
+        # materializes in step/grad_push, where its results are forced
+        # onto the wire (docs/observability.md attribution note).
+        with profiling.span("step/compute"):
+            if self._embedding_dims:
+                rows, idx, plan = self._prepare_embedding_batch(features)
+                loss, grads, row_grads, new_state, _ = self._emb_grad_fn(
+                    self._params, rows, self._state, idx, features,
+                    labels, rng,
+                )
+                self._state = new_state
+                return (
+                    loss,
+                    grads,
+                    self._sparse_grad_tensors(row_grads, plan),
+                )
+            loss, grads, new_state, _ = self._grad_fn(
+                self._params, self._state, features, labels, rng
             )
             self._state = new_state
-            return loss, grads, self._sparse_grad_tensors(row_grads, plan)
-        loss, grads, new_state, _ = self._grad_fn(
-            self._params, self._state, features, labels, rng
-        )
-        self._state = new_state
-        return loss, grads, None
+            return loss, grads, None
 
     def forward_process(self, features):
         if self._embedding_dims:
@@ -716,19 +748,22 @@ class Worker:
             # dense gradients apply to the local replica immediately.
             accepted, version = True, -1
             if sparse_grads:
-                accepted, version = self._sparse_plane.push(
-                    sparse_grads, max(self._model_version, 0)
-                )
+                with profiling.span("step/grad_push", sparse=True):
+                    accepted, version = self._sparse_plane.push(
+                        sparse_grads, max(self._model_version, 0)
+                    )
             if version is not None and version >= 0:
                 # the version a rejection reports feeds the retry's
                 # next push; accepted pushes advance the SSP clock
                 self._model_version = max(self._model_version, version)
             if accepted:
-                self._apply_local_dense(grads)
+                with profiling.span("step/local_update"):
+                    self._apply_local_dense(grads)
             return accepted, self._model_version, loss
-        accepted, min_model_version = self.report_gradient(
-            grads, sparse_grads
-        )
+        with profiling.span("step/grad_push"):
+            accepted, min_model_version = self.report_gradient(
+                grads, sparse_grads
+            )
         if accepted and self._get_model_steps > 1:
             self._non_embed_grads = grads
         return accepted, min_model_version, loss
@@ -767,7 +802,11 @@ class Worker:
         train_with_local_model=False,
     ):
         if not self._var_created or self._params is None:
-            self._run_model_call_before_training(features)
+            # first-batch variable creation (init pass + report) is
+            # seconds on a cold backend; without its own span the first
+            # step's critical-path attribution would blame nothing
+            with profiling.span("step/var_init"):
+                self._run_model_call_before_training(features)
         for _ in range(self._max_minibatch_retry_num):
             if task_type == TaskType.EVALUATION:
                 if min_model_version == -1:
@@ -1011,11 +1050,22 @@ class Worker:
                 batch_count = self._batch_count(dataset_batch)
                 # the dispatcher's task trace id labels the train span,
                 # so profiler timelines join pull/prefetch/decode/train
-                # across processes (docs/observability.md)
+                # across processes (docs/observability.md). The "step"
+                # span is the per-minibatch trace root the critical-path
+                # breakdown (tools/tracetool.py) decomposes; its
+                # children (pull_model/compute/grad_push/...) inherit
+                # trace and parent from the thread-local context.
                 trace_id = (task.extended_config or {}).get(
                     "trace_id", "untraced"
                 )
-                with annotate("edl/task/%s/train" % trace_id):
+                with annotate(
+                    "edl/task/%s/train" % trace_id
+                ), profiling.span(
+                    "step",
+                    trace_id=trace_id,
+                    task=getattr(task, "task_id", None),
+                    examples=batch_count,
+                ):
                     err_msg = self._process_minibatch_and_report(
                         dataset_batch,
                         task.type,

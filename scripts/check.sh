@@ -86,6 +86,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_elastic_pipeline.py \
     tests/test_compile_plane.py \
     tests/test_telemetry.py \
+    tests/test_tracing.py \
     tests/test_locktrace.py \
     tests/test_edlint.py \
     tests/test_wire.py \

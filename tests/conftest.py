@@ -65,6 +65,7 @@ _LOCKTRACE_SUITES = {
     "test_compile_plane",
     "test_locktrace",
     "test_telemetry",
+    "test_tracing",
     "test_wire",
     "test_dense_sharding",
     "test_comm_plane",

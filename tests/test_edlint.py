@@ -580,6 +580,45 @@ def test_r7_flags_telemetry_registry_calls_in_traced_code(tmp_path):
     assert not good
 
 
+def test_r7_flags_span_emission_in_traced_code(tmp_path):
+    """Opening a tracing span inside jit-reachable scope is a finding:
+    the span would time the TRACE (once, at compile) rather than the
+    step, then silently never record again (docs/observability.md)."""
+    bad = _lint(
+        tmp_path,
+        "import jax\n"
+        "from elasticdl_tpu.utils import profiling\n"
+        "def step(ts, batch):\n"
+        "    with profiling.span('step/compute'):\n"
+        "        return ts\n"
+        "jax.jit(step)\n",
+    )
+    assert _rules_of(bad) == ["R7"]
+    assert "records telemetry" in bad[0].message
+    bad_begin = _lint(
+        tmp_path,
+        "import jax\n"
+        "from elasticdl_tpu.utils.profiling import spans\n"
+        "def step(ts, batch):\n"
+        "    spans.begin('step/compute')\n"
+        "    return ts\n"
+        "jax.jit(step)\n",
+    )
+    assert _rules_of(bad_begin) == ["R7"]
+    # the intended idiom: the span wraps the DISPATCH, outside trace
+    good = _lint(
+        tmp_path,
+        "import jax\n"
+        "from elasticdl_tpu.utils import profiling\n"
+        "def step(ts, batch):\n"
+        "    return ts\n"
+        "def drive(ts, batch):\n"
+        "    with profiling.span('step/compute'):\n"
+        "        return jax.jit(step)(ts, batch)\n",
+    )
+    assert not good
+
+
 def test_r7_sees_decorator_and_shard_map_forms(tmp_path):
     bad = _lint(
         tmp_path,

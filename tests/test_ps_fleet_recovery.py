@@ -187,7 +187,7 @@ def test_sigkill_with_durability_restores_and_reconnects(tmp_path):
     # only shard 0 serves the (per-pod) telemetry endpoint in this
     # test; a shared port would fail the second shard's bind
     extras = [
-        extra + ["--telemetry_port", str(tport)],
+        extra + ["--ps_telemetry_port", str(tport)],
         extra,
     ]
     ports = [free_port(), free_port()]
