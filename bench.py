@@ -1345,10 +1345,62 @@ def _stop_ps_fleet(procs):
         err.close()
 
 
+# Every bench-launched fleet process (PS shards, scorers) boots through
+# a `python -c` snippet containing this exact line — the marker the
+# stale-process reaper keys on.
+_FLEET_BOOT_MARKER = "bench._force_cpu_backend()"
+
+
+def _reap_stale_fleet():
+    """SIGKILL leaked fleet processes from aborted earlier drives.
+
+    The PR-9 caution, made automatic: a PS (or scorer) process orphaned
+    by an aborted manual drive keeps its port and its CPU share and
+    silently poisons later bench arms' measurements. Every
+    bench-launched fleet child carries the boot-code marker in its -c
+    argv and a parent-death watchdog; this pre-run guard catches the
+    cases the watchdog cannot (a re-parented child whose new ancestor
+    lives on). Matching is strictly on the marker — test-launched
+    ``ps.main`` processes and anything else are never touched. Shared
+    by every fleet-driving arm (--ps, --hybrid, --chaos, --serve)."""
+    import signal
+
+    me = os.getpid()
+    reaped = []
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return reaped  # no /proc (non-linux): nothing to do
+    for pid_s in pids:
+        pid = int(pid_s)
+        if pid == me:
+            continue
+        try:
+            with open("/proc/%d/cmdline" % pid, "rb") as f:
+                cmdline = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        if _FLEET_BOOT_MARKER not in cmdline:
+            continue
+        try:
+            os.kill(pid, signal.SIGKILL)
+            reaped.append(pid)
+        except (ProcessLookupError, PermissionError):
+            continue
+    if reaped:
+        print(
+            "reaped %d stale fleet process(es) from an earlier "
+            "aborted drive: %s" % (len(reaped), reaped),
+            file=sys.stderr,
+        )
+    return reaped
+
+
 def _bench_ps_impl(quick=False):
     import tempfile
 
     _force_cpu_backend()
+    _reap_stale_fleet()
 
     from elasticdl_tpu.common.constants import JobType
     from elasticdl_tpu.master.checkpoint_service import CheckpointService
@@ -1693,6 +1745,7 @@ def _bench_chaos_impl(quick=False):
     import threading
 
     _force_cpu_backend()
+    _reap_stale_fleet()
 
     from elasticdl_tpu.common.constants import JobType
     from elasticdl_tpu.master.checkpoint_service import CheckpointService
@@ -2468,6 +2521,7 @@ def _bench_hybrid_impl(quick=False):
     import tempfile
 
     _force_cpu_backend()
+    _reap_stale_fleet()
 
     from elasticdl_tpu.common.constants import JobType
     from elasticdl_tpu.master.checkpoint_service import CheckpointService
@@ -2578,6 +2632,435 @@ def _bench_hybrid_impl(quick=False):
                 )
             finally:
                 stop_fleet(procs)
+    return results
+
+
+def _scorer_boot_code():
+    """Scorer-pod bootstrap: CPU-forced + parent-death watchdog (the
+    same discipline as _ps_fleet_boot_code, marker included so the
+    stale-fleet reaper covers scorers too)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return (
+        "import os, sys, threading, time\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "bench._force_cpu_backend()\n"
+        "_parent = os.getppid()\n"
+        "def _watch():\n"
+        "    while os.getppid() == _parent:\n"
+        "        time.sleep(1.0)\n"
+        "    os._exit(0)\n"
+        "threading.Thread(target=_watch, daemon=True).start()\n"
+        "from elasticdl_tpu.serving.main import main\n"
+        "sys.exit(main())\n"
+    ) % here
+
+
+def bench_serve(quick=False):
+    """The serving plane's gate (docs/serving.md): a 2-process scorer
+    fleet answering sustained score traffic from the live export
+    stream + PS-resident embeddings WHILE an in-process streaming
+    trainer churns versions, with a mid-bench PS shard SIGKILL +
+    relaunch. Gated (explicit rc-1 in main): p99 latency, the
+    staleness bound (no served row older than the configured window,
+    scraped via each scorer's /metrics), at least one hot swap under
+    churn, and post-recovery health."""
+    return _bench_serve_impl(quick)
+
+
+def _bench_serve_impl(quick=False):
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    _force_cpu_backend()
+    _reap_stale_fleet()
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.rpc.core import Client
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
+    batch = 32
+    staleness_window = 4
+    export_every = 8
+    n_scorers = 2
+    drive_s = 15.0 if quick else 40.0
+    snapshot_every = 2
+
+    def powerlaw_batch(rng, pool, weights, n=batch):
+        return {
+            "feature": rng.choice(pool, size=(n, 10), p=weights).astype(
+                np.int64
+            )
+        }
+
+    def powerlaw_file(n, tmp, rng, pool, weights):
+        from elasticdl_tpu.data.example import encode_example
+        from elasticdl_tpu.data.recordio import RecordIOWriter
+
+        path = os.path.join(tmp, "serve_powerlaw_%d.edlr" % n)
+        with RecordIOWriter(path) as f:
+            for _ in range(n):
+                f.write(
+                    encode_example(
+                        {
+                            "feature": rng.choice(
+                                pool, size=(10,), p=weights
+                            ).astype(np.int64),
+                            "label": np.array(
+                                [rng.integers(2)], dtype=np.int64
+                            ),
+                        }
+                    )
+                )
+        return path
+
+    def scrape_metrics(port):
+        with urllib.request.urlopen(
+            "http://localhost:%d/metrics" % port, timeout=10
+        ) as resp:
+            text = resp.read().decode("utf-8")
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            name, _, value = line.rpartition(" ")
+            try:
+                out[name] = float(value)
+            except ValueError:
+                continue
+        return out
+
+    rng = np.random.default_rng(11)
+    pool = rng.permutation(5383)[:64]
+    weights = 1.0 / np.arange(1, 65) ** 1.1
+    weights /= weights.sum()
+
+    results = {
+        "staleness_window": staleness_window,
+        "n_scorers": n_scorers,
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        data = powerlaw_file(batch * 8, tmp, rng, pool, weights)
+        export_root = os.path.join(tmp, "exports")
+        os.makedirs(export_root)
+        snap_dir = os.path.join(tmp, "snap")
+        procs, addrs, cmds, env = _launch_ps_fleet_ex(
+            tmp,
+            MODEL_ZOO_PATH,
+            model_def,
+            "serve",
+            extra_args=[
+                "--ps_snapshot_versions", str(snapshot_every),
+                "--ps_snapshot_dir", snap_dir,
+            ],
+        )
+        scorer_procs = []
+        clients = []
+        ps_client = None
+        task_d = None
+        stop_drive = threading.Event()
+        trainer_done = threading.Event()
+        trainer_err = []
+        try:
+            # -- the streaming trainer (in-process thread) --------------
+            task_d = TaskDispatcher(
+                {data: (0, batch * 8)}, {}, {}, batch * 2, 1,
+                streaming=True,
+            )
+            master = MasterServicer(
+                1,
+                batch,
+                None,
+                task_d,
+                checkpoint_service=CheckpointService("", 0, 0, False),
+                use_async=True,
+            )
+            ps_client = PSClient(
+                [BoundPS(a, deadline_s=20.0, retries=3) for a in addrs]
+            )
+            worker = Worker(
+                worker_id=1,
+                job_type=JobType.TRAINING_ONLY,
+                minibatch_size=batch,
+                model_zoo=MODEL_ZOO_PATH,
+                model_def=model_def,
+                model_params=model_params,
+                ps_client=ps_client,
+                get_model_steps=4,
+                export_dir=export_root,
+                export_every_versions=export_every,
+                export_keep=4,
+            )
+            worker._stub = InProcessMaster(master)
+
+            def train():
+                try:
+                    worker.run()
+                except Exception as err:  # noqa: BLE001 — surfaced below
+                    trainer_err.append(err)
+                finally:
+                    trainer_done.set()
+
+            t_train = threading.Thread(
+                target=train, daemon=True, name="serve-trainer"
+            )
+            t_train.start()
+
+            # -- the scorer fleet (real OS processes) -------------------
+            ports, tports = [], []
+            for _ in range(n_scorers):
+                for bucket in (ports, tports):
+                    s = socket.socket()
+                    s.bind(("localhost", 0))
+                    bucket.append(s.getsockname()[1])
+                    s.close()
+            boot = _scorer_boot_code()
+            for i in range(n_scorers):
+                err = open(
+                    os.path.join(tmp, "scorer-%d.err" % i), "ab"
+                )
+                scorer_procs.append(
+                    (
+                        subprocess.Popen(
+                            [
+                                sys.executable, "-c", boot,
+                                "--scorer_id", str(i),
+                                "--export_dir", export_root,
+                                "--ps_addrs", ",".join(addrs),
+                                "--port", str(ports[i]),
+                                "--scorer_telemetry_port",
+                                str(tports[i]),
+                                "--serving_staleness_versions",
+                                str(staleness_window),
+                                "--serving_sync_interval_s", "0.25",
+                                "--watch_interval_s", "0.5",
+                            ],
+                            env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=err,
+                        ),
+                        err,
+                    )
+                )
+            clients = [
+                Client("localhost:%d" % p, deadline_s=60.0)
+                for p in ports
+            ]
+            # scorers answer status immediately; score needs the
+            # trainer's FIRST export (worker jit + export cadence)
+            deadline = time.time() + 420
+            first_versions = []
+            for i, client in enumerate(clients):
+                while True:
+                    if trainer_err:
+                        raise trainer_err[0]
+                    proc, errf = scorer_procs[i]
+                    if proc.poll() is not None:
+                        errf.flush()
+                        raise RuntimeError(
+                            "scorer %d exited rc=%d at boot: %s"
+                            % (
+                                i,
+                                proc.returncode,
+                                open(errf.name, "rb").read()[-1500:],
+                            )
+                        )
+                    import grpc
+
+                    try:
+                        status = client.call("scorer_status")
+                        if int(status.get("model_version", -1)) >= 0:
+                            first_versions.append(
+                                int(status["model_version"])
+                            )
+                            break
+                    except grpc.RpcError:
+                        pass  # still booting: the deadline bounds this
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "scorer %d never loaded a model (no "
+                            "export arrived?)" % i
+                        )
+                    time.sleep(0.5)
+
+            # -- warm the request path (first request pays the jit) ----
+            for client in clients:
+                for _ in range(3):
+                    reply = client.call(
+                        "score", **powerlaw_batch(rng, pool, weights)
+                    )
+                    if "error" in reply:
+                        raise RuntimeError(
+                            "warm score failed: %s" % reply["error"]
+                        )
+
+            # -- sustained drive + mid-bench shard kill ----------------
+            records = []  # (t_mono, ok, latency_s)
+            records_mu = threading.Lock()
+
+            def drive(idx):
+                drng = np.random.default_rng(100 + idx)
+                client = clients[idx]
+                while not stop_drive.is_set():
+                    feats = powerlaw_batch(drng, pool, weights)
+                    # record the request's START: a request ISSUED
+                    # during the outage may return its failure long
+                    # after recovery (the scorer's deadline+retry
+                    # budget), and classifying by completion would
+                    # blame a healthy post-recovery plane for it
+                    t_issued = time.monotonic()
+                    t0 = time.perf_counter()
+                    try:
+                        reply = client.call("score", **feats)
+                        ok = "error" not in reply
+                    except Exception:  # noqa: BLE001 — outage window
+                        ok = False
+                    dt = time.perf_counter() - t0
+                    with records_mu:
+                        records.append((t_issued, ok, dt))
+
+            drivers = [
+                threading.Thread(
+                    target=drive, args=(i,), daemon=True,
+                    name="serve-drive-%d" % i,
+                )
+                for i in range(n_scorers)
+            ]
+            t_start = time.monotonic()
+            for d in drivers:
+                d.start()
+            # SIGKILL shard 0 mid-drive, relaunch same argv/port (the
+            # LocalInstanceManager contract) — snapshots restore it
+            time.sleep(drive_s * 0.4)
+            kill_t = time.monotonic()
+            proc0, err0 = procs[0]
+            proc0.kill()
+            proc0.wait(timeout=10)
+            time.sleep(1.0)
+            procs[0] = (
+                subprocess.Popen(
+                    cmds[0],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=err0,
+                ),
+                err0,
+            )
+            port0 = int(addrs[0].rsplit(":", 1)[1])
+            _wait_ps_port(procs[0][0], err0, port0, time.time() + 90)
+            recovered_t = time.monotonic()
+            time.sleep(max(0.0, drive_s - (time.monotonic() - t_start)))
+            stop_drive.set()
+            for d in drivers:
+                d.join(timeout=30)
+
+            # -- post-drive probes -------------------------------------
+            final_versions, staleness, hit_rates = [], [], []
+            post_ok = 0
+            for i, client in enumerate(clients):
+                reply = client.call(
+                    "score", **powerlaw_batch(rng, pool, weights)
+                )
+                if "error" not in reply:
+                    post_ok += 1
+                status = client.call("scorer_status")
+                final_versions.append(
+                    int(status.get("model_version", -1))
+                )
+                metrics = scrape_metrics(tports[i])
+                staleness.append(
+                    metrics.get(
+                        "edl_scorer_row_staleness_versions", -1.0
+                    )
+                )
+                hit_rates.append(
+                    metrics.get("edl_scorer_hot_row_hit_rate", 0.0)
+                )
+
+            # -- wind the stream down ----------------------------------
+            task_d.set_streaming(False)
+            if not trainer_done.wait(timeout=300):
+                raise RuntimeError(
+                    "streaming trainer did not drain after "
+                    "set_streaming(False)"
+                )
+            if trainer_err:
+                raise trainer_err[0]
+
+            with records_mu:
+                done = list(records)
+            oks = [r for r in done if r[1]]
+            lat = sorted(r[2] for r in oks)
+            outage_grace = (recovered_t - kill_t) + 5.0
+            bad_outside = [
+                r
+                for r in done
+                if not r[1]
+                and not (kill_t - 1.0 <= r[0] <= kill_t + outage_grace)
+            ]
+            measured_s = max(
+                1e-9,
+                (max(r[0] for r in done) - t_start) if done else 0.0,
+            )
+            results.update(
+                {
+                    "qps": len(oks) / measured_s,
+                    "requests_ok": len(oks),
+                    "requests_failed": len(done) - len(oks),
+                    "failures_outside_outage": len(bad_outside),
+                    "p50_ms": 1e3 * lat[len(lat) // 2] if lat else -1.0,
+                    "p99_ms": (
+                        1e3 * lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+                        if lat
+                        else -1.0
+                    ),
+                    "first_versions": first_versions,
+                    "final_versions": final_versions,
+                    "staleness": staleness,
+                    "hit_rates": hit_rates,
+                    "post_recovery_scores_ok": post_ok,
+                    "outage_s": recovered_t - kill_t,
+                    "drive_s": drive_s,
+                }
+            )
+        finally:
+            stop_drive.set()
+            if task_d is not None:
+                task_d.set_streaming(False)
+            for client in clients:
+                try:
+                    client.close()
+                except Exception as err:  # noqa: BLE001 — teardown
+                    print(
+                        "scorer client close failed: %s" % err,
+                        file=sys.stderr,
+                    )
+            for proc, err in scorer_procs:
+                proc.terminate()
+            for proc, err in scorer_procs:
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001 — teardown
+                    proc.kill()
+                err.close()
+            trainer_done.wait(timeout=60)
+            if ps_client is not None:
+                ps_client.close()
+            _stop_ps_fleet(procs)
     return results
 
 
@@ -4642,6 +5125,99 @@ def main(argv=None):
         )
         return 0
 
+    if "--serve" in argv:
+        res = bench_serve(quick)
+        problems = []
+        try:
+            p99_gate_ms = float(
+                os.environ.get("EDL_BENCH_SERVE_P99_MS", "2000")
+            )
+        except ValueError:
+            p99_gate_ms = 2000.0
+        window = res["staleness_window"]
+        if res.get("requests_ok", 0) <= 0:
+            problems.append("no score request succeeded")
+        if not (0 < res.get("p99_ms", -1.0) < p99_gate_ms):
+            problems.append(
+                "p99 latency %.0f ms outside the <%.0f ms gate "
+                "(p50 %.0f ms)"
+                % (
+                    res.get("p99_ms", -1.0),
+                    p99_gate_ms,
+                    res.get("p50_ms", -1.0),
+                )
+            )
+        for i, lag in enumerate(res.get("staleness", [])):
+            if not 0 <= lag <= window:
+                problems.append(
+                    "scorer %d staleness gauge %.1f outside "
+                    "[0, %d] after the PS shard kill+restore "
+                    "(missing gauge = -1)" % (i, lag, window)
+                )
+        for i, (first, final) in enumerate(
+            zip(res.get("first_versions", []), res.get("final_versions", []))
+        ):
+            if final <= first:
+                problems.append(
+                    "scorer %d never hot-swapped under live churn "
+                    "(model_version %d -> %d)" % (i, first, final)
+                )
+        if res.get("failures_outside_outage", 0):
+            problems.append(
+                "%d request(s) failed OUTSIDE the shard-kill outage "
+                "window" % res["failures_outside_outage"]
+            )
+        if res.get("post_recovery_scores_ok", 0) < res["n_scorers"]:
+            problems.append(
+                "only %d/%d scorers answered after the shard "
+                "relaunch"
+                % (
+                    res.get("post_recovery_scores_ok", 0),
+                    res["n_scorers"],
+                )
+            )
+        if problems:
+            print(
+                json.dumps(
+                    {
+                        "metric": "serving_scorer_qps",
+                        "error": "; ".join(problems),
+                        "detail": res,
+                    }
+                )
+            )
+            return 1
+        _emit(
+            "serving_scorer_qps",
+            round(res["qps"], 1),
+            "score requests/sec (batch 32) sustained by a %d-process "
+            "scorer fleet under LIVE streaming training churn "
+            "(train->export->serve loop, docs/serving.md): p50 %.0f "
+            "ms, p99 %.0f ms (gate <%.0f ms), %d ok / %d failed over "
+            "%.0f s, every scorer hot-swapped (v%s -> v%s), served-row "
+            "staleness %s <= %d-version window scraped via /metrics "
+            "AFTER a mid-bench PS shard SIGKILL+snapshot-relaunch "
+            "(outage %.1f s; failures confined to it), cache hit "
+            "rates %s"
+            % (
+                res["n_scorers"],
+                res["p50_ms"],
+                res["p99_ms"],
+                p99_gate_ms,
+                res["requests_ok"],
+                res["requests_failed"],
+                res["drive_s"],
+                res["first_versions"],
+                res["final_versions"],
+                [round(s, 1) for s in res["staleness"]],
+                window,
+                res["outage_s"],
+                [round(h, 3) for h in res["hit_rates"]],
+            ),
+            update,
+        )
+        return 0
+
     if "--wire" in argv:
         res = bench_wire(quick)
         _emit(
@@ -5088,6 +5664,10 @@ def main(argv=None):
     # snapshot-staleness bound, master-kill accounting exactly-once
     # with the final state inside the fault-free noise floor
     section("ps_chaos_recovery_divergence", ["--chaos"], 750)
+    # the serving-plane gate: a 2-process scorer fleet under live
+    # streaming training churn, p99 + staleness-bound + hot-swap +
+    # shard-kill-recovery gates (docs/serving.md)
+    section("serving_scorer_qps", ["--serve"], 600)
     # device sections, cheapest diagnosis first (each shrinks its
     # workload and renames its metric _cpu when the backend is plain
     # CPU, so the suite fits the budget without an accelerator)

@@ -237,6 +237,16 @@ def add_train_params(parser):
     )
     add_bool_param(
         parser,
+        "--streaming_tasks",
+        False,
+        "Treat the training data as an unbounded stream: the task "
+        "dispatcher rolls a fresh epoch over the shards whenever the "
+        "todo queue drains, ignoring --num_epochs, until the job is "
+        "stopped — the train half of the train->export->serve loop "
+        "(docs/serving.md)",
+    )
+    add_bool_param(
+        parser,
         "--use_async",
         False,
         "Apply gradients asynchronously (host-PS mode only; the ALLREDUCE "
@@ -383,6 +393,31 @@ def add_common_args_between_master_and_worker(parser):
         help="Compress f32 model pulls and gradient pushes to this "
         "dtype on the wire (PS-mode hot path); receivers upcast back "
         "to f32 before any optimizer math",
+    )
+    parser.add_argument(
+        "--export_dir",
+        default="",
+        help="Streaming serving exports (docs/serving.md): the worker "
+        "writes a complete export artifact (common/export.py, "
+        "MANIFEST.json last) under this directory every "
+        "--export_every_versions model versions, for the scorer "
+        "fleet's ModelDirectoryWatcher to hot-swap in. Distinct from "
+        "--output, the end-of-job SAVE_MODEL export",
+    )
+    parser.add_argument(
+        "--export_every_versions",
+        type=non_neg_int,
+        default=0,
+        help="Export the dense graph every this many model versions "
+        "when --export_dir is set; 0 disables the cadence",
+    )
+    parser.add_argument(
+        "--export_keep",
+        type=pos_int,
+        default=4,
+        help="Versioned export artifacts to retain under --export_dir "
+        "(oldest pruned after each export; scorers mid-load of a "
+        "pruned artifact retry on the next watcher poll)",
     )
     parser.add_argument(
         "--hot_row_cache_rows",
@@ -697,6 +732,109 @@ def parse_worker_args(worker_args=None):
     )
     add_common_args_between_master_and_worker(parser)
     args, unknown = parser.parse_known_args(args=worker_args)
+    return args
+
+
+def parse_scorer_args(scorer_args=None):
+    """The serving plane's scorer process (elasticdl_tpu/serving/main):
+    one scorer pod of the fleet answering inference traffic from the
+    latest export artifact + PS-resident embeddings (docs/serving.md).
+    """
+    parser = argparse.ArgumentParser(description="ElasticDL TPU Scorer")
+    parser.add_argument("--scorer_id", type=int, default=0)
+    parser.add_argument(
+        "--export_dir",
+        required=True,
+        help="Export root the trainer's streaming cadence writes "
+        "versioned artifacts under; the scorer watches it and "
+        "hot-swaps to the newest MANIFEST.json",
+    )
+    parser.add_argument(
+        "--ps_addrs",
+        default="",
+        help="Comma-separated PS shard addresses serving the elastic "
+        "embedding tables read-through; empty for dense-only models",
+    )
+    parser.add_argument(
+        "--port",
+        type=non_neg_int,
+        default=0,
+        help="Scorer RPC port (0 binds ephemeral)",
+    )
+    parser.add_argument(
+        "--scorer_telemetry_port",
+        type=int,
+        default=-1,
+        help="Serve this scorer's /metrics + /healthz + /events + "
+        "/trace on this port (0 = ephemeral, -1 disables) — the "
+        "request-latency histogram, staleness gauge, and cache hit "
+        "rate the serving gates scrape (docs/serving.md)",
+    )
+    parser.add_argument(
+        "--serving_staleness_versions",
+        type=pos_int,
+        default=2,
+        help="Freshness bound: a served embedding row is never more "
+        "than this many shard versions behind the newest version this "
+        "scorer has seen — the hot-row cache window, kept cheap by "
+        "the delta sync (docs/serving.md)",
+    )
+    parser.add_argument(
+        "--serving_sync_interval_s",
+        type=float,
+        default=0.5,
+        help="Delta-sync poll cadence against each PS shard's "
+        "serving_status; backs off with capped doubling while the "
+        "fleet is unreachable",
+    )
+    parser.add_argument(
+        "--hot_row_cache_rows",
+        type=pos_int,
+        default=65536,
+        help="Read-through hot-row cache capacity (rows) shared by "
+        "the request path and the delta sync",
+    )
+    parser.add_argument(
+        "--watch_interval_s",
+        type=float,
+        default=1.0,
+        help="Export-directory poll cadence for new model versions",
+    )
+    parser.add_argument(
+        "--model_zoo",
+        default="",
+        help="Override the artifact metadata's model_zoo path when "
+        "the trainer's path is not valid on this host",
+    )
+    parser.add_argument(
+        "--rpc_deadline_s",
+        type=float,
+        default=20.0,
+        help="Deadline per PS data-plane RPC on the scorer's pull "
+        "path (0 disables)",
+    )
+    parser.add_argument(
+        "--rpc_retries",
+        type=non_neg_int,
+        default=3,
+        help="Bounded UNAVAILABLE retries (doubling backoff) on the "
+        "scorer's idempotent pull path — the PR-12 failover posture "
+        "scaled to a data plane (docs/serving.md)",
+    )
+    parser.add_argument(
+        "--ps_shm",
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="Shared-memory payload transport toward co-located PS "
+        "shards (docs/wire.md), same negotiation/fallback as the "
+        "worker's flag",
+    )
+    parser.add_argument(
+        "--log_level",
+        default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
+    )
+    args, unknown = parser.parse_known_args(args=scorer_args)
     return args
 
 

@@ -41,6 +41,7 @@ def _make_task_dispatcher(
     num_epochs,
     data_reader_params=None,
     journal=None,
+    streaming=False,
 ):
     """Reference master.py:38-65."""
 
@@ -62,6 +63,7 @@ def _make_task_dispatcher(
         records_per_task,
         num_epochs,
         journal=journal,
+        streaming=streaming,
     )
 
 
@@ -151,6 +153,9 @@ class Master:
                 getattr(args, "data_reader_params", "")
             ),
             journal=self.journal,
+            # --streaming_tasks: the unbounded train half of the
+            # train->export->serve loop (docs/serving.md)
+            streaming=bool(getattr(args, "streaming_tasks", False)),
         )
 
         model_module = load_module(

@@ -208,6 +208,76 @@ class HotRowCache:
                 self._latest.pop(shard, None)
             return len(victims)
 
+    def invalidate_table(self, name, below_version=None):
+        """Drop ``name``'s entries tagged below ``below_version``
+        (every entry when None), touching NOTHING else — no other
+        table's rows, no shard version clock.
+
+        The delta-sync fallback (docs/serving.md): when a table's
+        delta answer is incomplete (the PS pruned past the scorer's
+        sync point), only THAT table's potentially-moved rows may be
+        dropped — ``invalidate_shard`` would evict every co-sharded
+        table's hot rows and re-anchor the clock for a failure mode
+        that is not a relaunch. ``below_version`` compares against
+        entry tags from whichever shard wrote them: version clocks are
+        per-shard, so cross-shard comparison can only over-drop (a
+        cache miss), never under-drop. Returns the entry count
+        dropped."""
+        with self._mu:
+            victims = [
+                key
+                for key, (_, version, _) in self._rows.items()
+                if key[0] == name
+                and (below_version is None or version < below_version)
+            ]
+            for key in victims:
+                del self._rows[key]
+            return len(victims)
+
+    def refresh_table(self, name, shard, version, changed_ids, since):
+        """Apply one table's delta from ``shard``: entries whose id is
+        in ``changed_ids`` (or whose tag predates ``since``, the
+        delta's lower bound — the log knows nothing about them) drop;
+        every other entry of (``name``, ``shard``) is provably
+        unchanged through ``version`` and is re-tagged fresh. Also
+        advances the shard's version clock. Returns
+        ``(dropped_ids, retagged_count)`` — the dropped ids let the
+        delta sync re-pull exactly the hot rows that moved
+        (docs/serving.md)."""
+        changed = {int(i) for i in changed_ids}
+        dropped, retagged = [], 0
+        with self._mu:
+            for key in list(self._rows):
+                entry_shard, entry_version, row = self._rows[key]
+                if key[0] != name or entry_shard != shard:
+                    continue
+                if key[1] in changed or entry_version < since:
+                    del self._rows[key]
+                    dropped.append(key[1])
+                else:
+                    self._rows[key] = (shard, version, row)
+                    retagged += 1
+            if version > self._latest.get(shard, -1):
+                self._latest[shard] = version
+        return dropped, retagged
+
+    def max_live_lag(self):
+        """Worst-case staleness (in shard versions) any cache HIT could
+        currently serve: the max over entries of
+        ``latest_seen(shard) - entry_version``, counting only entries
+        inside the window (anything beyond it would miss and drop at
+        probe time, so it cannot be served). This is the serving
+        plane's ``edl_scorer_row_staleness_versions`` gauge — by
+        construction it never exceeds the configured window
+        (docs/serving.md freshness contract)."""
+        with self._mu:
+            worst = 0
+            for (_, _), (shard, version, _) in self._rows.items():
+                lag = self._latest.get(shard, -1) - version
+                if 0 < lag <= self._window and lag > worst:
+                    worst = lag
+            return worst
+
     def get(self, name, row_id):
         """The cached row, or None on miss/stale (stale entries drop)."""
         with self._mu:

@@ -1,0 +1,117 @@
+"""Per-table embedding update log: the serving plane's freshness feed.
+
+A scorer fleet (elasticdl_tpu/serving/) keeps a read-through
+``HotRowCache`` warm from the live PS fleet. Without a delta feed, every
+shard version advance ages EVERY cached entry of that shard, so under
+continuous training the whole cache churns even though a power-law
+workload rewrites only the head rows each step. This log records, per
+embedding table, WHICH row ids each optimizer version touched, so the
+``serving_status``/``pull_embedding_delta`` RPC pair (ps/servicer.py)
+can answer "what moved since version S" and the scorer refreshes or
+drops exactly those rows — everything else is provably unchanged and
+gets re-tagged fresh (docs/serving.md).
+
+Bounded on purpose: at most ``keep_versions`` version entries and
+``max_rows`` recorded ids per table; answering below the pruned floor
+returns ``complete=False`` and the scorer falls back to a
+whole-table-below-version invalidation (``HotRowCache.invalidate_table``)
+instead of trusting a partial answer.
+
+Thread model: ``note`` runs on the servicer's apply path (sync under
+the gradient lock, async from any handler thread) and the read methods
+run on RPC handler threads — every access rides one internal lock, and
+nothing here does IO or blocks (edlint R5/R8).
+"""
+
+import threading
+from collections import deque
+
+import numpy as np
+
+
+class DeltaLog:
+    def __init__(self, base_version=0, keep_versions=1024, max_rows=1 << 20):
+        if keep_versions <= 0:
+            raise ValueError("keep_versions must be positive")
+        self._keep = int(keep_versions)
+        self._max_rows = int(max_rows)
+        self._base = int(base_version)
+        self._mu = threading.Lock()
+        # table -> deque[(version, ids int64 ndarray)] oldest-first
+        self._entries = {}
+        self._rows = {}  # table -> total ids retained
+        # table -> oldest since-version answerable completely: a
+        # ``since(table, S)`` with S >= floor has lost nothing to
+        # pruning (boot = base_version: everything earlier predates
+        # this incarnation's tracking)
+        self._floor = {}
+        self._last = {}  # table -> newest version with a recorded update
+
+    def note(self, table, ids, version):
+        """Record that ``ids`` of ``table`` were (re)written at
+        ``version``. Empty updates are dropped."""
+        # copy, not view: async applies hand over gradient indices that
+        # are zero-copy views into a wire buffer (possibly a shm slot
+        # the client recycles right after the reply) — a retained view
+        # here could tear (docs/wire.md retention discipline)
+        ids = np.array(ids, dtype=np.int64, copy=True).reshape(-1)
+        if ids.size == 0:
+            return
+        version = int(version)
+        with self._mu:
+            q = self._entries.setdefault(table, deque())
+            self._floor.setdefault(table, self._base)
+            q.append((version, ids))
+            self._rows[table] = self._rows.get(table, 0) + ids.size
+            if version > self._last.get(table, -1):
+                self._last[table] = version
+            while len(q) > self._keep or self._rows[table] > self._max_rows:
+                old_version, old_ids = q.popleft()
+                self._rows[table] -= old_ids.size
+                # everything at or below the dropped version is now
+                # unanswerable: since(S) needs every entry > S retained
+                if old_version > self._floor[table]:
+                    self._floor[table] = old_version
+
+    def since(self, table, since_version):
+        """(unique ids updated after ``since_version``, covered_version,
+        complete).
+
+        ``covered_version`` is the newest update version the answer
+        covers (== ``since_version`` when nothing moved). ``complete``
+        is False when ``since_version`` predates the retained window —
+        the caller must treat the whole table as potentially moved."""
+        since_version = int(since_version)
+        with self._mu:
+            q = self._entries.get(table)
+            floor = self._floor.get(table, self._base)
+            last = self._last.get(table, -1)
+            if since_version < floor:
+                return (
+                    np.zeros((0,), np.int64),
+                    max(last, since_version),
+                    False,
+                )
+            if not q:
+                return np.zeros((0,), np.int64), since_version, True
+            chunks = [ids for v, ids in q if v > since_version]
+        if not chunks:
+            return np.zeros((0,), np.int64), since_version, True
+        return (
+            np.unique(np.concatenate(chunks)),
+            max(last, since_version),
+            True,
+        )
+
+    def table_versions(self):
+        """{table: newest version with a recorded update} — the
+        per-table advance signal ``serving_status`` publishes."""
+        with self._mu:
+            return dict(self._last)
+
+    def floors(self):
+        """{table: oldest completely answerable since-version}."""
+        with self._mu:
+            return {
+                t: self._floor.get(t, self._base) for t in self._entries
+            }

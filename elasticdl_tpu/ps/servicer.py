@@ -66,6 +66,16 @@ class PserverServicer:
         self._restored_version = (
             -1 if restored_version is None else int(restored_version)
         )
+        # serving plane (docs/serving.md): record which embedding rows
+        # each optimizer version touched so scorers can sync their
+        # read-through caches by delta instead of re-aging every entry
+        # on every version advance. base = whatever version this boot
+        # serves from: rows older than that are this incarnation's
+        # restored state, which the scorer's epoch-change invalidation
+        # already covers (docs/ps_recovery.md).
+        from elasticdl_tpu.ps.delta_log import DeltaLog
+
+        self._delta = DeltaLog(base_version=parameters.version)
 
     @property
     def shard_epoch(self):
@@ -235,7 +245,19 @@ class PserverServicer:
                         dense_grads=dense,
                         embedding_grads=self._indexed_sum,
                     )
-                    self._parameters.version += 1
+                    # note BEFORE the version bump becomes visible:
+                    # serving_status reads version + delta unlocked,
+                    # and advertising a version whose update is not in
+                    # the log yet would let a scorer re-tag rows that
+                    # version rewrote as provably-unchanged. The safe
+                    # direction is the reverse (tables may run AHEAD of
+                    # version — an early delta only re-pulls sooner).
+                    # The accumulated tensors are .combined(): indices
+                    # are already one-per-unique-row.
+                    new_version = self._parameters.version + 1
+                    for name, t in self._indexed_sum.items():
+                        self._delta.note(name, t.indices, new_version)
+                    self._parameters.version = new_version
                 self._dense_sum.clear()
                 self._indexed_sum.clear()
                 self._grad_n = 0
@@ -266,7 +288,19 @@ class PserverServicer:
                 dense_grads=dense, embedding_grads=sparse
             )
             with self._version_lock:
-                self._parameters.version += 1
+                # rows are written (apply above) and the delta is noted
+                # BEFORE the new version becomes visible: serving_status
+                # must never advertise a version whose update the log
+                # does not carry yet, or a scorer re-tags rows that
+                # version rewrote as provably-unchanged. Over-advertising
+                # the table (note lands, bump not yet visible) is safe —
+                # the scorer just pulls the delta one poll early. The
+                # optimizer combines duplicate ids at apply; the log
+                # dedups at read time either way.
+                new_version = self._parameters.version + 1
+                for name, t in sparse.items():
+                    self._delta.note(name, t.indices, new_version)
+                self._parameters.version = new_version
         self._maybe_snapshot()
 
     def ps_status(self, req):
@@ -288,6 +322,65 @@ class PserverServicer:
             ),
         })
 
+    # -- serving-plane RPCs (docs/serving.md) -------------------------------
+
+    def serving_status(self, req):
+        """Per-table freshness advertisement for the scorer fleet.
+
+        Read-only and idempotent (edlint R9): scorers poll it to learn
+        (a) this incarnation's identity (``shard_epoch`` rides every
+        reply — a change triggers the PR-10 shard-selective cache
+        invalidation), (b) the shard's current optimizer version, and
+        (c) per NON-SLOT embedding table, the newest version that
+        touched it (``tables``) plus the oldest since-version the delta
+        log can still answer completely (``floors``). A table with no
+        recorded update since boot reports the boot/base version —
+        sound, because a materialized row only ever changes through a
+        noted apply (lazy init happens at first pull, before any cache
+        copy exists)."""
+        # version FIRST, delta state after: with the apply paths noting
+        # updates before their version bump becomes visible, this read
+        # order guarantees tables[] covers every update the advertised
+        # version includes (tables may run ahead — harmlessly early)
+        version = self._parameters.version
+        last = self._delta.table_versions()
+        floors = self._delta.floors()
+        base = self._restored_version if self._restored_version >= 0 else 0
+        tables = {}
+        table_floors = {}
+        for name, table in list(self._parameters.embedding_params.items()):
+            if table.is_slot:
+                continue  # optimizer state, never served
+            tables[name] = int(last.get(name, base))
+            table_floors[name] = int(floors.get(name, base))
+        return self._reply({
+            "version": version,
+            "initialized": bool(self._parameters.initialized),
+            "tables": tables,
+            "floors": table_floors,
+        })
+
+    def pull_embedding_delta(self, req):
+        """Row ids of ``req['name']`` updated after
+        ``req['since_version']`` (docs/serving.md).
+
+        Read-only and idempotent (edlint R9) — the reply is computed
+        fresh from the delta log, so replaying it is harmless and the
+        scorer's capped-backoff retry policy may resend it freely.
+        ``complete=False`` means ``since_version`` predates the
+        retained window; the scorer must fall back to
+        ``HotRowCache.invalidate_table`` instead of trusting a partial
+        id list. ``version`` is the newest update version the answer
+        covers — the scorer's next ``since_version``."""
+        name = req["name"]
+        since = int(req.get("since_version", -1))
+        ids, covered, complete = self._delta.since(name, since)
+        return self._reply({
+            "ids": ids,
+            "version": int(covered),
+            "complete": bool(complete),
+        })
+
     # -- rpc.core wiring ----------------------------------------------------
 
     def rpc_methods(self):
@@ -307,6 +400,8 @@ class PserverServicer:
                 "push_embedding_info": self.push_embedding_info,
                 "push_gradient": self.push_gradient,
                 "ps_status": self.ps_status,
+                "serving_status": self.serving_status,
+                "pull_embedding_delta": self.pull_embedding_delta,
             },
             role="ps",
         )

@@ -1,0 +1,22 @@
+"""The serving plane (docs/serving.md): a scorer fleet answering
+inference traffic from the latest exported dense graph plus embeddings
+served read-through from the live PS fleet, freshness bounded by
+version-tagged deltas — the serve third of the streaming
+train -> export -> serve loop."""
+
+from elasticdl_tpu.serving.delta_sync import EmbeddingDeltaSync
+from elasticdl_tpu.serving.scorer import (
+    ModelDirectoryWatcher,
+    Scorer,
+    ScorerModel,
+)
+from elasticdl_tpu.serving.server import ScorerServer, ScorerServicer
+
+__all__ = [
+    "EmbeddingDeltaSync",
+    "ModelDirectoryWatcher",
+    "Scorer",
+    "ScorerModel",
+    "ScorerServer",
+    "ScorerServicer",
+]

@@ -1,0 +1,238 @@
+"""Version-tagged delta sync: keeps a scorer's hot-row cache fresh.
+
+The freshness half of the serving plane (docs/serving.md). A scorer
+serves embedding rows read-through from the live PS fleet via the
+plane-shared :class:`~elasticdl_tpu.nn.comm_plane.HotRowCache`, whose
+window mechanically bounds every HIT to at most
+``--serving_staleness_versions`` shard versions behind the newest
+version this process has seen. Without a delta feed that bound is
+enforced by ATTRITION: every version advance ages every cached entry of
+the shard, so under continuous training the whole cache churns each
+window — a permanent miss storm on exactly the power-law head rows the
+cache exists for. This sync loop turns the bound into cheap bookkeeping:
+
+- poll each shard's ``serving_status`` (per-table newest update
+  version + this incarnation's ``shard_epoch``),
+- for tables that advanced, ``pull_embedding_delta`` names exactly the
+  row ids that moved; :meth:`HotRowCache.refresh_table` drops the
+  cached copies of THOSE rows (optionally re-pulling them hot) and
+  re-tags every other entry fresh — rows the PS proves unchanged never
+  churn,
+- tables that did NOT advance re-tag wholesale (a recorded-update-free
+  interval is a proof of no movement: lazy init happens before any
+  cache copy exists, and every apply is noted),
+- an incomplete delta (the shard pruned past our sync point) falls
+  back to :meth:`HotRowCache.invalidate_table` — only that table's
+  stale rows drop, never the co-sharded tables' (the PR-15 cache fix),
+- a changed ``shard_epoch`` means the shard relaunched: the PSClient
+  reconnect protocol (docs/ps_recovery.md) already invalidated the
+  shard's entries inside ``serving_status``'s reply handling; the sync
+  just re-baselines.
+
+Retry discipline (the PR-12 failover posture, scaled to a data plane):
+both RPCs are idempotent reads (edlint R9), so the scorer's channel may
+retry them freely — the process entry builds its ``BoundPS`` channels
+with a finite deadline and bounded UNAVAILABLE retries — and the sync
+loop itself backs off with capped doubling while a whole round fails,
+so a dead fleet costs a bounded poll rate, not a spin.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+
+
+class EmbeddingDeltaSync:
+    """Background per-shard delta poller over one PSClient + cache.
+
+    ``ps_client``: a :class:`~elasticdl_tpu.worker.ps_client.PSClient`
+    whose ``serving_status``/``pull_embedding_delta`` wrappers ride the
+    reconnect protocol. ``cache``: the scorer's shared
+    :class:`HotRowCache` (usually the same instance the client pulls
+    through). ``refresh_rows`` re-pulls dropped-but-hot rows in the
+    same sync round so head rows stay resident across updates.
+    """
+
+    def __init__(
+        self,
+        ps_client,
+        cache,
+        interval_s=0.5,
+        max_interval_s=8.0,
+        refresh_rows=True,
+    ):
+        self._client = ps_client
+        self._cache = cache
+        self._interval = float(interval_s)
+        self._max_interval = max(float(max_interval_s), self._interval)
+        self._refresh_rows = bool(refresh_rows)
+        self._mu = threading.Lock()
+        self._synced = {}  # shard -> {table: newest reflected update version}
+        self._epochs = {}  # shard -> last seen shard_epoch
+        self._stop = threading.Event()
+        self._thread = None
+        # observability (scraped via the scorer's collector too)
+        self.rounds = 0
+        self.rows_dropped = 0
+        self.rows_retagged = 0
+        self.rows_refreshed = 0
+        self.tables_invalidated = 0
+        self.last_error = None
+
+    # -- one synchronous round (tests drive this directly) ------------------
+
+    def sync_once(self):
+        """Sync every shard once; returns {shards_ok, shards_failed}.
+
+        Public on purpose (tests and a one-shot warmer drive it), so it
+        is concurrent with the background loop by edlint R8's model —
+        every mutable field it touches rides ``_mu``."""
+        ok = failed = 0
+        for shard in range(self._client.num_ps):
+            try:
+                self._sync_shard(shard)
+                ok += 1
+            except Exception as err:  # noqa: BLE001 — counted, backoff
+                failed += 1
+                with self._mu:
+                    self.last_error = str(err)
+                logger.debug(
+                    "delta sync of shard %d failed (will retry on the "
+                    "backed-off cadence): %s",
+                    shard,
+                    err,
+                )
+        with self._mu:
+            self.rounds += 1
+        return {"shards_ok": ok, "shards_failed": failed}
+
+    def _sync_point(self, shard, epoch, table):
+        """Read (and baseline) one table's sync point under the lock;
+        an epoch change re-baselines the whole shard first — the
+        reconnect protocol (PSClient._note_shard_reply inside
+        ``serving_status``) already ran the PR-10 shard-selective cache
+        invalidation, and the dead incarnation's version clock means
+        nothing to the restored one."""
+        with self._mu:
+            if self._epochs.get(shard) != epoch:
+                self._epochs[shard] = epoch
+                self._synced[shard] = {}
+            return self._synced.setdefault(shard, {}).get(table)
+
+    def _set_sync_point(self, shard, table, version):
+        with self._mu:
+            self._synced.setdefault(shard, {})[table] = int(version)
+
+    def _count(self, **deltas):
+        with self._mu:
+            for field, n in deltas.items():
+                setattr(self, field, getattr(self, field) + n)
+
+    def _sync_shard(self, shard):
+        status = self._client.serving_status(shard)
+        epoch = status.get("shard_epoch")
+        shard_version = int(status.get("version", -1))
+        for table, last in status["tables"].items():
+            prev = self._sync_point(shard, epoch, table)
+            if prev is None:
+                # baseline: entries cached before this point carry
+                # pull-time tags; refresh_table's drop-below-since rule
+                # retires any the next delta cannot vouch for
+                prev = int(last)
+                self._set_sync_point(shard, table, prev)
+            changed = np.zeros((0,), np.int64)
+            covered = prev
+            if int(last) > prev:
+                ids, covered, complete = self._client.pull_embedding_delta(
+                    shard, table, prev
+                )
+                if not complete:
+                    # the shard pruned past our sync point: everything
+                    # this table cached below its newest update version
+                    # is suspect — drop ONLY this table's stale rows
+                    dropped = self._cache.invalidate_table(
+                        table, below_version=covered
+                    )
+                    self._count(
+                        tables_invalidated=1, rows_dropped=dropped
+                    )
+                    self._set_sync_point(shard, table, covered)
+                    continue
+                changed = ids
+            # re-tag up to the SHARD version, not just the table's
+            # newest update: ``last`` is the newest version that
+            # touched this table, so its rows are provably unchanged
+            # through shard_version >= last — without this, a quiet
+            # table's entries would age out on the other tables'
+            # version advances (the miss storm the delta feed exists
+            # to prevent)
+            dropped_ids, retagged = self._cache.refresh_table(
+                table,
+                shard,
+                max(shard_version, int(covered)),
+                changed,
+                since=prev,
+            )
+            self._count(
+                rows_dropped=len(dropped_ids), rows_retagged=retagged
+            )
+            self._set_sync_point(shard, table, covered)
+            if self._refresh_rows and dropped_ids:
+                # the dropped rows were HOT (cached); re-pull them in
+                # this round so the next request hits — the pull path
+                # re-inserts them tagged with its reply version
+                self._client.pull_embedding_vectors(
+                    table, np.asarray(dropped_ids, dtype=np.int64)
+                )
+                self._count(rows_refreshed=len(dropped_ids))
+        # advance the cache's aging clock from the poll too: with the
+        # live entries just re-tagged, aging against the real shard
+        # version keeps the staleness bound honest even while no
+        # request-path pull is observing versions
+        if shard_version >= 0 and self._cache is not None:
+            self._cache.note_version(shard, shard_version)
+
+    def synced_versions(self):
+        """{shard: {table: version}} snapshot (tests/telemetry)."""
+        with self._mu:
+            return {s: dict(t) for s, t in self._synced.items()}
+
+    # -- the background loop -------------------------------------------------
+
+    def start(self):
+        with self._mu:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="edl-delta-sync"
+            )
+            self._thread.start()
+
+    def _run(self):
+        interval = self._interval
+        while not self._stop.wait(interval):
+            try:
+                result = self.sync_once()
+            except Exception:  # noqa: BLE001 — loop must survive
+                logger.warning("delta sync round failed", exc_info=True)
+                result = {"shards_ok": 0}
+            if result.get("shards_ok"):
+                interval = self._interval
+            else:
+                # capped doubling while the whole fleet is unreachable
+                # (the PR-12 posture: ride the outage out, bounded)
+                interval = min(interval * 2.0, self._max_interval)
+
+    def stop(self):
+        self._stop.set()
+        with self._mu:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def staleness_gauge(self):
+        """Scrape-time staleness reading for the scorer's collector."""
+        return self._cache.max_live_lag() if self._cache is not None else 0

@@ -1,0 +1,647 @@
+"""The scorer: inference over exported dense graphs + live embeddings.
+
+The serving plane's worker (docs/serving.md). One scorer process
+answers inference requests from:
+
+- the **latest exported dense graph** — an export artifact
+  (common/export.py, loaded through its ``MANIFEST.json``), either the
+  source-free ``serving_fn.jaxexport`` plane or the model rebuilt from
+  the manifest's provenance metadata, jitted ONCE per model_version,
+- **embeddings served read-through from the PS fleet** via the shared
+  :class:`~elasticdl_tpu.nn.comm_plane.CommPlane` +
+  :class:`~elasticdl_tpu.nn.comm_plane.HotRowCache`, kept fresh by
+  :class:`~elasticdl_tpu.serving.delta_sync.EmbeddingDeltaSync` so a
+  served row is never more than ``--serving_staleness_versions`` shard
+  versions behind.
+
+Hot swap: :class:`ModelDirectoryWatcher` notices a new export version,
+loads AND WARMS it off the request path (the jit compile happens on the
+watcher thread against the last request's feature shapes), then
+:meth:`Scorer.install` flips the double buffer — new requests route to
+the new executable immediately, requests already in flight finish on
+the version they started with, and the old model object drops once its
+in-flight count drains to zero.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.utils import profiling
+
+
+# Rebuilt models + their jitted forwards, shared ACROSS artifact
+# versions: a streaming trainer exports the same model config every
+# cadence point, and a fresh jit per version would recompile an
+# identical executable on every hot swap — the params are call
+# ARGUMENTS, not baked constants, so one traced callable serves every
+# version of one provenance. Keyed by (model_zoo, model_def,
+# model_params); a handful of entries per process, never pruned.
+_REBUILD_CACHE = {}
+_REBUILD_MU = threading.Lock()
+
+
+class ScorerModel:
+    """One export artifact, loaded and ready to score.
+
+    Dense-only models serve through the artifact's serialized
+    ``serving_fn`` when present (source-free), else through a jitted
+    forward of the model rebuilt from ``metadata['model_def']``.
+    Elastic-embedding models always rebuild (their lookup leaves the
+    graph by design) and score through the same hoisted-lookup path the
+    trainer uses: capture ids -> dedup plan -> read-through pull ->
+    static-bucket scatter -> jitted apply (docs/embedding_planes.md).
+    The rebuilt module and its jitted forward are shared across
+    versions of the same provenance (see ``_REBUILD_CACHE``), so a hot
+    swap costs one params load — never a recompile.
+    """
+
+    def __init__(self, export_dir, model_zoo=None):
+        from elasticdl_tpu.common.export import load_export
+
+        self.export_dir = os.path.abspath(export_dir)
+        self.exported = load_export(export_dir)
+        self.version = int(self.exported.version)
+        self._model_zoo = model_zoo
+        self._mu = threading.Lock()
+        self._prepared = False
+        self._model = None
+        self._forward = None
+        self._emb_forward = None
+        self._embedding_dims = {}  # {path_tuple: dim}
+        self._embedding_initializers = {}
+        self._num_calls = 0
+        self._plan_lookup_multi = None
+
+    @property
+    def params(self):
+        return self.exported.params
+
+    @property
+    def embedding_tables(self):
+        """{table_name: (dim, initializer)} after :meth:`prepare` —
+        what an uninitialized-relaunch re-push needs (docs/serving.md).
+        """
+        from elasticdl_tpu.nn.embedding import path_name
+
+        return {
+            path_name(path): (
+                dim,
+                self._embedding_initializers.get(path, "uniform"),
+            )
+            for path, dim in self._embedding_dims.items()
+        }
+
+    def _rebuild(self):
+        """Build the model object from the manifest's provenance."""
+        from elasticdl_tpu.common.model_utils import get_model_spec
+
+        meta = self.exported.metadata
+        model_def = meta.get("model_def")
+        if not model_def:
+            raise ValueError(
+                "export at %s carries no model_def metadata and no "
+                "serving function; nothing to rebuild" % self.export_dir
+            )
+        spec = get_model_spec(
+            model_zoo=self._model_zoo or meta.get("model_zoo"),
+            model_def=model_def,
+            model_params=meta.get("model_params") or None,
+        )
+        return spec.model
+
+    def _rebuild_key(self):
+        meta = self.exported.metadata
+        return (
+            self._model_zoo or meta.get("model_zoo"),
+            meta.get("model_def"),
+            meta.get("model_params") or "",
+        )
+
+    def prepare(self, features):
+        """Discover the embedding surface + bind the jitted forward.
+
+        Lazy (the artifact does not record feature shapes); runs once
+        per ScorerModel, and the expensive half — rebuild + capture
+        discovery + jit — once per PROVENANCE: later versions of the
+        same model config bind the cached module/forward and pay only
+        their params load. Thread-safe: the watcher warms on its own
+        thread while the server may race a first request in.
+        """
+        with self._mu:
+            if self._prepared:
+                return
+            if self.exported.has_serving_fn():
+                # source-free plane: serialized StableHLO, already
+                # batch-polymorphic — no rebuild, no embedding surface
+                self._prepared = True
+                return
+            key = self._rebuild_key()
+            with _REBUILD_MU:
+                entry = _REBUILD_CACHE.get(key)
+            if entry is None:
+                entry = self._build_entry(features)
+                with _REBUILD_MU:
+                    # racing builders converge; the first stays (its
+                    # jitted callable may already hold warm traces)
+                    entry = _REBUILD_CACHE.setdefault(key, entry)
+            self._model = entry["model"]
+            self._embedding_dims = entry["embedding_dims"]
+            self._embedding_initializers = entry["embedding_initializers"]
+            self._num_calls = entry["num_calls"]
+            self._emb_forward = entry["emb_forward"]
+            self._forward = entry["forward"]
+            self._prepared = True
+
+    def _build_entry(self, features):
+        """The once-per-provenance build: rebuild the module, discover
+        the embedding surface with one capture pass, jit the forward.
+        The capture only needs the params' STRUCTURE, identical across
+        versions of one provenance."""
+        from elasticdl_tpu.nn.embedding import capture_embedding_ids
+        from elasticdl_tpu.training.step import (
+            make_embedding_forward_fn,
+            make_forward_fn,
+        )
+
+        model = self._rebuild()
+        layer_info = {}
+        captured = capture_embedding_ids(
+            model,
+            {"params": self.params},
+            features,
+            layer_info=layer_info,
+        )
+        embedding_dims = {
+            path: info[0] for path, info in layer_info.items()
+        }
+        return {
+            "model": model,
+            "embedding_dims": embedding_dims,
+            "embedding_initializers": {
+                path: info[1] for path, info in layer_info.items()
+            },
+            "num_calls": sum(len(v) for v in captured.values()),
+            "emb_forward": (
+                make_embedding_forward_fn(model)
+                if embedding_dims
+                else None
+            ),
+            "forward": (
+                make_forward_fn(model) if not embedding_dims else None
+            ),
+        }
+
+    def predict(self, features, plane=None, capture_lock=None):
+        """Score one features batch; returns the model output.
+
+        ``plane``: the CommPlane serving PS-resident tables (required
+        for elastic-embedding models). ``capture_lock``: serializes the
+        host-side flax id capture — the interceptor must not run
+        concurrently with another capture or an untraced forward
+        (worker/worker.py runs it worker-thread-only for the same
+        reason); the jitted forward itself runs outside it.
+        """
+        if not self._prepared:
+            self.prepare(features)
+        if self.exported.has_serving_fn():
+            return self.exported.serve(features)
+        if not self._embedding_dims:
+            return self._forward(self.params, {}, features)
+        if plane is None:
+            raise RuntimeError(
+                "model %s has PS-resident embedding tables; the scorer "
+                "needs a comm plane over the PS fleet to serve them"
+                % self.export_dir
+            )
+        from elasticdl_tpu.nn.embedding import (
+            build_collection,
+            call_slot_name,
+            capture_embedding_ids,
+            path_name,
+        )
+
+        lock = capture_lock if capture_lock is not None else self._mu
+        with lock:
+            captured = capture_embedding_ids(
+                self._model,
+                {"params": self.params},
+                features,
+                expected_count=self._num_calls,
+            )
+            lookups = {
+                path: plane.plan_lookup_multi(ids_list)
+                for path, ids_list in captured.items()
+            }
+        pulled = plane.pull(
+            {
+                path_name(path): unique
+                for path, (unique, _, _) in lookups.items()
+            }
+        )
+        rows_by_path, idx_by_path = {}, {}
+        for path, (unique, idxs, bucket) in lookups.items():
+            rows_by_path[path] = plane.scatter(
+                pulled[path_name(path)], bucket
+            )
+            for i, idx in enumerate(idxs):
+                idx_by_path[path + (call_slot_name(i),)] = idx
+        return self._emb_forward(
+            self.params,
+            build_collection(rows_by_path, "rows"),
+            {},
+            build_collection(idx_by_path, "idx"),
+            features,
+        )
+
+
+class Scorer:
+    """The double-buffered scoring surface over one model slot.
+
+    Owns the request path's shared machinery: the comm plane (a
+    :class:`PsPlane` over the caller's PSClient), the capture lock, the
+    in-flight ledger the hot swap drains against, and the process
+    telemetry (request-latency histogram, error counters, and a
+    scrape-time collector for the staleness gauge / cache hit rate /
+    current model version).
+    """
+
+    def __init__(
+        self,
+        ps_client=None,
+        staleness_versions=None,
+        model_zoo=None,
+    ):
+        from elasticdl_tpu.nn.comm_plane import PsPlane
+
+        self._client = ps_client
+        self._plane = PsPlane(ps_client) if ps_client is not None else None
+        self._model_zoo = model_zoo
+        self._mu = threading.Lock()
+        self._capture_mu = threading.Lock()
+        self._current = None
+        self._inflight = {}  # model_version -> in-flight request count
+        self._draining = {}  # model_version -> ScorerModel awaiting drain
+        self._drained = threading.Condition(self._mu)
+        self._features_template = None
+        self._swaps = 0
+        cache = ps_client.hot_row_cache if ps_client is not None else None
+        self._cache = cache
+        self._staleness_versions = (
+            staleness_versions
+            if staleness_versions is not None
+            else (cache._window if cache is not None else 0)
+        )
+        if ps_client is not None and hasattr(
+            ps_client, "set_on_shard_reset"
+        ):
+            # uninitialized PS relaunch (no snapshot): re-push the
+            # embedding TABLE INFOS so read-through pulls lazily re-init
+            # rows instead of erroring forever; the trainer re-pushes
+            # the authoritative state on its own schedule
+            # (docs/ps_recovery.md)
+            ps_client.set_on_shard_reset(self._on_ps_shard_reset)
+        r = profiling.metrics
+        self._h_latency = r.histogram(
+            "edl_scorer_request_latency_seconds",
+            "Scorer-observed request latency (score path, successes "
+            "only)",
+        )
+        self._c_requests = r.counter(
+            "edl_scorer_requests_total",
+            "Score requests by outcome",
+            labels=("outcome",),
+        )
+        r.register_collector(self._collect)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _collect(self):
+        """Scrape-time gauges: staleness (the serving freshness
+        contract, docs/serving.md), cache hit rate, model version."""
+        out = []
+        if self._cache is not None:
+            out.append(
+                (
+                    "edl_scorer_row_staleness_versions",
+                    {},
+                    self._cache.max_live_lag(),
+                )
+            )
+            probes = self._cache.hits + self._cache.misses
+            out.append(
+                (
+                    "edl_scorer_hot_row_hit_rate",
+                    {},
+                    (self._cache.hits / probes) if probes else 0.0,
+                )
+            )
+        with self._mu:
+            version = (
+                self._current.version if self._current is not None else -1
+            )
+            draining = len(self._draining)
+            swaps = self._swaps
+        out.append(("edl_scorer_model_version", {}, version))
+        out.append(("edl_scorer_draining_versions", {}, draining))
+        out.append(("edl_scorer_model_swaps_total", {}, swaps))
+        return out
+
+    def close(self):
+        profiling.metrics.unregister_collector(self._collect)
+
+    def _on_ps_shard_reset(self, shards):
+        model = self.model()
+        if model is None:
+            return
+        tables = model.embedding_tables
+        if not tables:
+            return
+        from elasticdl_tpu.ps.parameters import EmbeddingTableInfo
+
+        logger.warning(
+            "re-pushing embedding table infos after PS shard(s) %s "
+            "relaunched without restorable state",
+            shards,
+        )
+        self._client.push_embedding_info(
+            [
+                EmbeddingTableInfo(name, dim, init)
+                for name, (dim, init) in sorted(tables.items())
+            ]
+        )
+
+    # -- the double buffer ---------------------------------------------------
+
+    def model(self):
+        with self._mu:
+            return self._current
+
+    @property
+    def model_version(self):
+        with self._mu:
+            return (
+                self._current.version if self._current is not None else -1
+            )
+
+    def install(self, model, warm=True):
+        """Swap the serving model to ``model`` (idempotent on version).
+
+        ``warm`` pre-traces the new executable against the last
+        request's feature shapes BEFORE the flip, so no request ever
+        pays the per-version jit compile; the capture lock is held
+        through the warm forward because a first trace runs the module
+        body on the tracing thread (docs/serving.md). In-flight
+        requests keep the model they acquired; the superseded version
+        drops from the ledger when its count drains to zero.
+        """
+        with self._mu:
+            template = self._features_template
+        if warm and template is not None:
+            try:
+                with self._capture_mu:
+                    model.prepare(template)
+                model.predict(
+                    template,
+                    plane=self._plane,
+                    capture_lock=self._capture_mu,
+                )
+            except Exception:  # noqa: BLE001 — warm is best-effort
+                logger.warning(
+                    "warming export v%d failed; first request pays "
+                    "the compile",
+                    model.version,
+                    exc_info=True,
+                )
+        with self._mu:
+            old = self._current
+            if old is not None and old.version == model.version:
+                return False
+            self._current = model
+            self._swaps += 1
+            old_inflight = (
+                self._inflight.get(old.version, 0)
+                if old is not None
+                else 0
+            )
+            if old_inflight:
+                self._draining[old.version] = old
+        profiling.events.emit(
+            "scorer_model_swap",
+            version=model.version,
+            previous=old.version if old is not None else None,
+            export_dir=model.export_dir,
+        )
+        logger.info(
+            "scorer now serving model v%d (%s)%s",
+            model.version,
+            model.export_dir,
+            (
+                "; v%d draining %d in-flight request(s)"
+                % (old.version, old_inflight)
+            )
+            if old_inflight
+            else "",
+        )
+        return True
+
+    def _acquire(self):
+        with self._mu:
+            model = self._current
+            if model is None:
+                raise RuntimeError(
+                    "scorer has no model yet (no export artifact "
+                    "loaded); is the trainer exporting?"
+                )
+            self._inflight[model.version] = (
+                self._inflight.get(model.version, 0) + 1
+            )
+            return model
+
+    def _release(self, model):
+        with self._mu:
+            n = self._inflight.get(model.version, 1) - 1
+            if n > 0:
+                self._inflight[model.version] = n
+                return
+            self._inflight.pop(model.version, None)
+            drained = self._draining.pop(model.version, None)
+            self._drained.notify_all()
+        if drained is not None:
+            profiling.events.emit(
+                "scorer_version_drained", version=model.version
+            )
+
+    def inflight_versions(self):
+        """{model_version: in-flight count} snapshot (tests/status)."""
+        with self._mu:
+            return dict(self._inflight)
+
+    def wait_drained(self, version, timeout=10.0):
+        """Block until no request of ``version`` is in flight."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            while self._inflight.get(version):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._drained.wait(left)
+            return True
+
+    # -- the request path ----------------------------------------------------
+
+    def score(self, features):
+        """Score one batch -> (output, model_version)."""
+        model = self._acquire()
+        try:
+            with self._mu:
+                need_template = self._features_template is None
+            if need_template:
+                # shapes-only template for warming future versions
+                # (zeros: the values never matter, only the traced
+                # shapes/dtypes); built outside the ledger lock, racing
+                # writers converge on equivalent templates
+                import jax
+
+                template = jax.tree_util.tree_map(
+                    lambda a: np.zeros_like(np.asarray(a)), features
+                )
+                with self._mu:
+                    if self._features_template is None:
+                        self._features_template = template
+            t0 = time.perf_counter()
+            out = model.predict(
+                features, plane=self._plane, capture_lock=self._capture_mu
+            )
+            self._h_latency.observe(time.perf_counter() - t0)
+            self._c_requests.inc(outcome="ok")
+            return out, model.version
+        except Exception:
+            self._c_requests.inc(outcome="error")
+            raise
+        finally:
+            self._release(model)
+
+    def status(self):
+        cache = self._cache
+        with self._mu:
+            version = (
+                self._current.version if self._current is not None else -1
+            )
+            inflight = {str(v): n for v, n in self._inflight.items()}
+            swaps = self._swaps
+        out = {
+            "model_version": version,
+            "inflight": inflight,
+            "swaps": swaps,
+        }
+        if cache is not None:
+            out["cache_hits"] = cache.hits
+            out["cache_misses"] = cache.misses
+            out["staleness_versions"] = cache.max_live_lag()
+            out["staleness_window"] = self._staleness_versions
+        return out
+
+
+class ModelDirectoryWatcher:
+    """Polls an export root for new versioned artifacts and hot-swaps.
+
+    The trainer's streaming export cadence writes
+    ``<root>/<subdir>/MANIFEST.json`` last and atomically, so a
+    manifest's presence marks a complete artifact (docs/export.md); the
+    watcher reads every manifest's ``model_version`` cheaply, loads the
+    newest unseen one on ITS thread (orbax restore + jit warm — never
+    on a request), and installs it. A directory vanishing mid-load (the
+    trainer's retention pruning) just logs and retries next poll.
+    """
+
+    def __init__(self, export_root, scorer, interval_s=1.0, model_zoo=None):
+        self._root = os.path.abspath(export_root)
+        self._scorer = scorer
+        self._interval = float(interval_s)
+        self._model_zoo = model_zoo
+        self._stop = threading.Event()
+        self._mu = threading.Lock()
+        self._thread = None
+        self._failed = {}  # export_dir -> failure count (skip repeats)
+
+    def newest_manifest(self):
+        """(export_dir, model_version) of the newest complete artifact
+        under the root, or (None, -1)."""
+        import json
+
+        best_dir, best_version = None, -1
+        try:
+            entries = sorted(os.listdir(self._root))
+        except OSError:
+            return None, -1
+        for name in entries:
+            path = os.path.join(self._root, name)
+            manifest = os.path.join(path, "MANIFEST.json")
+            try:
+                with open(manifest) as f:
+                    version = int(json.load(f).get("model_version", -1))
+            except (OSError, ValueError):
+                continue  # incomplete/foreign/vanished — not an artifact
+            if version > best_version:
+                best_dir, best_version = path, version
+        return best_dir, best_version
+
+    def poll_once(self):
+        """Load+install the newest unseen export; returns its version
+        or None when nothing new."""
+        path, version = self.newest_manifest()
+        with self._mu:
+            # drop failure records for pruned artifacts — a long-lived
+            # scorer against an every-few-seconds export cadence must
+            # not accumulate one dead key per vanished directory
+            for stale in [
+                p for p in self._failed if not os.path.isdir(p)
+            ]:
+                del self._failed[stale]
+        if path is None or version <= self._scorer.model_version:
+            return None
+        with self._mu:
+            if self._failed.get(path, 0) >= 3:
+                return None  # poisoned artifact: stop re-loading it
+        try:
+            model = ScorerModel(path, model_zoo=self._model_zoo)
+            self._scorer.install(model)
+        except Exception:  # noqa: BLE001 — keep serving the old version
+            with self._mu:
+                self._failed[path] = self._failed.get(path, 0) + 1
+            logger.warning(
+                "loading export at %s failed; still serving v%d",
+                path,
+                self._scorer.model_version,
+                exc_info=True,
+            )
+            return None
+        return version
+
+    def start(self):
+        with self._mu:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="edl-model-watcher"
+            )
+            self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — loop must survive
+                logger.warning("model watcher poll failed", exc_info=True)
+
+    def stop(self):
+        self._stop.set()
+        with self._mu:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)

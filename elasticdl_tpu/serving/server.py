@@ -1,0 +1,135 @@
+"""The scorer's RPC surface: ``score`` + ``scorer_status``.
+
+Same transport stack as every other plane (rpc/core bytes-frame gRPC,
+no codegen): requests are dict messages whose non-underscore fields ARE
+the feature arrays, replies carry the output array(s) plus the
+``model_version`` that scored them. The shared-memory endpoint is
+always offered (rpc/shm_transport) so a co-located client's request
+payloads ride slots, and every method is instrumented with the
+``role="scorer"`` server-latency histogram (docs/observability.md).
+
+Both RPCs are idempotent reads (edlint R9): scoring mutates nothing but
+cache residency, so a client may retry a timed-out ``score`` freely —
+the serving plane's retry discipline (docs/serving.md).
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.utils import profiling
+
+
+class ScorerServicer:
+    """Dict-method servicer over one :class:`~elasticdl_tpu.serving.
+    scorer.Scorer` — served via rpc.core or called in-process."""
+
+    def __init__(self, scorer):
+        self._scorer = scorer
+
+    def score(self, req):
+        """Score the request's feature arrays.
+
+        Every non-underscore field is a feature (``_sctx`` and friends
+        are transport metadata). Replies: ``output`` (single-output
+        models) or ``out:<name>`` fields (dict outputs), plus
+        ``model_version``. Failures return ``{"error": ...}`` instead
+        of a transport error: the request was well-formed, the plane
+        is degraded (e.g. the PS fleet is mid-relaunch) — callers gate
+        on the field and retry on their own policy."""
+        features = {
+            k: np.asarray(v)
+            for k, v in req.items()
+            if not k.startswith("_")
+        }
+        if not features:
+            return {"error": "score request carried no feature arrays"}
+        try:
+            out, version = self._scorer.score(features)
+        except Exception as err:  # noqa: BLE001 — degraded, reported
+            logger.warning("score request failed: %s", err)
+            return {"error": str(err)[:500]}
+        reply = {"model_version": int(version)}
+        if isinstance(out, dict):
+            for name, value in out.items():
+                reply["out:%s" % name] = np.asarray(value)
+        else:
+            reply["output"] = np.asarray(out)
+        return reply
+
+    def scorer_status(self, req):
+        """Read-only probe: current model version, in-flight ledger,
+        cache/staleness stats (idempotent, edlint R9)."""
+        return self._scorer.status()
+
+    def rpc_methods(self):
+        return profiling.instrument_service_methods(
+            {
+                "score": self.score,
+                "scorer_status": self.scorer_status,
+            },
+            role="scorer",
+        )
+
+
+class ScorerServer:
+    """One scorer process's serving stack: RPC + shm + telemetry.
+
+    ``port=0`` binds an ephemeral RPC port (exposed as ``.port``).
+    ``telemetry_port >= 0`` serves the PR-6 ``/metrics``/``/events``/
+    ``/trace``/``/healthz`` plane (``loading`` 503 until the first
+    model installs, then ``serving``, ``draining`` through stop).
+    """
+
+    def __init__(self, scorer, port=0, telemetry_port=-1):
+        from elasticdl_tpu.rpc.core import serve
+        from elasticdl_tpu.rpc.shm_transport import install_shm_endpoint
+
+        self._scorer = scorer
+        self.servicer = ScorerServicer(scorer)
+        self._draining = threading.Event()
+        self._telemetry_http = None
+        if telemetry_port is not None and telemetry_port >= 0:
+            from elasticdl_tpu.master.telemetry import (
+                ProcessTelemetry,
+                TelemetryHTTPServer,
+            )
+
+            self._telemetry_http = TelemetryHTTPServer(
+                ProcessTelemetry(),
+                port=telemetry_port,
+                health_fn=self._health,
+            )
+            self.telemetry_port = self._telemetry_http.port
+        methods, self._shm_registry = install_shm_endpoint(
+            self.servicer.rpc_methods()
+        )
+        self._server = serve(methods, port)
+        self.port = self._server._edl_port
+        logger.info(
+            "scorer RPC server on port %d%s",
+            self.port,
+            (
+                " (telemetry on %d)" % self.telemetry_port
+                if self._telemetry_http is not None
+                else ""
+            ),
+        )
+
+    def _health(self):
+        if self._draining.is_set():
+            return "draining"
+        return "serving" if self._scorer.model_version >= 0 else "loading"
+
+    def stop(self):
+        self._draining.set()
+        if self._server is not None:
+            self._server.stop(grace=None)
+            self._server = None
+        if self._shm_registry is not None:
+            self._shm_registry.close()
+            self._shm_registry = None
+        if self._telemetry_http is not None:
+            self._telemetry_http.close()
+            self._telemetry_http = None

@@ -938,6 +938,19 @@ RPC_IDEMPOTENT = frozenset(
         # relaunch probes and the chaos harness poll it freely
         # (docs/master_recovery.md)
         "master_status",
+        # serving plane (docs/serving.md): the scorer fleet's delta
+        # feed. serving_status is a pure per-table freshness read;
+        # pull_embedding_delta computes its answer fresh from the
+        # shard's delta log on every call — both are resent freely by
+        # the scorer's capped-backoff retry policy, which NEEDS them
+        # retriable (they probe shards that may be mid-relaunch).
+        "serving_status",
+        "pull_embedding_delta",
+        # the scorer's own RPC surface (serving/server.py): scoring
+        # mutates nothing but cache residency, and scorer_status is a
+        # pure read — a client may retry a timed-out score
+        "score",
+        "scorer_status",
     )
 )
 RPC_NON_IDEMPOTENT = frozenset(

@@ -253,6 +253,13 @@ def _run(args):
             args, "telemetry_report_secs", 5.0
         ),
         embedding_plane=getattr(args, "embedding_plane", "ps"),
+        # streaming serving exports (docs/serving.md): relayed from
+        # the master's flags like every other train param
+        export_dir=getattr(args, "export_dir", "") or None,
+        export_every_versions=getattr(
+            args, "export_every_versions", 0
+        ),
+        export_keep=getattr(args, "export_keep", 4),
     )
     try:
         worker.run()

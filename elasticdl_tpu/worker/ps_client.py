@@ -296,6 +296,50 @@ class PSClient:
                 self._needs_reinit.update(shards)
             raise
 
+    # -- serving-plane reads (docs/serving.md) ------------------------------
+
+    def serving_status(self, shard):
+        """One shard's per-table freshness advertisement
+        (ps/servicer.serving_status): {version, shard_epoch, tables,
+        floors, initialized}. Rides the reconnect protocol — a changed
+        ``shard_epoch`` in the reply triggers the shard-selective cache
+        invalidation right here, so a scorer's poll loop detects a PS
+        relaunch without waiting for a data-plane pull to fail
+        (docs/ps_recovery.md)."""
+        resp = self._ps[shard].serving_status({})
+        self._note_shard_reply(shard, resp)
+        try:
+            return {
+                "version": int(resp.get("version", -1)),
+                "shard_epoch": resp.get("shard_epoch"),
+                "initialized": bool(resp.get("initialized", False)),
+                "tables": dict(resp.get("tables") or {}),
+                "floors": dict(resp.get("floors") or {}),
+            }
+        finally:
+            release_message(resp)
+
+    def pull_embedding_delta(self, shard, name, since_version):
+        """Ids of ``name``'s rows shard ``shard`` updated after
+        ``since_version`` -> (ids int64, covered_version, complete).
+        Idempotent read (edlint R9) — safe under the retriable
+        data-plane channel."""
+        resp = self._ps[shard].pull_embedding_delta(
+            {"name": name, "since_version": int(since_version)}
+        )
+        self._note_shard_reply(shard, resp)
+        try:
+            # materialize: the decoded ids are a zero-copy view into
+            # the reply buffer (possibly a recycling shm slot)
+            ids = np.array(resp["ids"], dtype=np.int64, copy=True)
+            return (
+                ids,
+                int(resp.get("version", since_version)),
+                bool(resp.get("complete", False)),
+            )
+        finally:
+            release_message(resp)
+
     @property
     def num_ps(self):
         return len(self._ps)

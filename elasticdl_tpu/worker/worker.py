@@ -91,6 +91,9 @@ class Worker:
         telemetry_report_secs=5.0,
         embedding_plane="ps",
         embedding_prefetch=None,
+        export_dir=None,
+        export_every_versions=0,
+        export_keep=4,
     ):
         self._worker_id = worker_id
         self._job_type = job_type
@@ -234,6 +237,16 @@ class Worker:
         self._local_opt = None
         self._local_opt_state = None
         self._non_embed_grads = None
+
+        # streaming export cadence (docs/serving.md): write a complete
+        # export artifact every N model versions so a scorer fleet's
+        # directory watcher can hot-swap to it — the export third of
+        # the train->export->serve loop. 0 disables (the end-of-job
+        # SAVE_MODEL task is unaffected either way).
+        self._export_dir = export_dir or None
+        self._export_every = max(0, int(export_every_versions))
+        self._export_keep = max(1, int(export_keep))
+        self._last_export_version = -1
 
         self._evaluation_result = {}
         self._task_data_service = TaskDataService(
@@ -944,6 +957,112 @@ class Worker:
         self.report_task_result(task_id, err_msg)
         self._evaluation_result = {}
 
+    def _maybe_streaming_export(self):
+        """Export the dense graph when the version cadence is due.
+
+        Runs on the worker thread between minibatches (never inside a
+        step span): drains the push window first so the exported params
+        reflect every completed push, writes the artifact under
+        ``<export_dir>/v<version>`` with the MANIFEST last (the
+        watcher's completeness marker, docs/export.md), then prunes
+        artifacts beyond ``export_keep``. Failures log and retry at the
+        next cadence point — a serving fleet losing ONE export just
+        serves the previous version a little longer."""
+        if (
+            not self._export_every
+            or self._export_dir is None
+            or self._params is None
+            or self._model_version < 0
+            or self._model_version
+            < self._last_export_version + self._export_every
+        ):
+            return
+        version = self._model_version
+        try:
+            with profiling.span("step/export", version=version):
+                self._drain_ps_pushes()
+                from elasticdl_tpu.common.export import export_model
+
+                # streaming exports are params-only artifacts (no
+                # serving_fn member): the scorer rebuilds the forward
+                # from the provenance metadata, and elastic-embedding
+                # forwards cannot serialize anyway (docs/export.md).
+                # Staged in a dot-dir (invisible to the watcher, which
+                # keys on <name>/MANIFEST.json of listed entries) and
+                # RENAMED into place: multiple workers share one
+                # export_dir and the shared version clock, so two can
+                # hit the same cadence point — in-place writes would
+                # let B rewrite an artifact A already manifest-sealed.
+                # The rename is atomic and fails on an existing
+                # non-empty target: first exporter wins, the loser
+                # discards its identical staging copy.
+                final = os.path.join(self._export_dir, "v%010d" % version)
+                staging = os.path.join(
+                    self._export_dir,
+                    ".staging-v%010d-w%s" % (version, self._worker_id),
+                )
+                export_model(
+                    staging,
+                    self._params,
+                    version,
+                    metadata=self._export_meta,
+                )
+                import shutil
+
+                try:
+                    os.rename(staging, final)
+                except OSError:
+                    # another worker exported this version first
+                    shutil.rmtree(staging, ignore_errors=True)
+            self._prune_exports()
+        except Exception:  # noqa: BLE001 — next cadence point retries
+            logger.warning(
+                "streaming export of v%d failed; retrying at the next "
+                "cadence point",
+                version,
+                exc_info=True,
+            )
+        # advance the cadence clock even on failure: a persistently
+        # failing export (full disk) must not turn into an attempt per
+        # minibatch
+        self._last_export_version = version
+
+    def _prune_exports(self):
+        """Drop the oldest complete artifacts beyond ``export_keep``."""
+        import shutil
+
+        try:
+            versions = sorted(
+                d
+                for d in os.listdir(self._export_dir)
+                if d.startswith("v")
+                and os.path.exists(
+                    os.path.join(self._export_dir, d, "MANIFEST.json")
+                )
+            )
+        except OSError:
+            return
+        for stale in versions[: -self._export_keep]:
+            shutil.rmtree(
+                os.path.join(self._export_dir, stale),
+                ignore_errors=True,
+            )
+        # crash-leaked staging dirs: a staging entry for a version
+        # BELOW the oldest retained export can only belong to a dead
+        # writer (a live one's version is at worst slightly behind the
+        # newest; the retention window deep is unreachable lag) — a
+        # loser of the rename race cleans its own staging inline
+        if versions:
+            floor = versions[0]
+            for entry in os.listdir(self._export_dir):
+                if not entry.startswith(".staging-"):
+                    continue
+                if entry.split("-")[1] < floor:
+                    shutil.rmtree(
+                        os.path.join(self._export_dir, entry),
+                        ignore_errors=True,
+                    )
+
     def _process_save_model_task_if_needed(self):
         task, dataset = (
             self._task_data_service.get_save_model_task_and_dataset()
@@ -1073,6 +1192,7 @@ class Worker:
                         train_with_local_model,
                     )
                 self._telemetry.on_batch(batch_count)
+                self._maybe_streaming_export()
                 local_update_count += 1
                 if err_msg:
                     last_training_minibatch_failed = True

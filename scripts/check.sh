@@ -95,6 +95,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_ps_snapshot.py \
     tests/test_chaos.py \
     tests/test_master_journal.py \
+    tests/test_serving.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 echo "check.sh: all gates green"

@@ -72,6 +72,7 @@ _LOCKTRACE_SUITES = {
     "test_ps_snapshot",
     "test_chaos",
     "test_master_journal",
+    "test_serving",
 }
 
 

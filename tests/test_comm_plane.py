@@ -542,6 +542,37 @@ def test_ps_plane_shares_external_cache():
     client.close()
 
 
+def test_invalidate_table_spares_unrelated_tables():
+    """The serving-plane cache fix (ISSUE 15): delta sync's
+    whole-table fallback must drop ONLY the named table's stale rows —
+    ``invalidate_shard`` was the only reset path before, and it evicts
+    every co-sharded table's hot rows plus re-anchors the shard clock
+    for what is not a relaunch."""
+    cache = HotRowCache(64, window=4)
+    for i in range(4):
+        cache.put("a", i, 0, 10 + i, np.full(2, i, np.float32))
+        cache.put("b", i, 0, 10 + i, np.full(2, 100 + i, np.float32))
+    cache.put("a", 9, 1, 3, np.zeros(2, np.float32))  # other shard
+    # version-bounded drop: only a's entries below 12 go
+    assert cache.invalidate_table("a", below_version=12) == 3  # 10,11,3
+    assert cache.get("a", 2) is not None  # tagged 12: kept
+    assert cache.get("a", 3) is not None  # tagged 13: kept
+    assert cache.get("a", 0) is None
+    assert cache.get("a", 9) is None  # cross-shard entries drop too
+    # b is untouched — every row still hittable
+    assert all(
+        r is not None for r in cache.get_rows("b", list(range(4)))
+    )
+    # and the shard version clock was NOT re-anchored: aging still
+    # works off the versions the cache had seen, per entry
+    cache.note_version(0, 16)
+    assert cache.get("b", 0) is None  # tag 10, lag 6 > window: ages out
+    assert cache.get("b", 3) is not None  # tag 13, lag 3: still fresh
+    # unbounded form drops everything left of a, and only a
+    assert cache.invalidate_table("a") == 2  # the kept 12 and 13
+    assert cache.get("b", 3) is not None
+
+
 def test_master_store_plane_pulls_per_table():
     store = {}
 

@@ -1366,6 +1366,48 @@ def test_r9_recovery_plane_rpcs_classified(tmp_path):
     assert "unclassified" in bad[0].message
 
 
+def test_r9_serving_plane_rpcs_classified(tmp_path):
+    """ISSUE 15's serving-plane RPCs carry explicit idempotency
+    decisions: ``serving_status``/``pull_embedding_delta`` (the scorer
+    fleet's delta feed — pure reads the capped-backoff retry policy
+    NEEDS retriable) and the scorer's own ``score``/``scorer_status``
+    surface; a new serving-flavored RPC without a classification stays
+    a finding."""
+    good = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class DeltaFeed:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=20.0, retries=3)\n"
+        "    def status(self):\n"
+        "        return self._client.call('serving_status')\n"
+        "    def delta(self, req):\n"
+        "        return self._client.call('pull_embedding_delta', **req)\n"
+        "class ScoreChannel:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr, deadline_s=5.0, retries=2)\n"
+        "    def score(self, req):\n"
+        "        return self._client.call('score', **req)\n"
+        "    def probe(self):\n"
+        "        return self._client.call('scorer_status')\n",
+        relpath="elasticdl_tpu/serving/feed_fixture.py",
+    )
+    assert not good
+    # a hypothetical serving RPC that skipped the classification table
+    bad = _lint(
+        tmp_path,
+        "from elasticdl_tpu.rpc.core import Client\n"
+        "class DeltaFeed:\n"
+        "    def __init__(self, addr):\n"
+        "        self._client = Client(addr)\n"
+        "    def push(self, req):\n"
+        "        return self._client.call('push_scoring_feedback', **req)\n",
+        relpath="elasticdl_tpu/serving/feed_fixture.py",
+    )
+    assert _rules_of(bad) == ["R9"], bad
+    assert "unclassified" in bad[0].message
+
+
 def test_r9_unclassified_rpc_is_a_finding(tmp_path):
     bad = _lint(
         tmp_path,

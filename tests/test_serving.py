@@ -1,0 +1,644 @@
+"""The serving plane (docs/serving.md): scorer fleet + delta sync +
+streaming train->export->serve loop.
+
+Coverage map (ISSUE 15):
+
+- the delta feed: DeltaLog floor/prune semantics, the servicer's
+  ``serving_status``/``pull_embedding_delta`` pair (in-process AND over
+  real gRPC), and the staleness bound holding under live training
+  churn — with the unrelated-table retention pin (a version advance on
+  one table must not evict the other's hot rows),
+- the scorer: end-to-end deepfm scoring read-through from in-process
+  PS shards, cache-hit determinism, hot swap draining in-flight
+  requests on the superseded version, the directory watcher's
+  newest-complete-manifest discipline,
+- the loop: the streaming task dispatcher rolling epochs until
+  stopped, and the worker's version-cadence export writing complete
+  retention-bounded artifacts,
+- the fleet: shm vs gRPC scorer parity over a real ScorerServer, and
+  a scorer surviving a real PS shard SIGKILL/relaunch with the
+  shard-selective cache invalidation (the PR-10 reconnect protocol).
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.common.export import export_model, export_provenance
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.nn.comm_plane import HotRowCache
+from elasticdl_tpu.ps.delta_log import DeltaLog
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo, Parameters
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.serving.delta_sync import EmbeddingDeltaSync
+from elasticdl_tpu.serving.scorer import (
+    ModelDirectoryWatcher,
+    Scorer,
+    ScorerModel,
+)
+from elasticdl_tpu.serving.server import ScorerServer
+from elasticdl_tpu.utils import profiling
+from elasticdl_tpu.worker.ps_client import PSClient
+from tests.test_utils import MODEL_ZOO_PATH
+
+MODEL_DEF = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+MODEL_PARAMS = "embedding_dim=8,fc_unit=8,vocab_size=100"
+
+
+def _features(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "feature": rng.integers(1, 100, size=(n, 10)).astype(np.int64)
+    }
+
+
+def _deepfm_params(seed=0):
+    import jax
+
+    from elasticdl_tpu.common.model_utils import get_model_spec
+    from elasticdl_tpu.nn.embedding import IDX_COLLECTION, ROWS_COLLECTION
+    from elasticdl_tpu.nn.model_api import init_variables, split_variables
+
+    spec = get_model_spec(
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=MODEL_DEF,
+        model_params=MODEL_PARAMS,
+    )
+    variables = init_variables(
+        spec.model, jax.random.PRNGKey(seed), _features()
+    )
+    params, state = split_variables(variables)
+    state.pop(ROWS_COLLECTION, None)
+    state.pop(IDX_COLLECTION, None)
+    return spec, params
+
+
+def _export(export_root, params, version):
+    path = os.path.join(export_root, "v%010d" % version)
+    export_model(
+        path,
+        params,
+        version,
+        metadata=export_provenance(MODEL_ZOO_PATH, MODEL_DEF, MODEL_PARAMS),
+    )
+    return path
+
+
+def _ps_shards(n=2, use_async=True):
+    shards = []
+    for _ in range(n):
+        shards.append(
+            PserverServicer(
+                Parameters(), 1, optax.sgd(0.1), use_async=use_async
+            )
+        )
+    return shards
+
+
+INFOS = [
+    EmbeddingTableInfo("embedding", 8, "uniform"),
+    EmbeddingTableInfo("id_bias", 1, "uniform"),
+]
+
+
+def _client(shards, window=2, rows=4096):
+    cache = HotRowCache(rows, window=window)
+    client = PSClient(shards, cache=cache)
+    client.push_model({}, INFOS, version=0)
+    return client, cache
+
+
+def _push_sparse(client, table, ids, dim, scale=0.1, seed=None):
+    rng = np.random.default_rng(seed)
+    grads = rng.normal(0, scale, size=(len(ids), dim)).astype(np.float32)
+    client.push_gradient(
+        {}, [Tensor(table, grads, indices=np.asarray(ids, np.int64))], 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# the delta feed
+# ---------------------------------------------------------------------------
+
+
+def test_delta_log_since_floor_and_prune():
+    log = DeltaLog(base_version=0, keep_versions=3)
+    log.note("t", [1, 2, 3], 1)
+    log.note("t", [2, 4], 2)
+    ids, covered, complete = log.since("t", 1)
+    assert complete and covered == 2
+    assert list(ids) == [2, 4]
+    ids, covered, complete = log.since("t", 0)
+    assert complete and sorted(ids) == [1, 2, 3, 4]
+    # nothing moved: empty, complete, covered == since
+    ids, covered, complete = log.since("t", 2)
+    assert complete and covered == 2 and ids.size == 0
+    log.note("t", [5], 3)
+    log.note("t", [6], 4)  # prunes the v1 entry -> floor rises to 1
+    ids, covered, complete = log.since("t", 0)
+    assert not complete and covered == 4
+    assert log.floors()["t"] == 1
+    ids, covered, complete = log.since("t", 1)
+    assert complete and sorted(ids) == [2, 4, 5, 6]
+    # an unknown table is empty-complete at or above base
+    ids, covered, complete = log.since("u", 0)
+    assert complete and ids.size == 0
+    assert log.table_versions() == {"t": 4}
+
+
+def test_refresh_table_drops_changed_retags_unchanged_dropping_stale():
+    cache = HotRowCache(64, window=2)
+    for i in range(4):
+        cache.put("t", i, 0, 10, np.full(2, i, np.float32))
+    cache.put("t", 9, 0, 5, np.full(2, 9.0, np.float32))  # below since
+    cache.put("u", 1, 0, 10, np.ones(2, np.float32))  # other table
+    dropped, retagged = cache.refresh_table(
+        "t", 0, 14, changed_ids=[1, 3], since=10
+    )
+    assert sorted(dropped) == [1, 3, 9]
+    assert retagged == 2
+    # retagged entries serve at version 14 (lag 0)
+    assert cache.get("t", 0) is not None
+    assert cache.get("t", 2) is not None
+    assert cache.get("t", 1) is None
+    # the refresh bumped the shard clock to 14; "u"'s entry (still
+    # tagged 10, lag 4 > window) now ages out — exactly why EVERY
+    # table needs its own refresh round, which the delta sync provides
+    assert cache.get("u", 1) is None
+    assert cache.max_live_lag() <= 2
+
+
+def test_servicer_serving_status_and_delta_in_process():
+    shards = _ps_shards(1)
+    client, cache = _client(shards)
+    status = client.serving_status(0)
+    assert status["initialized"]
+    assert set(status["tables"]) == {"embedding", "id_bias"}
+    base = status["tables"]["embedding"]
+    _push_sparse(client, "embedding", [3, 5, 7], 8)
+    _push_sparse(client, "embedding", [5, 9], 8)
+    status = client.serving_status(0)
+    assert status["tables"]["embedding"] == base + 2
+    # slot tables (created by the sparse applies) never advertise
+    assert set(status["tables"]) == {"embedding", "id_bias"}
+    ids, covered, complete = client.pull_embedding_delta(
+        0, "embedding", base
+    )
+    assert complete and covered == base + 2
+    assert sorted(ids) == [3, 5, 7, 9]
+    # a pruned-past sync point comes back incomplete
+    shards[0]._delta = DeltaLog(base_version=100, keep_versions=2)
+    _, _, complete = client.pull_embedding_delta(0, "embedding", 0)
+    assert not complete
+    client.close()
+
+
+def test_delta_sync_staleness_bound_and_unrelated_table_retention():
+    """The freshness contract under live churn: while table A's rows
+    are rewritten every version, (a) no serveable entry ever exceeds
+    the staleness window, and (b) table B's hot rows — untouched by
+    training — keep HITTING across many A-advances instead of aging
+    out (the miss storm the delta feed exists to prevent)."""
+    shards = _ps_shards(1)
+    scorer_client, cache = _client(shards, window=2)
+    trainer_client = PSClient(shards)  # cache-less trainer side
+    sync = EmbeddingDeltaSync(scorer_client, cache, refresh_rows=True)
+
+    # warm both tables into the scorer cache
+    a_ids = np.arange(1, 9, dtype=np.int64)
+    b_ids = np.arange(1, 9, dtype=np.int64)
+    scorer_client.pull_embedding_vectors("embedding", a_ids)
+    scorer_client.pull_embedding_vectors("id_bias", b_ids)
+    sync.sync_once()
+
+    hits_before = cache.hits
+    for round_ in range(12):
+        # churn: rewrite half of A's rows (versions advance)
+        _push_sparse(
+            trainer_client, "embedding", a_ids[round_ % 2 :: 2], 8
+        )
+        sync.sync_once()
+        assert cache.max_live_lag() <= 2
+        # B still hits without any wire pull
+        rows = cache.get_rows("id_bias", b_ids)
+        assert all(r is not None for r in rows), (
+            "unrelated table's hot rows were evicted by A's version "
+            "advances at round %d" % round_
+        )
+    assert cache.hits > hits_before
+    # the refreshed A rows serve the POST-update values: pull through
+    # the trainer (no cache) and through the cache and compare
+    fresh = trainer_client.pull_embedding_vectors("embedding", a_ids)
+    cached = scorer_client.pull_embedding_vectors("embedding", a_ids)
+    np.testing.assert_array_equal(fresh, cached)
+    scorer_client.close()
+    trainer_client.close()
+
+
+def test_invalidate_table_fallback_on_incomplete_delta():
+    shards = _ps_shards(1)
+    client, cache = _client(shards)
+    sync = EmbeddingDeltaSync(client, cache, refresh_rows=False)
+    client.pull_embedding_vectors("embedding", np.arange(1, 9))
+    client.pull_embedding_vectors("id_bias", np.arange(1, 9))
+    sync.sync_once()
+    # replace the shard's log with one that cannot answer our sync
+    # point; advance the table so the sync tries
+    _push_sparse(client, "embedding", [1, 2], 8)
+    shards[0]._delta = DeltaLog(base_version=50, keep_versions=2)
+    shards[0]._delta.note("embedding", [1, 2], 51)
+    sync.sync_once()
+    assert sync.tables_invalidated >= 1
+    # the other table survived the fallback
+    assert all(
+        r is not None
+        for r in cache.get_rows("id_bias", np.arange(1, 9))
+    )
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# the scorer
+# ---------------------------------------------------------------------------
+
+
+def test_scorer_end_to_end_and_cache_determinism(tmp_path):
+    _, params = _deepfm_params()
+    export_root = str(tmp_path / "exports")
+    os.makedirs(export_root)
+    _export(export_root, params, 0)
+    shards = _ps_shards(2)
+    client, cache = _client(shards)
+    scorer = Scorer(ps_client=client, staleness_versions=2)
+    try:
+        watcher = ModelDirectoryWatcher(export_root, scorer)
+        assert watcher.poll_once() == 0
+        feats = _features()
+        out1, v1 = scorer.score(feats)
+        assert v1 == 0 and out1["probs"].shape == (4, 1)
+        hits_before = cache.hits
+        # second score of the same batch: rows served from cache, and
+        # the output must be BITWISE identical (cache path == wire path)
+        out2, _ = scorer.score(feats)
+        assert cache.hits > hits_before
+        np.testing.assert_array_equal(
+            np.asarray(out1["logits"]), np.asarray(out2["logits"])
+        )
+        assert scorer.inflight_versions() == {}
+        status = scorer.status()
+        assert status["model_version"] == 0
+        assert status["staleness_versions"] <= status["staleness_window"]
+    finally:
+        scorer.close()
+        client.close()
+
+
+def test_hot_swap_drains_inflight_requests(tmp_path):
+    """A request in flight across an install finishes on the version
+    it acquired; new requests score the new version immediately; the
+    superseded version leaves the ledger once drained."""
+    _, params = _deepfm_params(seed=0)
+    _, params2 = _deepfm_params(seed=1)
+    export_root = str(tmp_path / "exports")
+    os.makedirs(export_root)
+    _export(export_root, params, 1)
+    shards = _ps_shards(1)
+    client, _cache = _client(shards)
+    scorer = Scorer(ps_client=client, staleness_versions=2)
+    try:
+        assert ModelDirectoryWatcher(export_root, scorer).poll_once() == 1
+        feats = _features()
+        scorer.score(feats)  # prepare v1 + record the template
+
+        v1_model = scorer.model()
+        entered = threading.Event()
+        proceed = threading.Event()
+        real_predict = v1_model.predict
+
+        def slow_predict(*a, **kw):
+            entered.set()
+            assert proceed.wait(10.0)
+            return real_predict(*a, **kw)
+
+        v1_model.predict = slow_predict
+        result = {}
+
+        def request():
+            result["out"], result["version"] = scorer.score(feats)
+
+        t = threading.Thread(target=request)
+        t.start()
+        assert entered.wait(10.0)
+        # swap to v2 while the request is parked inside v1
+        _export(export_root, params2, 2)
+        assert ModelDirectoryWatcher(export_root, scorer).poll_once() == 2
+        assert scorer.model_version == 2
+        assert scorer.inflight_versions().get(1) == 1
+        out_new, v_new = scorer.score(feats)
+        assert v_new == 2
+        proceed.set()
+        t.join(10.0)
+        assert result["version"] == 1
+        assert scorer.wait_drained(1, timeout=10.0)
+        assert 1 not in scorer.inflight_versions()
+        # different params must actually score differently (the swap
+        # was real, not a re-label)
+        assert not np.allclose(
+            np.asarray(result["out"]["logits"]),
+            np.asarray(out_new["logits"]),
+        )
+    finally:
+        scorer.close()
+        client.close()
+
+
+def test_model_watcher_newest_complete_manifest(tmp_path):
+    export_root = str(tmp_path / "exports")
+    os.makedirs(export_root)
+    _, params = _deepfm_params()
+    _export(export_root, params, 3)
+    _export(export_root, params, 12)
+    # an incomplete artifact (no manifest) must be invisible
+    os.makedirs(os.path.join(export_root, "v9999999999"))
+    # a foreign manifest-shaped file is skipped, not fatal
+    bad = os.path.join(export_root, "junk")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "MANIFEST.json"), "w") as f:
+        f.write("not json")
+    scorer = Scorer()
+    try:
+        watcher = ModelDirectoryWatcher(export_root, scorer)
+        path, version = watcher.newest_manifest()
+        assert version == 12 and path.endswith("v%010d" % 12)
+        assert watcher.poll_once() == 12
+        assert watcher.poll_once() is None  # nothing newer
+    finally:
+        scorer.close()
+
+
+# ---------------------------------------------------------------------------
+# the streaming loop
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_dispatcher_rolls_epochs_until_stopped():
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    task_d = TaskDispatcher(
+        {"f": (0, 4)}, {}, {}, 2, num_epochs=1, streaming=True
+    )
+    # one epoch is 2 tasks; pull far past it
+    seen = []
+    for _ in range(9):
+        task_id, task = task_d.get(1)
+        assert task is not None, "streaming source drained"
+        seen.append(task_id)
+        task_d.report(task_id, True)
+    task_d.set_streaming(False)
+    drained = 0
+    while True:
+        task_id, task = task_d.get(1)
+        if task is None:
+            break
+        task_d.report(task_id, True)
+        drained += 1
+    assert drained <= 2  # at most the already-open epoch's remainder
+    assert task_d.finished()
+
+
+def test_worker_streaming_export_cadence_and_retention(tmp_path):
+    """A real PS-mode worker over an in-process master exports on the
+    version cadence into complete, retention-bounded artifacts."""
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.worker import Worker
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import DatasetName, create_recordio_file
+
+    export_root = str(tmp_path / "exports")
+    f = create_recordio_file(
+        64, DatasetName.FRAPPE, 10, temp_dir=str(tmp_path)
+    )
+    task_d = TaskDispatcher({f: (0, 64)}, {}, {}, 16, 1)
+    master = MasterServicer(
+        1,
+        8,
+        None,
+        task_d,
+        checkpoint_service=CheckpointService("", 0, 0, False),
+        use_async=True,
+    )
+    shards = _ps_shards(2)
+    client = PSClient(shards)
+    worker = Worker(
+        worker_id=1,
+        job_type=JobType.TRAINING_ONLY,
+        minibatch_size=8,
+        model_zoo=MODEL_ZOO_PATH,
+        model_def=MODEL_DEF,
+        model_params=MODEL_PARAMS,
+        ps_client=client,
+        export_dir=export_root,
+        export_every_versions=2,
+        export_keep=2,
+    )
+    worker._stub = InProcessMaster(master)
+    try:
+        worker.run()
+    finally:
+        client.close()
+    assert task_d.finished()
+    exports = sorted(os.listdir(export_root))
+    assert exports, "no streaming export written"
+    assert len(exports) <= 2, "retention bound violated: %r" % exports
+    versions = []
+    for d in exports:
+        with open(
+            os.path.join(export_root, d, "MANIFEST.json")
+        ) as fh:
+            manifest = json.load(fh)
+        versions.append(manifest["model_version"])
+        assert manifest["metadata"]["model_def"] == MODEL_DEF
+    assert versions == sorted(versions)
+    # the newest artifact round-trips through the scorer loader
+    model = ScorerModel(
+        os.path.join(export_root, exports[-1]), model_zoo=MODEL_ZOO_PATH
+    )
+    assert model.version == versions[-1]
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+
+def test_scorer_server_shm_vs_grpc_parity(tmp_path):
+    """The same request through the plain bytes path and through a
+    negotiated shm ring scores identically, and scorer_status serves
+    over the wire."""
+    from elasticdl_tpu.rpc.core import Client
+    from elasticdl_tpu.rpc.shm_transport import ShmChannel
+
+    _, params = _deepfm_params()
+    export_root = str(tmp_path / "exports")
+    os.makedirs(export_root)
+    _export(export_root, params, 0)
+    shards = _ps_shards(1)
+    ps_client, _cache = _client(shards)
+    scorer = Scorer(ps_client=ps_client, staleness_versions=2)
+    server = None
+    plain = shm_client = None
+    try:
+        ModelDirectoryWatcher(export_root, scorer).poll_once()
+        server = ScorerServer(scorer, port=0, telemetry_port=-1)
+        feats = _features()
+        plain = Client("localhost:%d" % server.port)
+        reply_a = plain.call("score", **feats)
+        assert "error" not in reply_a, reply_a.get("error")
+        assert reply_a["model_version"] == 0
+        shm_client = Client("localhost:%d" % server.port)
+        channel = ShmChannel(shm_client, n_slots=2, slot_mb=2)
+        reply_b = channel.call("score", **feats)
+        assert "error" not in reply_b, reply_b.get("error")
+        np.testing.assert_array_equal(
+            np.asarray(reply_a["out:logits"]),
+            np.asarray(reply_b["out:logits"]),
+        )
+        status = plain.call("scorer_status")
+        assert status["model_version"] == 0
+        channel.close()
+    finally:
+        if server is not None:
+            server.stop()
+        scorer.close()
+        ps_client.close()
+        for c in (plain, shm_client):
+            if c is not None:
+                c.close()
+
+
+def test_scorer_survives_ps_sigkill_relaunch(tmp_path):
+    """A real PS shard SIGKILLed and relaunched (snapshot restore):
+    the scorer's poll path detects the new shard_epoch, invalidates
+    that shard's cache entries (PR-10 reconnect protocol), and keeps
+    serving within the staleness bound."""
+    import subprocess
+    import sys as _sys
+
+    from tests.fake_ps import free_port
+    from elasticdl_tpu.worker.ps_client import BoundPS
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = free_port()
+    snap_dir = str(tmp_path / "snap")
+    cmd = [
+        _sys.executable,
+        "-m",
+        "elasticdl_tpu.ps.main",
+        "--ps_id", "0",
+        "--port", str(port),
+        "--model_zoo", MODEL_ZOO_PATH,
+        "--model_def", MODEL_DEF,
+        "--use_async", "true",
+        "--grads_to_wait", "1",
+        "--ps_snapshot_versions", "1",
+        "--ps_snapshot_dir", snap_dir,
+    ]
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+
+    def spawn():
+        return subprocess.Popen(
+            cmd,
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def wait_port(proc, timeout=90):
+        import socket
+
+        deadline = time.time() + timeout
+        while True:
+            assert proc.poll() is None, "PS died at boot"
+            try:
+                with socket.create_connection(
+                    ("localhost", port), 1.0
+                ):
+                    return
+            except OSError:
+                assert time.time() < deadline, "PS never served"
+                time.sleep(0.2)
+
+    proc = spawn()
+    try:
+        wait_port(proc)
+        cache = HotRowCache(4096, window=2)
+        client = PSClient(
+            [BoundPS("localhost:%d" % port, deadline_s=5.0, retries=2)],
+            cache=cache,
+        )
+        client.push_model({}, INFOS, version=0)
+        _, params = _deepfm_params()
+        export_root = str(tmp_path / "exports")
+        os.makedirs(export_root)
+        _export(export_root, params, 0)
+        scorer = Scorer(ps_client=client, staleness_versions=2)
+        sync = EmbeddingDeltaSync(client, cache, refresh_rows=True)
+        try:
+            ModelDirectoryWatcher(export_root, scorer).poll_once()
+            feats = _features()
+            out1, _ = scorer.score(feats)
+            # advance versions so the relaunch has a snapshot to restore
+            _push_sparse(client, "embedding", [3, 5, 7], 8)
+            sync.sync_once()
+            epoch_before = client.shard_epochs.get(0)
+            rows_before = len(cache)
+            assert rows_before > 0
+
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            proc = spawn()
+            wait_port(proc)
+            # the poll path detects the new incarnation and runs the
+            # shard-selective invalidation
+            deadline = time.time() + 30
+            while client.shard_epochs.get(0) == epoch_before:
+                assert time.time() < deadline, (
+                    "relaunch never detected via serving_status"
+                )
+                try:
+                    sync.sync_once()
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            assert client.shard_epochs.get(0, 0) > (epoch_before or 0)
+            # scoring resumes against the restored incarnation within
+            # the bound
+            out2, _ = scorer.score(feats)
+            assert np.all(np.isfinite(np.asarray(out2["logits"])))
+            assert cache.max_live_lag() <= 2
+            restores = [
+                e
+                for e in profiling.events.tail(200)
+                if e.get("kind") == "ps_shard_restore"
+            ]
+            assert restores, "no ps_shard_restore event emitted"
+        finally:
+            scorer.close()
+            sync.stop()
+            client.close()
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
