@@ -1160,6 +1160,256 @@ def bench_ps(quick=False):
     )
 
 
+def bench_ps_device(quick=False):
+    """Host-apply vs device-apply PS shard (docs/ps_device.md) at
+    production payload sizes, in a CPU-forced subprocess (same
+    containment as --ps). Returns the _bench_ps_device_impl dict:
+    equivalence pre-pass verdicts + dense/sparse apply speedups."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench, json\n"
+        "print('PSBENCH ' + json.dumps(bench._bench_ps_device_impl(%r)))\n"
+    ) % (here, quick)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=1800,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(
+            "ps device bench timed out:\n%s" % str(e.stdout or "")[-2000:]
+        ) from e
+    for line in proc.stdout.splitlines():
+        if line.startswith("PSBENCH "):
+            return json.loads(line[len("PSBENCH "):])
+    raise RuntimeError(
+        "ps device bench failed:\n"
+        + proc.stdout[-2000:]
+        + proc.stderr[-2000:]
+    )
+
+
+def _bench_ps_device_impl(quick=False):
+    """Measure the device-resident shard against the host shard on the
+    two apply shapes that dominate a PS deployment (docs/ps_device.md):
+
+    - **dense**: ~8 MiB full-model sgd push + pull_variable round.
+      SGD on purpose: both planes run the SAME jitted step, so what
+      separates them is the storage boundary this subsystem moved —
+      the host arm's D2H writeback copy and pull-side staging — not
+      optimizer flops. (adam's 7 compute passes would bury the
+      boundary under math that is byte-identical work on both arms.)
+    - **sparse**: a power-law (zipf) embedding id stream — duplicate
+      ids, lazy init, adam slot tables (dim-64 rows, 2048-id pushes,
+      50k vocab) — where the host arm walks the dict-of-rows store
+      per row per table and the device arm runs one compiled
+      gather/scatter per table over the arena.
+
+    Both modes run at PRODUCTION payload sizes always; ``quick`` only
+    trims rounds and steps, never shapes — the gate is defined at
+    these shapes. Both servicer pairs run IN-PROCESS: this isolates
+    the apply path — the wire cost is identical in both modes and
+    already priced by the --ps fleet metrics.
+
+    Protocol: a warmup pass drives the EXACT op/shape mix the timed
+    pass uses (so every jit compile and lazy-init materialization —
+    including the pull-shape gathers — lands outside the window; a
+    production shard is measured at steady state, not during its
+    first epoch), then host/device rounds alternate and each arm
+    keeps its min-of-rounds per-step time (scheduler noise rejection).
+
+    An equivalence pre-pass drives both modes through one identical
+    stream per arm first and demands BITWISE-equal pulled params,
+    embedding rows, and slot tables (the
+    tests/test_ps_device_parity.py contract re-checked at bench
+    shapes); the caller withholds the speedups unless it passes."""
+    _force_cpu_backend()
+    import numpy as np
+    import optax
+
+    from elasticdl_tpu.common.tensor import Tensor
+    from elasticdl_tpu.ps.parameters import Parameters
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    # production payload sizes in BOTH modes (quick trims effort only);
+    # the 32 MiB dense model deliberately exceeds L3 — at cache-resident
+    # sizes the measurement is thread-pool noise, at DRAM sizes the host
+    # arm's single-threaded staging copies are a structural cost
+    dense_shape = (2048, 4096)
+    dim, batch_ids, vocab = 64, 2048, 50_000
+    rounds = 3 if quick else 5
+    dense_steps = 4 if quick else 8
+    sparse_steps = 6 if quick else 10
+    warmup = 3
+
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal(dense_shape).astype(np.float32)
+    b0 = rng.standard_normal((dense_shape[1],)).astype(np.float32)
+    dense_grads = [
+        {
+            "w": rng.standard_normal(dense_shape).astype(np.float32),
+            "b": rng.standard_normal((dense_shape[1],)).astype(np.float32),
+        }
+        for _ in range(4)
+    ]
+    # power-law ids: head-heavy duplicates (the segment-sum combine
+    # branch) with a long lazy-init tail
+    sparse_stream = []
+    for _ in range(sparse_steps):
+        ids = ((rng.zipf(1.3, size=batch_ids) - 1) % vocab).astype(np.int64)
+        sparse_stream.append(
+            (ids, rng.standard_normal((batch_ids, dim)).astype(np.float32))
+        )
+    sparse_pull_ids = sparse_stream[0][0][:256]
+
+    def mk_dense(device):
+        s = PserverServicer(
+            Parameters(device=device), 1, optax.sgd(0.05), use_async=True
+        )
+        s.push_model(
+            {
+                "version": 0,
+                "params": [Tensor("w", w0.copy()), Tensor("b", b0.copy())],
+                "embedding_infos": [],
+            }
+        )
+        return s
+
+    def mk_sparse(device):
+        s = PserverServicer(
+            Parameters(device=device), 1, optax.adam(1e-3), use_async=True
+        )
+        s.push_model(
+            {
+                "version": 0,
+                "params": [],
+                "embedding_infos": [{"name": "emb", "dim": dim}],
+            }
+        )
+        return s
+
+    def push_dense(servicer, step):
+        g = dense_grads[step % len(dense_grads)]
+        servicer.push_gradient(
+            {
+                "model_version": step,
+                "gradients": [
+                    Tensor("w", g["w"].copy()),
+                    Tensor("b", g["b"].copy()),
+                ],
+            }
+        )
+
+    def push_sparse(servicer, step):
+        ids, rows = sparse_stream[step % len(sparse_stream)]
+        servicer.push_gradient(
+            {
+                "model_version": step,
+                "gradients": [
+                    Tensor("emb", rows.copy(), indices=ids.copy())
+                ],
+            }
+        )
+
+    # -- equivalence pre-pass: bitwise host == device per arm ----------
+    pre_steps = 4
+    probe_ids = np.arange(0, vocab, max(1, vocab // 512), dtype=np.int64)
+    pulled = []
+    for device in (False, True):
+        s = mk_dense(device)
+        for step in range(pre_steps):
+            push_dense(s, step)
+        dense = {
+            t.name: np.asarray(t.values)
+            for t in s.pull_variable({})["params"]
+        }
+        s = mk_sparse(device)
+        for step in range(pre_steps):
+            push_sparse(s, step)
+        rows = np.asarray(
+            s.pull_embedding_vector({"name": "emb", "ids": probe_ids})[
+                "rows"
+            ]
+        )
+        tables = {
+            name: table.snapshot()
+            for name, table in s._parameters.embedding_params.items()
+        }
+        pulled.append((dense, rows, tables))
+    (hd, hr, ht), (dd, dr, dt) = pulled
+    eq = {
+        "dense_bitwise": all(
+            np.array_equal(hd[k], dd[k]) for k in hd
+        )
+        and hd.keys() == dd.keys(),
+        "rows_bitwise": np.array_equal(hr, dr),
+        "slot_tables_bitwise": ht.keys() == dt.keys()
+        and all(
+            np.array_equal(ht[n][0], dt[n][0])
+            and np.array_equal(ht[n][1], dt[n][1])
+            for n in ht
+        ),
+    }
+    eq["ok"] = all(eq.values())
+    if not eq["ok"]:
+        return {"equivalence": eq}
+
+    # -- timed arms: steady-state warmup, alternating min-of-rounds ----
+    def measure(mk, push, pull, steps, warm_steps):
+        pair = {device: mk(device) for device in (False, True)}
+        for device, s in pair.items():
+            for step in range(warm_steps):
+                push(s, step)
+                pull(s)
+        best = {False: float("inf"), True: float("inf")}
+        for _ in range(rounds):
+            for device, s in pair.items():
+                t0 = time.perf_counter()
+                for step in range(steps):
+                    push(s, step)
+                    pull(s)
+                best[device] = min(
+                    best[device], (time.perf_counter() - t0) / steps
+                )
+        return best[False], best[True]
+
+    def pull_dense(s):
+        s.pull_variable({})
+
+    def pull_rows(s):
+        s.pull_embedding_vector({"name": "emb", "ids": sparse_pull_ids})
+
+    out = {"equivalence": eq}
+    out["dense_host_s"], out["dense_device_s"] = measure(
+        mk_dense, push_dense, pull_dense, dense_steps, warmup
+    )
+    # sparse warmup covers the WHOLE stream once: every id
+    # materializes and every k_pad/capacity combo compiles before the
+    # window opens (an arena growth mid-round is a recompile, and a
+    # production shard past its first epoch doesn't pay those)
+    out["sparse_host_s"], out["sparse_device_s"] = measure(
+        mk_sparse, push_sparse, pull_rows, sparse_steps, len(sparse_stream)
+    )
+    out["dense_speedup"] = out["dense_host_s"] / max(
+        out["dense_device_s"], 1e-9
+    )
+    out["sparse_speedup"] = out["sparse_host_s"] / max(
+        out["sparse_device_s"], 1e-9
+    )
+    out["dense_mib"] = round(
+        (w0.nbytes + b0.nbytes) / (1024.0 * 1024.0), 2
+    )
+    out["sparse_batch_ids"] = batch_ids
+    out["rounds"] = rounds
+    return out
+
+
 def _on_cpu():
     """True when the measured backend is plain CPU: device sections
     shrink their workloads (a production-sized ResNet-50 step on CPU
@@ -4915,6 +5165,68 @@ def main(argv=None):
                 res["fanout_slowest_shard_s"] * 1e3,
                 res["fanout_serial_call_s"] * 1e3,
                 res["fanout_shard_sum_s"] * 1e3,
+            ),
+            update,
+        )
+        dev = bench_ps_device(quick)
+        eq = dev.get("equivalence", {})
+        if not eq.get("ok"):
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_device_apply_speedup",
+                        "error": "host/device equivalence pre-pass "
+                        "FAILED (%s): the device shard is not bitwise "
+                        "the same trainer; speedups withheld"
+                        % ", ".join(
+                            k for k, v in eq.items() if k != "ok" and not v
+                        ),
+                    }
+                )
+            )
+            return 1
+        floor = 1.3
+        for arm in ("dense", "sparse"):
+            if dev["%s_speedup" % arm] < floor:
+                print(
+                    json.dumps(
+                        {
+                            "metric": "ps_device_apply_speedup",
+                            "error": "device-apply shard %.2fx the "
+                            "host-apply shard on the %s arm (%.2f vs "
+                            "%.2f ms/step) — below the %.1fx gate at "
+                            "production payload sizes"
+                            % (
+                                dev["%s_speedup" % arm],
+                                arm,
+                                dev["%s_host_s" % arm] * 1e3,
+                                dev["%s_device_s" % arm] * 1e3,
+                                floor,
+                            ),
+                        }
+                    )
+                )
+                return 1
+        _emit(
+            "ps_device_apply_speedup",
+            round(dev["dense_speedup"], 2),
+            "x host-apply/device-apply per-step wall on the dense arm "
+            "(%.1f MiB sgd model, %.2f vs %.2f ms push+pull; sparse "
+            "arm %.2fx, %d-id zipf adam pushes %.2f vs %.2f ms), "
+            "in-process shard pairs at steady state, min of %d "
+            "alternating rounds, gate >=%.1fx both arms; equivalence "
+            "pre-pass: bitwise-identical pulled params, embedding "
+            "rows, and slot tables (docs/ps_device.md)"
+            % (
+                dev["dense_mib"],
+                dev["dense_host_s"] * 1e3,
+                dev["dense_device_s"] * 1e3,
+                dev["sparse_speedup"],
+                dev["sparse_batch_ids"],
+                dev["sparse_host_s"] * 1e3,
+                dev["sparse_device_s"] * 1e3,
+                dev["rounds"],
+                floor,
             ),
             update,
         )

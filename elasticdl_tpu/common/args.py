@@ -667,6 +667,18 @@ def parse_ps_args(ps_args=None):
     parser.add_argument("--grads_to_wait", type=pos_int, default=1)
     add_bool_param(parser, "--use_async", False, "")
     add_bool_param(parser, "--lr_staleness_modulation", False, "")
+    add_bool_param(
+        parser,
+        "--ps_device",
+        False,
+        help="Device-resident shard (docs/ps_device.md): dense params, "
+        "embedding tables and optimizer state live as jax.Arrays with "
+        "jitted apply paths and compiled embedding gather/scatter; "
+        "incoming gradients decode straight to device. Bitwise-"
+        "identical to the host shard on every RPC (snapshot format, "
+        "delta log and reconnect protocol unchanged). Off (default) "
+        "keeps the host-numpy store",
+    )
     parser.add_argument(
         "--wire_dtype", default="", choices=["", "bfloat16"]
     )

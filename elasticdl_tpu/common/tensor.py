@@ -103,6 +103,42 @@ def device_host_view(arr):
     return jax.device_get(arr)
 
 
+def device_from_host_view(arr):
+    """The inverse bridge: a device array over a host buffer — how a
+    decoded wire view enters a device-resident PS shard
+    (ps/device_store.py, docs/ps_device.md).
+
+    A writable float32 numpy view imports through dlpack with NO copy:
+    the returned ``jax.Array`` ALIASES the host buffer, so on a CPU
+    backend a shm-slot gradient flows slot -> dlpack view -> jitted
+    apply with zero host staging. The caller owns the lifetime
+    contract — it must ``jax.block_until_ready`` everything computed
+    from the import before the backing buffer is recycled (the shm
+    server overwrites the request slot with the reply the moment the
+    handler returns), and must never donate the aliased array.
+
+    Read-only views (numpy cannot export them pre-DLPack-1.0) and
+    non-f32/non-contiguous payloads fall back to ``jax.device_put`` —
+    one fused H2D copy, the exact dual of :func:`device_host_view`'s
+    ``device_get`` fallback. Device arrays pass through untouched."""
+    if is_device_array(arr):
+        return arr
+    import jax
+
+    flags = getattr(arr, "flags", None)
+    if (
+        flags is not None
+        and flags.writeable
+        and flags.c_contiguous
+        and arr.dtype == np.float32
+    ):
+        try:
+            return jax.dlpack.from_dlpack(arr)
+        except (BufferError, RuntimeError, TypeError, ValueError):
+            pass  # backend refused the import; device_put below
+    return jax.device_put(arr)
+
+
 class Tensor:
     """A named ndarray, optionally sparse (values + row indices).
 
@@ -322,15 +358,28 @@ def _readonly(data):
     return view if view.readonly else view.toreadonly()
 
 
-def deserialize_tensor(data):
+def deserialize_tensor(data, writable=False):
     """Zero-copy decode: values and indices come back as READ-ONLY
     ``np.frombuffer`` views pinned to ``data`` (the views hold the
     buffer alive; see :class:`WireArena` for the explicit lifetime
     handle). Mutating/retaining consumers call
     :meth:`Tensor.materialize` — in-process fast paths (the master
     rung, tests) read straight out of the frame buffer with no copy at
-    all, indices included."""
-    view = _readonly(data)
+    all, indices included.
+
+    ``writable=True`` (device-resident PS shards only) keeps the views
+    writable when ``data`` itself is — numpy refuses to dlpack-export
+    a read-only buffer, so this is what lets a shm-slot payload enter
+    the device with zero copies (:func:`device_from_host_view`). It
+    FORFEITS :meth:`Tensor.materialize`'s view detection (a writable
+    view looks owned), so every consumer on that path must copy
+    explicitly if it retains — the device apply paths consume within
+    the handler instead."""
+    view = (
+        _readonly(data)
+        if not writable
+        else (data if isinstance(data, memoryview) else memoryview(data))
+    )
     if view[:4] != _MAGIC:
         raise ValueError("bad tensor frame magic")
     ver, hlen = struct.unpack_from("<BI", view, 4)

@@ -1,0 +1,302 @@
+"""Device-resident embedding store: one contiguous arena per table.
+
+The host :class:`~elasticdl_tpu.ps.embedding_table.EmbeddingTable` is a
+per-id Python dict — every pull walks ids one by one and every apply
+scatters rows back through Python. This variant keeps all rows of a
+table in ONE device-resident ``(capacity, dim)`` ``jax.Array`` (the
+arena) plus a host-side ``{id: slot}`` index, so:
+
+- ``pull``-side lookups are one compiled gather over the arena,
+- apply-side writebacks are one compiled scatter (the arena is DONATED
+  into the scatter, so a step updates rows in place instead of copying
+  ``capacity x dim`` floats),
+- lazy init is a vectorized fill of only the missing slots, using the
+  same id-seeded initializers as the host table
+  (ps/embedding_table._make_initializer) — so host and device shards
+  mint bitwise-identical fresh rows in any materialization order.
+
+Capacity grows by doubling (slots are append-only between
+``clear``/``load_snapshot``); gather/scatter index vectors are padded
+to the next power of two with an out-of-range sentinel (gather
+``mode="fill"`` returns zeros, scatter ``mode="drop"`` ignores them)
+so jit recompiles are bounded by ``log2`` of the working-set size, not
+by the stream of distinct batch shapes.
+
+Concurrency contract matches the host table: every method takes the
+table lock, so an async apply's scatter and a concurrent pull's gather
+serialize. Donation is safe because the arena is only ever reached
+through ``self._arena`` under that lock — gather outputs are fresh
+buffers, and the snapshot path copies before releasing the lock
+(jax's CPU ``device_get`` may alias the buffer a later scatter
+donates).
+
+See docs/ps_device.md for the full residency model.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor import (
+    device_from_host_view,
+    device_host_view,
+)
+from elasticdl_tpu.ps.embedding_table import _make_initializer
+
+_MIN_CAPACITY = 64
+# pad sentinel: out of range for any arena, so padded lanes vanish
+# through gather mode="fill" / scatter mode="drop"
+_OOB = np.int32(2**31 - 1)
+
+_jit_cache = {}
+
+
+def next_pow2(n):
+    """Smallest power of two >= n (and >= 1)."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def _jitted():
+    """Build (gather, scatter, grow) lazily so importing this module
+    never initializes a jax backend (edlint R2 discipline elsewhere in
+    the tree: process entries decide the platform first)."""
+    fns = _jit_cache.get("fns")
+    if fns is not None:
+        return fns
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def gather(arena, idx):
+        return arena.at[idx].get(mode="fill", fill_value=0.0)
+
+    def _scatter(arena, idx, rows):
+        return arena.at[idx].set(rows, mode="drop", unique_indices=True)
+
+    # the arena is donated: XLA writes the touched rows in place
+    # instead of materializing a second capacity x dim buffer per step
+    scatter = jax.jit(_scatter, donate_argnums=0)
+
+    def grow(arena, new_cap, dim):
+        new = jnp.zeros((new_cap, dim), jnp.float32)
+        if arena is not None and arena.shape[0]:
+            new = new.at[: arena.shape[0]].set(arena)
+        return new
+
+    fns = (gather, scatter, grow)
+    _jit_cache["fns"] = fns
+    return fns
+
+
+def _pad_idx(slots, k_pad):
+    """int32 index vector of length ``k_pad``: real slots first, OOB
+    sentinel lanes after."""
+    idx = np.full(k_pad, _OOB, dtype=np.int32)
+    idx[: len(slots)] = slots
+    return idx
+
+
+class DeviceEmbeddingTable:
+    """Drop-in for :class:`EmbeddingTable` with device-resident rows.
+
+    Same constructor, same host-facing methods (``get``/``set``/
+    ``clear``/``snapshot``/``load_snapshot``/``__len__``), plus the
+    device plane the jitted optimizer apply drives directly:
+    ``ensure_rows`` / ``gather_slots`` / ``scatter_slots`` / ``sync``.
+    """
+
+    def __init__(self, name, dim=None, initializer=None, is_slot=False):
+        self.name = name
+        self.dim = dim
+        self.initializer_name = initializer
+        self.is_slot = is_slot
+        self._initializer = _make_initializer(initializer)
+        self._lock = threading.Lock()
+        self._slots = {}  # id -> arena row, append-only between resets
+        self._arena = None  # jax.Array (capacity, dim) float32
+
+    # -- device plane -------------------------------------------------------
+
+    def _grow_locked(self, need):
+        cap = 0 if self._arena is None else int(self._arena.shape[0])
+        if self._arena is not None and need <= cap:
+            return
+        if self.dim is None:
+            raise ValueError(
+                "DeviceEmbeddingTable %r used before dim is known"
+                % self.name
+            )
+        new_cap = max(_MIN_CAPACITY, next_pow2(need))
+        if self._arena is not None and new_cap <= cap:
+            return
+        _, _, grow = _jitted()
+        self._arena = grow(self._arena, new_cap, int(self.dim))
+
+    def _materialize_locked(self, ids, init=True):
+        """Assign arena slots for unseen ids; ``init=True`` fills their
+        rows from the id-seeded initializer (one vectorized scatter of
+        only the missing slots). ``ids``: iterable of python ints."""
+        missing = [i for i in dict.fromkeys(ids) if i not in self._slots]
+        if not missing:
+            return
+        base = len(self._slots)
+        m = len(missing)
+        self._grow_locked(base + m)
+        if init:
+            gather, scatter, _ = _jitted()
+            m_pad = next_pow2(m)
+            fresh = np.zeros((m_pad, int(self.dim)), dtype=np.float32)
+            fresh[:m] = self._initializer(
+                np.asarray(missing, dtype=np.int64), self.dim
+            )
+            idx = _pad_idx(
+                np.arange(base, base + m, dtype=np.int32), m_pad
+            )
+            self._arena = scatter(
+                self._arena, idx, device_from_host_view(fresh)
+            )
+        for pos, i in enumerate(missing):
+            self._slots[i] = base + pos
+
+    def ensure_rows(self, unique_ids):
+        """Slots for ``unique_ids`` (materializing missing rows with
+        their id-seeded init). -> int64 (k,)."""
+        ids = [
+            int(i)
+            for i in np.asarray(unique_ids, dtype=np.int64).reshape(-1)
+        ]
+        with self._lock:
+            self._materialize_locked(ids)
+            return np.fromiter(
+                (self._slots[i] for i in ids), dtype=np.int64, count=len(ids)
+            )
+
+    def gather_slots(self, slots, k_pad):
+        """Compiled gather of ``slots`` padded to ``k_pad`` lanes.
+        -> device (k_pad, dim); padded lanes read as zero rows."""
+        gather, _, _ = _jitted()
+        with self._lock:
+            return gather(self._arena, _pad_idx(slots, k_pad))
+
+    def scatter_slots(self, slots, k_pad, rows):
+        """Compiled scatter of ``rows`` (device, (k_pad, dim)) into
+        ``slots``; padded lanes drop. Donates the arena."""
+        _, scatter, _ = _jitted()
+        with self._lock:
+            self._arena = scatter(
+                self._arena, _pad_idx(slots, k_pad), rows
+            )
+
+    def sync(self):
+        """Block until every in-flight arena update has executed — the
+        fence a zero-copy (dlpack-aliased) gradient import requires
+        before its backing wire buffer is recycled."""
+        import jax
+
+        with self._lock:
+            if self._arena is not None:
+                jax.block_until_ready(self._arena)
+
+    # -- host-facing interface (EmbeddingTable parity) ----------------------
+
+    def get(self, indices):
+        """Rows for ``indices`` (lazy-init missing ones). -> (n, dim).
+
+        One compiled gather; the result is a host VIEW of the fresh
+        gather buffer (zero-copy on a CPU backend) — fine to frame or
+        read, owned by nobody else, never the arena itself."""
+        if len(indices) == 0:
+            return None
+        ids = [
+            int(i) for i in np.asarray(indices, dtype=np.int64).reshape(-1)
+        ]
+        n = len(ids)
+        gather, _, _ = _jitted()
+        with self._lock:
+            self._materialize_locked(ids)
+            slots = np.fromiter(
+                (self._slots[i] for i in ids), dtype=np.int64, count=n
+            )
+            out = gather(self._arena, _pad_idx(slots, next_pow2(n)))
+        return device_host_view(out)[:n]
+
+    def set(self, indices, values):
+        """Write full rows (last write wins for duplicate ids, host
+        ``EmbeddingTable.set`` parity)."""
+        ids = [
+            int(i) for i in np.asarray(indices, dtype=np.int64).reshape(-1)
+        ]
+        values = np.asarray(values, dtype=np.float32)
+        last = {}
+        for pos, i in enumerate(ids):
+            last[i] = pos
+        uniq = list(last.keys())
+        _, scatter, _ = _jitted()
+        with self._lock:
+            self._materialize_locked(uniq, init=False)
+            k = len(uniq)
+            k_pad = next_pow2(k)
+            rows = np.zeros((k_pad, values.shape[1]), dtype=np.float32)
+            rows[:k] = values[[last[i] for i in uniq]]
+            slots = np.fromiter(
+                (self._slots[i] for i in uniq), dtype=np.int64, count=k
+            )
+            self._arena = scatter(
+                self._arena,
+                _pad_idx(slots, k_pad),
+                device_from_host_view(rows),
+            )
+
+    def clear(self):
+        with self._lock:
+            self._slots = {}
+            self._arena = None
+
+    def snapshot(self):
+        """Consistent (ids, rows) HOST COPY of every materialized row —
+        the device->disk drain's capture half (docs/ps_device.md).
+
+        Slots are append-only, so rows live contiguously in
+        ``arena[:n]`` in insertion order; one batched ``device_get``
+        under the table lock drains them. The explicit ``.copy()`` is
+        load-bearing: a CPU ``device_get`` may alias the arena buffer,
+        which the very next apply DONATES."""
+        import jax
+
+        with self._lock:
+            n = len(self._slots)
+            ids = np.fromiter(
+                self._slots.keys(), dtype=np.int64, count=n
+            )
+            if n == 0 or self._arena is None:
+                rows = np.zeros((0, int(self.dim or 0)), np.float32)
+            else:
+                rows = jax.device_get(self._arena)[:n].copy()
+        return ids, rows
+
+    def load_snapshot(self, ids, rows):
+        """Replace the row store with a snapshot's (ids, rows) — the
+        restore half of :meth:`snapshot` (PS shard relaunch)."""
+        rows = np.asarray(rows, dtype=np.float32)
+        ids = [
+            int(i) for i in np.asarray(ids, dtype=np.int64).reshape(-1)
+        ]
+        with self._lock:
+            self._slots = {}
+            self._arena = None
+            if not ids:
+                return
+            self._grow_locked(len(ids))
+            _, scatter, _ = _jitted()
+            k_pad = next_pow2(len(ids))
+            padded = np.zeros((k_pad, rows.shape[1]), dtype=np.float32)
+            padded[: len(ids)] = rows
+            self._arena = scatter(
+                self._arena,
+                _pad_idx(np.arange(len(ids), dtype=np.int32), k_pad),
+                device_from_host_view(padded),
+            )
+            self._slots = {i: pos for pos, i in enumerate(ids)}
+
+    def __len__(self):
+        return len(self._slots)

@@ -4,9 +4,21 @@ Parity: reference ps/embedding_table.py — rows materialize on first get
 using a named initializer; slot tables (optimizer state rows) use a
 constant initializer; slot-table naming is ``"{layer}-{slot}"``.
 
-This is the PS-mode (host HBM) store for tables too large to replicate.
-The TPU-native fast path keeps tables sharded in device HBM instead
-(parallel/embedding_sharding.py); both share the same naming/layout so
+Lazy init is ORDER-INDEPENDENT: a row's initial value is a pure
+function of ``(id, column, initializer, seed)`` (a splitmix64 hash
+drives the uniform/normal draws), never of the order rows happened to
+materialize in. The seed-era ``np.random.default_rng`` shared one
+stream across all lazy inits, so the same id drew different values on
+different shards or relaunch interleavings — which breaks restore
+parity (a row materialized pre-snapshot vs post-restore differed) and
+host-vs-device shard parity (ps/device_store.py shares these
+initializers so both modes mint bitwise-identical fresh rows).
+
+This is the PS-mode (host) store for tables too large to replicate.
+The device-resident variant (ps/device_store.py) keeps rows in an
+accelerator arena with the same interface; the TPU-native fast path
+keeps tables sharded in device HBM instead
+(parallel/embedding_sharding.py). All share the same naming/layout so
 checkpoints interoperate.
 """
 
@@ -14,24 +26,74 @@ import threading
 
 import numpy as np
 
+# splitmix64 constants (Steele et al.): the increment is the golden
+# ratio; a second odd constant separates the column axis so (id, col)
+# pairs never collide by construction of a linear relation
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_WEYL = np.uint64(0xBF58476D1CE4E5B9)
+_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = np.uint64(0x94D049BB133111EB)
+
+
+def _splitmix64(x):
+    z = x
+    z = (z ^ (z >> np.uint64(30))) * _MIX1
+    z = (z ^ (z >> np.uint64(27))) * _MIX2
+    return z ^ (z >> np.uint64(31))
+
+
+def _unit_from_ids(ids, dim, salt):
+    """``(n, dim)`` uniforms in [0, 1) — a pure function of
+    ``(id, column, salt)``, vectorized. float64 mantissa precision (53
+    hash bits per draw) so the downstream float32 cast is exact."""
+    ids64 = np.asarray(ids, dtype=np.int64).reshape(-1, 1)
+    cols = np.arange(int(dim), dtype=np.uint64).reshape(1, -1)
+    with np.errstate(over="ignore"):
+        # negative ids wrap deterministically through the uint64 view
+        x = _splitmix64(
+            ids64.astype(np.uint64) * _GOLDEN
+            + cols * _WEYL
+            + np.uint64(salt)
+        )
+    return (x >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+
 
 def _make_initializer(name, seed=0):
-    rng = np.random.default_rng(seed)
+    """Vectorized per-id initializer: ``init(ids, dim) -> (n, dim) f32``.
+
+    The value of row ``i`` depends only on ``(i, name, seed)`` — NOT on
+    how many rows initialized before it — so lazy init commutes with
+    any materialization order (pinned by
+    tests/test_ps_store.py::test_lazy_init_is_order_independent)."""
     name = (name or "uniform").lower()
 
     if name in ("uniform", "random_uniform"):
-        return lambda dim: rng.uniform(-0.05, 0.05, size=dim).astype(
-            np.float32
-        )
+
+        def uniform(ids, dim):
+            u = _unit_from_ids(ids, dim, 2 * seed + 1)
+            return (-0.05 + 0.1 * u).astype(np.float32)
+
+        return uniform
     if name in ("normal", "random_normal"):
-        return lambda dim: rng.normal(0.0, 0.05, size=dim).astype(np.float32)
+
+        def normal(ids, dim):
+            # Box-Muller on two independent per-(id, col) draws
+            u1 = _unit_from_ids(ids, dim, 2 * seed + 1)
+            u2 = _unit_from_ids(ids, dim, 2 * seed + 2)
+            u1 = np.maximum(u1, np.finfo(np.float64).tiny)
+            z = np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
+            return (0.05 * z).astype(np.float32)
+
+        return normal
     if name.startswith("zero"):
-        return lambda dim: np.zeros(dim, dtype=np.float32)
+        return lambda ids, dim: np.zeros((len(ids), dim), dtype=np.float32)
     if name.startswith("ones"):
-        return lambda dim: np.ones(dim, dtype=np.float32)
+        return lambda ids, dim: np.ones((len(ids), dim), dtype=np.float32)
     try:
         const = float(name)
-        return lambda dim: np.full(dim, const, dtype=np.float32)
+        return lambda ids, dim: np.full(
+            (len(ids), dim), const, dtype=np.float32
+        )
     except ValueError:
         raise ValueError("Unknown embedding initializer %r" % name)
 
@@ -52,16 +114,22 @@ class EmbeddingTable:
         """Rows for ``indices`` (lazy-init missing ones). -> (n, dim)."""
         if len(indices) == 0:
             return None
-        values = []
+        ids = [int(i) for i in indices]
         with self._lock:
-            for i in indices:
-                i = int(i)
-                value = self.embedding_vectors.get(i)
-                if value is None:
-                    value = self._initializer(self.dim)
-                    self.embedding_vectors[i] = value
-                values.append(value)
-        return np.stack(values)
+            missing = [
+                i
+                for i in dict.fromkeys(ids)
+                if i not in self.embedding_vectors
+            ]
+            if missing:
+                # one vectorized fill for all missing rows; each row's
+                # value is a function of its id alone (order-free)
+                fresh = self._initializer(
+                    np.asarray(missing, dtype=np.int64), self.dim
+                )
+                for pos, i in enumerate(missing):
+                    self.embedding_vectors[i] = fresh[pos]
+            return np.stack([self.embedding_vectors[i] for i in ids])
 
     def set(self, indices, values):
         values = np.asarray(values)

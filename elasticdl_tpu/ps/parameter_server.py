@@ -42,7 +42,11 @@ class ParameterServer:
             get_module_file_path(args.model_zoo, args.model_def)
         ).__dict__
         self._optimizer = module[args.optimizer]()
-        self.parameters = Parameters()
+        # --ps_device: device-resident store + jitted apply paths
+        # (docs/ps_device.md); everything downstream — snapshots, the
+        # delta log, the RPC protocol — is mode-agnostic
+        self.ps_device = bool(getattr(args, "ps_device", False))
+        self.parameters = Parameters(device=self.ps_device)
 
         # durability plane: build the per-shard snapshotter (a no-op
         # object when the cadence/dir flags are unset), mint this
@@ -124,8 +128,14 @@ class ParameterServer:
         # the hello reply carries this incarnation's boot id too, so a
         # reconnecting co-located client learns the epoch at negotiation
         # time, before its first data-plane round (docs/ps_recovery.md)
+        # device shards opt into WRITABLE request views: a shm-slot
+        # gradient then dlpack-imports straight to device with zero
+        # copies (the apply fences on its outputs before the reply
+        # recycles the slot — docs/ps_device.md)
         methods, self._shm_registry = install_shm_endpoint(
-            methods, hello_extra={"shard_epoch": self.shard_epoch}
+            methods,
+            hello_extra={"shard_epoch": self.shard_epoch},
+            writable_request_views=self.ps_device,
         )
         telemetry_port = getattr(self._args, "ps_telemetry_port", None)
         if telemetry_port is None:

@@ -30,12 +30,30 @@ class EmbeddingTableInfo:
 
 
 class Parameters:
-    def __init__(self):
+    def __init__(self, device=False):
+        """``device=True`` makes this a DEVICE-RESIDENT store
+        (docs/ps_device.md): dense params live as ``jax.Array``s,
+        embedding/slot tables are
+        :class:`~elasticdl_tpu.ps.device_store.DeviceEmbeddingTable`
+        arenas, and the optimizer wrapper picks its jitted apply
+        paths. Snapshot format, RPC protocol, and lazy-init values
+        are bitwise-identical to the host mode (the parity suite,
+        tests/test_ps_device_parity.py, pins this on every RPC)."""
         self.version = 0
         self.initialized = False
+        self.device = bool(device)
         self.non_embedding_params = {}
         self.embedding_params = {}
         self._lock = threading.Lock()
+
+    def _new_table(self, name, dim, initializer, is_slot=False):
+        if self.device:
+            from elasticdl_tpu.ps.device_store import DeviceEmbeddingTable
+
+            return DeviceEmbeddingTable(
+                name, dim, initializer, is_slot=is_slot
+            )
+        return EmbeddingTable(name, dim, initializer, is_slot=is_slot)
 
     def get_non_embedding_param(self, name, default=None):
         return self.non_embedding_params.get(name, default)
@@ -105,9 +123,15 @@ class Parameters:
                 self.init_embedding_params(embedding_infos)
                 return False
             for name, arr in dense_params.items():
-                self.non_embedding_params[name] = np.asarray(
-                    arr, dtype=np.float32
-                ).copy()
+                host = np.asarray(arr, dtype=np.float32)
+                if self.device:
+                    # device_put owns its copy, so a read-only wire
+                    # view needs no host-side .copy() first
+                    import jax
+
+                    self.non_embedding_params[name] = jax.device_put(host)
+                else:
+                    self.non_embedding_params[name] = host.copy()
             self.init_embedding_params(embedding_infos)
             self.version = max(0, int(version))
             self.initialized = True
@@ -116,7 +140,7 @@ class Parameters:
     def init_embedding_params(self, embedding_infos):
         for info in embedding_infos or ():
             if info.name not in self.embedding_params:
-                self.embedding_params[info.name] = EmbeddingTable(
+                self.embedding_params[info.name] = self._new_table(
                     info.name, info.dim, info.initializer
                 )
 
@@ -138,7 +162,7 @@ class Parameters:
             for slot_name in slot_names:
                 key = get_slot_table_name(layer_name, slot_name)
                 if key not in self.embedding_params:
-                    table = EmbeddingTable(
+                    table = self._new_table(
                         key,
                         dim,
                         initializer=str(init_values.get(slot_name, 0.0)),
@@ -155,6 +179,12 @@ class Parameters:
         and post-apply values (half the dict from before the rebind,
         half after) tagged with one version."""
         with self._lock:
+            if self.device:
+                # device arrays are immutable and applies REBIND the
+                # dict rather than mutate entries, so the dict copy
+                # alone is the atomic cut — no per-array copy; the
+                # wire codec frames them through the dlpack bridge
+                return dict(self.non_embedding_params)
             return {
                 name: arr.copy()
                 for name, arr in self.non_embedding_params.items()
@@ -175,10 +205,24 @@ class Parameters:
         with self._lock:
             version = int(self.version)
             initialized = bool(self.initialized)
-            dense = {
-                name: np.asarray(arr, dtype=np.float32).copy()
-                for name, arr in self.non_embedding_params.items()
-            }
+            if self.device:
+                # the device->disk drain: one batched device_get of
+                # the whole dense dict under the lock. The .copy() is
+                # load-bearing on a CPU backend, where device_get may
+                # alias a buffer the next apply's donation retires.
+                import jax
+
+                dense = {
+                    name: np.asarray(arr, dtype=np.float32).copy()
+                    for name, arr in jax.device_get(
+                        dict(self.non_embedding_params)
+                    ).items()
+                }
+            else:
+                dense = {
+                    name: np.asarray(arr, dtype=np.float32).copy()
+                    for name, arr in self.non_embedding_params.items()
+                }
             tables = list(self.embedding_params.items())
         table_snaps = {}
         for name, table in tables:
@@ -207,7 +251,7 @@ class Parameters:
         worker's first-write push."""
         tables = {}
         for name, snap in state["tables"].items():
-            table = EmbeddingTable(
+            table = self._new_table(
                 name,
                 snap["dim"],
                 initializer=snap["initializer"],
@@ -215,11 +259,20 @@ class Parameters:
             )
             table.load_snapshot(snap["ids"], snap["rows"])
             tables[name] = table
-        with self._lock:
-            self.non_embedding_params = {
+        if self.device:
+            import jax
+
+            dense = {
+                name: jax.device_put(np.asarray(arr, dtype=np.float32))
+                for name, arr in state["dense"].items()
+            }
+        else:
+            dense = {
                 name: np.asarray(arr, dtype=np.float32)
                 for name, arr in state["dense"].items()
             }
+        with self._lock:
+            self.non_embedding_params = dense
             self.embedding_params = tables
             self.version = int(state["version"])
             self.initialized = bool(state.get("initialized", True))

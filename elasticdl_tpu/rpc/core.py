@@ -156,7 +156,7 @@ def pack_message(msg):
     return buf
 
 
-def unpack_message(data, arena=None):
+def unpack_message(data, arena=None, writable=False):
     """bytes-like -> dict, zero-copy: segments stay memoryview slices
     of ``data`` and the field decoders decide what materializes —
     tensor/array fields decode to READ-ONLY views pinned to the buffer,
@@ -164,9 +164,15 @@ def unpack_message(data, arena=None):
     payloads never ride that kind), json fields are scalars. ``arena``
     (a :class:`WireArena`) rides along under ``"_wire_arena"`` so the
     consumer controls the buffer's lifetime (mandatory for shm slots;
-    see common/tensor.release_message)."""
+    see common/tensor.release_message).
+
+    ``writable=True`` keeps tensor views writable when ``data`` is a
+    writable buffer — the device-resident PS shard's opt-in
+    (rpc/shm_transport.install_shm_endpoint) so request payloads can
+    dlpack-import straight to device (common/tensor.
+    device_from_host_view); numpy cannot export read-only buffers."""
     view = data if isinstance(data, memoryview) else memoryview(data)
-    if not view.readonly:
+    if not view.readonly and not writable:
         view = view.toreadonly()
     (hlen,) = struct.unpack_from("<I", view, 0)
     header = json.loads(bytes(view[4 : 4 + hlen]))
@@ -187,11 +193,14 @@ def unpack_message(data, arena=None):
         elif kind == "bytes":
             msg[key] = bytes(segments[spec["i"]])
         elif kind == "tensor":
-            msg[key] = deserialize_tensor(segments[spec["i"]])
+            msg[key] = deserialize_tensor(segments[spec["i"]], writable)
         elif kind == "array":
-            msg[key] = deserialize_tensor(segments[spec["i"]]).values
+            msg[key] = deserialize_tensor(segments[spec["i"]], writable).values
         elif kind == "tensors":
-            msg[key] = [deserialize_tensor(segments[i]) for i in spec["i"]]
+            msg[key] = [
+                deserialize_tensor(segments[i], writable)
+                for i in spec["i"]
+            ]
         else:
             raise ValueError("unknown field kind %r" % kind)
     if arena is not None:

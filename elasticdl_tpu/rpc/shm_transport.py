@@ -442,10 +442,19 @@ class ShmEndpointRegistry:
     path that frees segments of clients SIGKILLed mid-call, since a
     dead creator's atexit never ran."""
 
-    def __init__(self):
+    def __init__(self, writable_request_views=False):
         self._mu = threading.Lock()
         self._rings = {}
         self._fingerprint = host_fingerprint()
+        # device-resident PS shards opt in (docs/ps_device.md): request
+        # payloads decode as WRITABLE slot views so gradients can
+        # dlpack-import to device with zero copies (numpy cannot export
+        # a read-only buffer). Safe under the existing slot contract —
+        # the handler consumes the request fully before the reply
+        # overwrites the slot (the device apply blocks on its outputs)
+        # — but it forfeits the codec's mutation guard, so it is never
+        # the default.
+        self._writable_request_views = bool(writable_request_views)
 
     def hello(self, req):
         name = req.get("name", "")
@@ -506,7 +515,12 @@ class ShmEndpointRegistry:
             ):
                 return {"_shm_error": "stale generation"}
             payload = ring.payload_view(slot)
-            request = unpack_message(payload[:length].toreadonly())
+            if self._writable_request_views:
+                request = unpack_message(
+                    payload[:length], writable=True
+                )
+            else:
+                request = unpack_message(payload[:length].toreadonly())
             reply = fn(request) or {}
             # the handler is done with the request (the audited PS
             # servicer materializes anything it retains), so the slot
@@ -530,7 +544,9 @@ class ShmEndpointRegistry:
             ring.reclaim()
 
 
-def install_shm_endpoint(methods, hello_extra=None):
+def install_shm_endpoint(
+    methods, hello_extra=None, writable_request_views=False
+):
     """Wrap a ``{name: fn}`` RPC table with the shared-memory endpoint.
 
     Returns ``(methods, registry)`` where ``methods`` additionally
@@ -540,8 +556,13 @@ def install_shm_endpoint(methods, hello_extra=None):
     ``hello_extra``: extra fields merged into every hello reply —
     the PS serves its ``shard_epoch`` boot id here so a reconnecting
     co-located client learns the incarnation at negotiation time,
-    before its first data-plane round (docs/ps_recovery.md)."""
-    registry = ShmEndpointRegistry()
+    before its first data-plane round (docs/ps_recovery.md).
+
+    ``writable_request_views``: device-resident PS shards only — see
+    :class:`ShmEndpointRegistry`."""
+    registry = ShmEndpointRegistry(
+        writable_request_views=writable_request_views
+    )
     wrapped = {name: registry.wrap(fn) for name, fn in methods.items()}
     if hello_extra:
         extra = dict(hello_extra)

@@ -178,5 +178,14 @@ ALLOW = {
             "tensor (the encode direction is fused into the frame "
             "write and allocates nothing)",
         },
+        "elasticdl_tpu/ps/device_store.py": {
+            "max": 2,
+            "reason": "the device->disk snapshot drain "
+            "(DeviceEmbeddingTable.snapshot) deliberately "
+            "host-stages: one batched jax.device_get of the arena "
+            "under the table lock, and its .copy() is load-bearing — "
+            "a CPU device_get may alias the arena buffer, which the "
+            "very next apply DONATES (docs/ps_device.md)",
+        },
     },
 }

@@ -1196,7 +1196,13 @@ class CopyOnWireRule(Rule):
         "decode through read-only frombuffer views, "
         "Tensor.materialize() at the audited retention sites; the "
         "transport-handoff copies and host-side normalizations that "
-        "must remain are reason-ratcheted"
+        "must remain are reason-ratcheted. The device-shard apply "
+        "path (docs/ps_device.md) extends the contract: inside "
+        "DEVICE_SCOPED_FILES' data-plane bodies a payload must stay "
+        "device-resident end to end, so bare np.asarray, "
+        "jax.device_get AND .copy() are findings there (the "
+        "deliberate host sites — the snapshot drain, the host-mode "
+        "D2H writeback — are reason-ratcheted)"
     )
 
     SCOPE_PREFIXES = ("elasticdl_tpu/rpc/",)
@@ -1208,17 +1214,49 @@ class CopyOnWireRule(Rule):
         "elasticdl_tpu/worker/ps_client.py",
         "elasticdl_tpu/ps/servicer.py",
     )
+    # the device-resident shard (docs/ps_device.md): gradient frames
+    # enter via dlpack and rows live in device arenas, so ANY host
+    # round-trip inside the push/pull/apply/gather/scatter bodies —
+    # including a plain .copy() — silently reintroduces the staging
+    # pass the plane exists to delete
+    DEVICE_SCOPED_FILES = (
+        "elasticdl_tpu/ps/device_store.py",
+        "elasticdl_tpu/ps/optimizer_wrapper.py",
+    )
 
     def _in_scope(self, path):
         return (
             path in self.SCOPE_FILES
             or path in self.METHOD_SCOPED_FILES
+            or path in self.DEVICE_SCOPED_FILES
             or any(path.startswith(p) for p in self.SCOPE_PREFIXES)
         )
 
     @staticmethod
     def _data_plane_fn(name):
         return name.lstrip("_").startswith(("push", "pull", "apply"))
+
+    @staticmethod
+    def _device_plane_fn(name):
+        # the device shard's data plane: RPC-facing push/pull/apply
+        # plus the arena verbs they drive (gather/scatter/ensure/
+        # materialize) and the store's host-facing row interface
+        # (get/set/snapshot/load_snapshot)
+        return name.lstrip("_").startswith(
+            (
+                "push",
+                "pull",
+                "apply",
+                "gather",
+                "scatter",
+                "ensure",
+                "materialize",
+                "get",
+                "set",
+                "snapshot",
+                "load",
+            )
+        )
 
     def _feeds_json_loads(self, ctx, node):
         """True for ``json.loads(bytes(view[...]))`` — a header-sized
@@ -1282,21 +1320,44 @@ class CopyOnWireRule(Rule):
             return "bytes(...) materializes the whole value"
         return None
 
+    def _why_device(self, node):
+        """Device-scope-only finding: a bare ``.copy()`` is a full host
+        round-trip when the receiver is (a host view of) a device
+        buffer — the arena plane's payloads must never grow one."""
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "copy"
+            and not node.args
+            and not node.keywords
+        ):
+            return (
+                ".copy() host-duplicates the payload — device-shard "
+                "rows/params stay resident (ratchet the deliberate "
+                "host sites: snapshot drain, host-mode writeback)"
+            )
+        return None
+
     def check(self, ctx):
         if not self._in_scope(ctx.path):
             return []
         method_scoped = ctx.path in self.METHOD_SCOPED_FILES
+        device_scoped = ctx.path in self.DEVICE_SCOPED_FILES
         out = []
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if method_scoped:
+            if method_scoped or device_scoped:
                 fn = ctx.enclosing(
                     node, (ast.FunctionDef, ast.AsyncFunctionDef)
                 )
-                if fn is None or not self._data_plane_fn(fn.name):
+                in_plane = self._device_plane_fn if device_scoped else (
+                    self._data_plane_fn
+                )
+                if fn is None or not in_plane(fn.name):
                     continue
             why = self._why(ctx, node)
+            if why is None and device_scoped:
+                why = self._why_device(node)
             if why:
                 out.append(
                     self.finding(

@@ -70,6 +70,7 @@ _LOCKTRACE_SUITES = {
     "test_dense_sharding",
     "test_comm_plane",
     "test_ps_snapshot",
+    "test_ps_device_parity",
     "test_chaos",
     "test_master_journal",
     "test_serving",

@@ -1606,6 +1606,72 @@ def test_r10_flags_host_staging_of_device_arrays(tmp_path):
     )
 
 
+R10_DEVICE_HOST_ROUNDTRIP = '''
+import jax
+import numpy as np
+
+
+class OptimizerWrapper:
+    def apply_sparse_gradients(self, layer, ids, values):
+        # three host round-trips the device apply plane must not grow:
+        # a bare asarray staging pass, a device_get materialization,
+        # and a plain .copy() of rows that should stay resident
+        staged = np.asarray(values)
+        drained = jax.device_get(staged)
+        return drained.copy()
+'''
+
+R10_DEVICE_RESIDENT = '''
+import numpy as np
+
+
+class OptimizerWrapper:
+    def apply_sparse_gradients(self, layer, ids, values):
+        # the resident idiom: typed decode of the index vector (a view
+        # unless the dtype differs), payload handed to the compiled
+        # step as-is — no staging pass, no host duplicate
+        idx = np.asarray(ids, dtype=np.int64)
+        return self._sparse_step_jit(values, idx)
+
+    def _stats_row_histogram(self, rows):
+        # non-data-plane helpers may copy freely: the contract is
+        # about payload bytes on the apply path
+        return np.asarray(rows).copy()
+'''
+
+
+def test_r10_device_scope_flags_host_roundtrips(tmp_path):
+    # the device-shard extension (docs/ps_device.md): inside the
+    # push/pull/apply/gather/scatter bodies of the device store and
+    # optimizer wrapper, bare np.asarray, jax.device_get AND .copy()
+    # are findings — a payload must stay device-resident end to end
+    bad = _lint(
+        tmp_path,
+        R10_DEVICE_HOST_ROUNDTRIP,
+        relpath="elasticdl_tpu/ps/optimizer_wrapper.py",
+    )
+    assert _rules_of(bad) == ["R10"] and len(bad) == 3, bad
+    messages = "\n".join(v.message for v in bad)
+    assert "np.asarray" in messages
+    assert "jax.device_get" in messages
+    assert ".copy() host-duplicates" in messages
+    # the resident idiom is clean, and out-of-plane helpers may copy
+    assert not _lint(
+        tmp_path,
+        R10_DEVICE_RESIDENT,
+        relpath="elasticdl_tpu/ps/optimizer_wrapper.py",
+    )
+    # the .copy() check is device-scope-only: the host PSClient data
+    # plane keeps its audited-retention .copy() sites un-flagged
+    assert not _lint(
+        tmp_path,
+        "class PSClient:\n"
+        "    def push_gradient(self, t):\n"
+        "        return t.values.copy()\n",
+        relpath="elasticdl_tpu/worker/ps_client.py",
+    )
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: the AST cache and --json
 # ---------------------------------------------------------------------------

@@ -1,0 +1,384 @@
+"""Host-shard vs device-shard BITWISE parity (docs/ps_device.md).
+
+The device-resident PS store (``Parameters(device=True)``) must be an
+invisible swap: the same RPC sequence through a host shard and a device
+shard yields bitwise-equal pulled params, embedding rows, slot tables,
+versions, and delta-log contents. The mechanism is shared compiled
+step functions (ps/optimizer_wrapper.py module docstring: XLA contracts
+FMAs inside a jit, so eager-vs-jit is NOT bitwise — both planes
+therefore run ONE executable and differ only in storage), plus
+id-seeded lazy init (ps/embedding_table._make_initializer) so fresh
+rows are a pure function of their ids.
+
+Every assert here is ``array_equal``/``==`` — no tolerances. If one of
+these starts failing by ~1 ulp, a storage path stopped sharing the
+compiled step (or a host round-trip crept into the device plane; edlint
+R10's device scope polices that statically).
+
+The SIGKILL drill at the bottom runs the crash/restore protocol from
+test_ps_fleet_recovery against live subprocess fleets in BOTH modes and
+pins that restored state and post-restore training stay bitwise equal.
+"""
+
+import glob
+import os
+import signal
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.common.tensor import Tensor
+from elasticdl_tpu.ps.parameters import EmbeddingTableInfo, Parameters
+from elasticdl_tpu.ps.servicer import PserverServicer
+from tests.fake_ps import free_port
+from tests.test_ps_fleet_recovery import (
+    _client,
+    _spawn_ps,
+    _stop,
+    _wait_port,
+)
+
+
+def _make_pair(use_async=True, grads_to_wait=1, opt=None):
+    """(host servicer, device servicer) with independent adam states."""
+    pair = []
+    for device in (False, True):
+        params = Parameters(device=device)
+        pair.append(
+            PserverServicer(
+                params,
+                grads_to_wait,
+                (opt or optax.adam)(1e-3),
+                use_async=use_async,
+            )
+        )
+    return pair
+
+
+def _push_model(servicer, dense, dim=8, initializer="normal"):
+    servicer.push_model(
+        {
+            "version": 0,
+            "params": [Tensor(n, v.copy()) for n, v in dense.items()],
+            "embedding_infos": [
+                {"name": "emb", "dim": dim, "initializer": initializer}
+            ],
+        }
+    )
+
+
+def _training_stream(steps=6, dim=8, seed=3):
+    """Deterministic dense+sparse gradient stream; odd steps carry
+    duplicate ids (the segment-sum combine branch), even steps are
+    duplicate-free (the reorder branch)."""
+    rng = np.random.default_rng(seed)
+    stream = []
+    for step in range(steps):
+        ids = rng.choice(
+            50, size=12, replace=(step % 2 == 1)
+        ).astype(np.int64)
+        stream.append(
+            {
+                "w": rng.standard_normal((16, 8)).astype(np.float32),
+                "b": rng.standard_normal((8,)).astype(np.float32),
+                "ids": ids,
+                "rows": rng.standard_normal((12, dim)).astype(np.float32),
+            }
+        )
+    return stream
+
+
+def _drive(servicer, stream):
+    for step, g in enumerate(stream):
+        resp = servicer.push_gradient(
+            {
+                "model_version": step,
+                "gradients": [
+                    Tensor("w", g["w"].copy()),
+                    Tensor("b", g["b"].copy()),
+                    Tensor(
+                        "emb", g["rows"].copy(), indices=g["ids"].copy()
+                    ),
+                ],
+            }
+        )
+        assert resp["accepted"]
+
+
+def _pulled_state(servicer, ids):
+    pull = servicer.pull_variable({})
+    dense = {t.name: np.asarray(t.values) for t in pull["params"]}
+    rows = np.asarray(
+        servicer.pull_embedding_vector({"name": "emb", "ids": ids})["rows"]
+    )
+    delta = servicer.pull_embedding_delta(
+        {"name": "emb", "since_version": -1}
+    )
+    serving = servicer.serving_status({})
+    return pull["version"], dense, rows, delta, serving
+
+
+def _assert_bitwise_state(host, device):
+    hv, hd, hr, hdelta, hserv = host
+    dv, dd, dr, ddelta, dserv = device
+    assert hv == dv
+    assert hd.keys() == dd.keys()
+    for name in hd:
+        assert np.array_equal(hd[name], dd[name]), name
+    assert np.array_equal(hr, dr)
+    assert np.array_equal(
+        np.asarray(hdelta["ids"]), np.asarray(ddelta["ids"])
+    )
+    assert hdelta["version"] == ddelta["version"]
+    assert hdelta["complete"] == ddelta["complete"]
+    assert hserv["tables"] == dserv["tables"]
+    assert hserv["floors"] == dserv["floors"]
+    assert hserv["version"] == dserv["version"]
+
+
+def _assert_tables_bitwise(host_params, device_params):
+    """Every table — embedding AND optimizer slots — row-for-row
+    bitwise, including insertion order of the materialized ids."""
+    assert (
+        host_params.embedding_params.keys()
+        == device_params.embedding_params.keys()
+    )
+    for name, host_table in host_params.embedding_params.items():
+        h_ids, h_rows = host_table.snapshot()
+        d_ids, d_rows = device_params.embedding_params[name].snapshot()
+        assert np.array_equal(h_ids, d_ids), name
+        assert np.array_equal(h_rows, d_rows), name
+
+
+def test_async_rpc_parity_bitwise():
+    host, device = _make_pair(use_async=True)
+    dense0 = {
+        "w": np.arange(128, dtype=np.float32).reshape(16, 8) / 7.0,
+        "b": np.linspace(-1.0, 1.0, 8, dtype=np.float32),
+    }
+    stream = _training_stream()
+    ids = np.arange(60, dtype=np.int64)  # includes never-pushed ids
+    for servicer in (host, device):
+        _push_model(servicer, dense0)
+        _drive(servicer, stream)
+    _assert_bitwise_state(
+        _pulled_state(host, ids), _pulled_state(device, ids)
+    )
+    _assert_tables_bitwise(host._parameters, device._parameters)
+
+
+def test_sync_mode_parity_bitwise():
+    """grads_to_wait=2 averaging + the stale-drop branch behave the
+    same on both planes."""
+    host, device = _make_pair(use_async=False, grads_to_wait=2)
+    dense0 = {"w": np.full((16, 8), 0.25, np.float32)}
+    rng = np.random.default_rng(11)
+    pushes = []
+    for _ in range(4):
+        pushes.append(
+            (
+                rng.standard_normal((16, 8)).astype(np.float32),
+                rng.integers(0, 30, size=10).astype(np.int64),
+                rng.standard_normal((10, 8)).astype(np.float32),
+            )
+        )
+    for servicer in (host, device):
+        servicer.push_model(
+            {
+                "version": 0,
+                "params": [Tensor("w", dense0["w"].copy())],
+                "embedding_infos": [{"name": "emb", "dim": 8}],
+            }
+        )
+        for g_w, ids, rows in pushes:
+            servicer.push_gradient(
+                {
+                    "model_version": servicer._parameters.version,
+                    "gradients": [
+                        Tensor("w", g_w.copy()),
+                        Tensor("emb", rows.copy(), indices=ids.copy()),
+                    ],
+                }
+            )
+    ids = np.arange(30, dtype=np.int64)
+    _assert_bitwise_state(
+        _pulled_state(host, ids), _pulled_state(device, ids)
+    )
+    _assert_tables_bitwise(host._parameters, device._parameters)
+
+
+def test_snapshot_drain_bitwise_and_cross_mode_restore():
+    """The device->disk drain produces byte-identical snapshot state,
+    and a snapshot is MODE-PORTABLE: host-captured state restored into
+    a device store (and vice versa) serves bitwise-identically — a
+    fleet can flip --ps_device across a relaunch without a reset."""
+    host, device = _make_pair(use_async=True)
+    dense0 = {
+        "w": np.ones((16, 8), np.float32),
+        "b": np.zeros((8,), np.float32),
+    }
+    stream = _training_stream(steps=4)
+    for servicer in (host, device):
+        _push_model(servicer, dense0)
+        _drive(servicer, stream)
+
+    h_state = host._parameters.snapshot_state()
+    d_state = device._parameters.snapshot_state()
+    assert h_state["version"] == d_state["version"]
+    assert h_state["dense"].keys() == d_state["dense"].keys()
+    for name in h_state["dense"]:
+        assert np.array_equal(h_state["dense"][name], d_state["dense"][name])
+    assert h_state["tables"].keys() == d_state["tables"].keys()
+    for name in h_state["tables"]:
+        for key in ("ids", "rows"):
+            assert np.array_equal(
+                h_state["tables"][name][key], d_state["tables"][name][key]
+            ), (name, key)
+
+    # cross-mode restore: host capture -> device store, device capture
+    # -> host store; both must serve what the originals serve
+    crossed = []
+    for state, into_device in ((h_state, True), (d_state, False)):
+        params = Parameters(device=into_device)
+        params.restore_state(state)
+        crossed.append(
+            PserverServicer(params, 1, optax.adam(1e-3), use_async=True)
+        )
+    ids = np.arange(60, dtype=np.int64)
+    baseline = _pulled_state(host, ids)
+    for servicer in crossed:
+        version, dense, rows, _, _ = _pulled_state(servicer, ids)
+        assert version == baseline[0]
+        for name in baseline[1]:
+            assert np.array_equal(dense[name], baseline[1][name])
+        assert np.array_equal(rows, baseline[2])
+
+
+def test_lazy_init_rows_bitwise_across_modes_any_order():
+    """Fresh-row materialization is a pure function of the id on BOTH
+    planes: pulling disjoint id sets in opposite orders still mints
+    bitwise-equal rows (the id-seeded initializer contract)."""
+    for initializer in ("normal", "uniform"):
+        host = Parameters(device=False)
+        device = Parameters(device=True)
+        infos = [EmbeddingTableInfo("emb", 6, initializer)]
+        host.init_from_model(0, {}, infos)
+        device.init_from_model(0, {}, infos)
+        first = np.asarray([9, 3, 27], dtype=np.int64)
+        second = np.asarray([0, 27, 14], dtype=np.int64)
+        host.get_embedding_param("emb", first)
+        device.get_embedding_param("emb", second)  # opposite order
+        everything = np.asarray([0, 3, 9, 14, 27], dtype=np.int64)
+        assert np.array_equal(
+            host.get_embedding_param("emb", everything),
+            device.get_embedding_param("emb", everything),
+        ), initializer
+
+
+def _wait_snapshot(snap_dir, ps_id, version, timeout=60):
+    """Poll until the cadence snapshot for ``version`` is PUBLISHED —
+    the drill must not race the async writer, or the two fleets could
+    roll back to different versions and the comparison means nothing."""
+    want = os.path.join(snap_dir, "ps-%d" % ps_id, "snap_v%d" % version)
+    deadline = time.time() + timeout
+    while not glob.glob(want):
+        assert time.time() < deadline, "snapshot v%d never published" % version
+        time.sleep(0.2)
+
+
+def _run_fleet_drill(tmp_path, mode, extra_mode_args):
+    """One single-shard live fleet: train, wait for the snapshot,
+    SIGKILL, relaunch, pull restored state, train more, pull again."""
+    snap_dir = str(tmp_path / ("snaps-" + mode))
+    extra = [
+        "--ps_snapshot_versions", "1",
+        "--ps_snapshot_dir", snap_dir,
+    ] + list(extra_mode_args)
+    port = free_port()
+    proc = _spawn_ps(0, port, extra=extra, log_dir=str(tmp_path))
+    try:
+        _wait_port(proc, port)
+        client = _client([port])
+        try:
+            client.push_model(
+                {"w": np.full((3, 3), 1.5, np.float32)},
+                [EmbeddingTableInfo("emb", 4)],
+            )
+            ids = np.arange(8, dtype=np.int64)
+            client.pull_embedding_vectors("emb", ids)
+            for i in range(3):
+                client.push_gradient(
+                    {"w": np.full((3, 3), 0.125, np.float32)},
+                    [
+                        Tensor(
+                            "emb",
+                            np.ones((8, 4), np.float32) * (i + 1),
+                            indices=ids,
+                        )
+                    ],
+                    i,
+                )
+            client.drain()
+            ok, version, _ = client.pull_dense()
+            assert ok
+            _wait_snapshot(snap_dir, 0, version)
+
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            proc = _spawn_ps(0, port, extra=extra, log_dir=str(tmp_path))
+            _wait_port(proc, port)
+
+            status = client._ps[0].ps_status({})
+            assert status["initialized"] is True
+            assert status["restored_version"] == version
+            ok, got_version, dense_restored = client.pull_dense()
+            assert ok and got_version == version
+            rows_restored = client.pull_embedding_vectors("emb", ids)
+
+            # training continues against the restored shard
+            client.push_gradient(
+                {"w": np.full((3, 3), -0.25, np.float32)},
+                [
+                    Tensor(
+                        "emb",
+                        np.full((8, 4), 0.5, np.float32),
+                        indices=ids,
+                    )
+                ],
+                got_version,
+            )
+            client.drain()
+            ok, final_version, dense_final = client.pull_dense()
+            assert ok and final_version == version + 1
+            rows_final = client.pull_embedding_vectors("emb", ids)
+            return (
+                version,
+                dense_restored,
+                rows_restored,
+                dense_final,
+                rows_final,
+            )
+        finally:
+            client.close()
+    finally:
+        _stop([proc])
+
+
+def test_sigkill_snapshot_relaunch_drill_bitwise(tmp_path):
+    """The full crash protocol — SIGKILL, snapshot restore, reconnect,
+    continued training — leaves a device shard bitwise-identical to a
+    host shard run through the same drill."""
+    host = _run_fleet_drill(tmp_path, "host", [])
+    device = _run_fleet_drill(
+        tmp_path, "device", ["--ps_device", "true"]
+    )
+    assert host[0] == device[0]
+    for h, d in zip(host[1:], device[1:]):
+        if isinstance(h, dict):
+            assert h.keys() == d.keys()
+            for name in h:
+                assert np.array_equal(h[name], d[name]), name
+        else:
+            assert np.array_equal(np.asarray(h), np.asarray(d))

@@ -30,6 +30,28 @@ def test_embedding_table_lazy_init():
     np.testing.assert_array_equal(again[0], rows[1])  # stable rows
 
 
+def test_lazy_init_is_order_independent():
+    """Fresh rows are a pure function of (id, initializer, seed) — NOT
+    of materialization order. Two tables pulling the same ids in
+    opposite orders (and interleaved with other ids) mint bitwise-equal
+    rows. This pins the id-seeded initializer contract the device
+    arena's vectorized fill relies on (docs/ps_device.md); the old
+    shared-rng initializer made row values depend on every pull that
+    came before."""
+    for initializer in ("uniform", "normal"):
+        a = create_embedding_table("emb", 4, initializer)
+        b = create_embedding_table("emb", 4, initializer)
+        a.get([11, 2, 300])
+        a.get([5])
+        b.get([5, 300])
+        b.get([2])
+        b.get([11])
+        everything = [2, 5, 11, 300]
+        np.testing.assert_array_equal(
+            a.get(everything), b.get(everything)
+        ), initializer
+
+
 def test_embedding_table_set_and_slot_name():
     t = EmbeddingTable("emb", 2)
     t.set([5], np.array([[1.0, 2.0]], dtype=np.float32))
@@ -135,3 +157,31 @@ def test_dense_gradient_apply():
     w = OptimizerWrapper(optax.sgd(1.0), p)
     w.apply_dense_gradients({"w": np.full((2, 2), 0.25, np.float32)})
     np.testing.assert_allclose(p.non_embedding_params["w"], 0.75)
+
+
+def test_dense_absent_params_use_cached_zero_grads():
+    """A param absent from a push still steps (stateful optimizers
+    decay its moments) through ONE cached zero gradient — not a fresh
+    ``np.zeros_like`` allocation per absent param per apply."""
+    p = Parameters()
+    p.init_from_model(
+        0,
+        {
+            "w": np.ones((2, 2), np.float32),
+            "v": np.full((3,), 2.0, np.float32),
+        },
+        [],
+    )
+    w = OptimizerWrapper(optax.adam(0.1), p)
+    for step in range(3):
+        w.apply_dense_gradients({"w": np.full((2, 2), 0.5, np.float32)})
+        # the cache holds exactly the absent param, and re-applies the
+        # SAME array object every round
+        assert set(w._zero_grads) == {"v"}
+        cached = w._zero_grads["v"]
+        if step == 0:
+            first = cached
+        assert cached is first
+    # zero gradient => adam moves nothing on the absent param
+    np.testing.assert_allclose(p.non_embedding_params["v"], 2.0)
+    assert not np.allclose(p.non_embedding_params["w"], 1.0)
