@@ -1195,6 +1195,45 @@ def bench_ps_device(quick=False):
     )
 
 
+def bench_tiered(quick=False):
+    """Tiered embedding store (docs/tiered_store.md): a bitwise
+    equivalence pre-pass (all-in-memory vs tiered PS shard from one
+    common init), then the deepfm fleet job on a power-law id stream
+    whose resident feature rows exceed the warm-tier budget 4x — the
+    tiered arm must hold EDL_BENCH_TIERED_FLOOR (default 0.5x) of the
+    all-in-memory arm's throughput while the ps_status counters prove
+    the disk tier was actually exercised. CPU-forced subprocess (same
+    containment as --ps). Returns the _bench_tiered_impl dict."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench, json\n"
+        "print('PSBENCH ' + json.dumps(bench._bench_tiered_impl(%r)))\n"
+    ) % (here, quick)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=1800,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(
+            "tiered bench timed out:\n%s" % str(e.stdout or "")[-2000:]
+        ) from e
+    for line in proc.stdout.splitlines():
+        if line.startswith("PSBENCH "):
+            return json.loads(line[len("PSBENCH "):])
+    raise RuntimeError(
+        "tiered bench failed:\n"
+        + proc.stdout[-2000:]
+        + proc.stderr[-2000:]
+    )
+
+
 def _bench_ps_device_impl(quick=False):
     """Measure the device-resident shard against the host shard on the
     two apply shapes that dominate a PS deployment (docs/ps_device.md):
@@ -1937,6 +1976,249 @@ def _bench_ps_fanout_microbench(quick=False):
         "fanout_slowest_shard_s": slow_s,
         "fanout_shard_sum_s": fast_s * (shards - 1) + slow_s,
     }
+
+
+def _bench_tiered_equivalence(quick, tmp):
+    """Bitwise equivalence pre-pass: one all-in-memory and one tiered
+    PS shard, in-process, driven from ONE common init (the splitmix64
+    id-keyed lazy init makes both arms mint identical rows) through an
+    identical power-law lookup/push stream. The tiered arm runs a tiny
+    warm budget so promotion/demotion churns on every step; lookups,
+    applied rows, and the final full-table read must all match bitwise
+    — a tier move that drops, duplicates or stales a single row fails
+    here before any throughput is measured."""
+    import optax
+
+    from elasticdl_tpu.common.tensor import Tensor
+    from elasticdl_tpu.ps.parameters import Parameters
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    dim, warm_rows, pool_n = 16, 64, 512
+    steps = 8 if quick else 24
+    rng = np.random.default_rng(11)
+    pool = rng.permutation(5383)[:pool_n]
+    w = 1.0 / np.arange(1, pool_n + 1) ** 1.2
+    w /= w.sum()
+    stream = [
+        np.unique(rng.choice(pool, size=96, p=w)).astype(np.int64)
+        for _ in range(steps)
+    ]
+    grads = [
+        rng.standard_normal((len(ids), dim)).astype(np.float32)
+        for ids in stream
+    ]
+
+    def mk(tier):
+        p = Parameters(tier_config=tier)
+        s = PserverServicer(p, 1, optax.adam(0.05), use_async=True)
+        s.push_model(
+            {
+                "version": 0,
+                "params": [Tensor("w", np.ones((4, 4), np.float32))],
+                "embedding_infos": [{"name": "emb", "dim": dim}],
+            }
+        )
+        return p, s
+
+    def rows_of(s, ids):
+        return np.asarray(
+            s.pull_embedding_vector({"name": "emb", "ids": ids})["rows"]
+        )
+
+    p_mem, s_mem = mk(None)
+    p_tier, s_tier = mk(
+        {
+            "warm_rows": warm_rows,
+            "spill_dir": os.path.join(tmp, "eq-spill"),
+        }
+    )
+    verdict = {"lookups": True, "applied_rows": True, "full_table": True}
+    try:
+        for step, (ids, g) in enumerate(zip(stream, grads)):
+            if not np.array_equal(rows_of(s_mem, ids), rows_of(s_tier, ids)):
+                verdict["lookups"] = False
+            req = {
+                "model_version": step,
+                "gradients": [Tensor("emb", g, indices=ids)],
+            }
+            s_mem.push_gradient(dict(req))
+            s_tier.push_gradient(dict(req))
+            if not np.array_equal(rows_of(s_mem, ids), rows_of(s_tier, ids)):
+                verdict["applied_rows"] = False
+        # force the disk tier into play before the full-table read: the
+        # pre-pass must prove equivalence ACROSS a tier crossing, not
+        # on a lucky all-warm run
+        table = p_tier.embedding_params["emb"]
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if table.stats()["disk_rows"] > 0:
+                break
+            table.signal_pressure()
+            time.sleep(0.02)
+        every = np.sort(np.unique(np.concatenate(stream)))
+        if not np.array_equal(rows_of(s_mem, every), rows_of(s_tier, every)):
+            verdict["full_table"] = False
+        st = table.stats()
+        verdict["spilled"] = st["spilled_rows"] > 0
+        verdict["cold_pulled"] = st["cold_pull_rows"] > 0
+    finally:
+        p_tier.close()
+        p_mem.close()
+    verdict["ok"] = all(verdict.values())
+    return verdict
+
+
+def _bench_tiered_impl(quick=False):
+    """Equivalence pre-pass (in-process), then the A/B fleet drive:
+    the SAME deepfm job on a zipf id stream against (a) an untiered
+    2-process PS fleet and (b) the same fleet with --ps_warm_rows /
+    --ps_spill_dir sized so the resident feature rows are >= 4x the
+    warm budget. Returns throughputs plus the summed ps_status
+    'tiered' counters of the tiered fleet — the caller gates on them
+    (spilled_rows > 0, cold_pull_rows > 0) plus the per-shard
+    distinct-id counts proving the table outgrows the warm budget."""
+    import tempfile
+
+    _force_cpu_backend()
+    _reap_stale_fleet()
+
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.master.checkpoint_service import CheckpointService
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tests.in_process_master import InProcessMaster
+    from tests.test_utils import MODEL_ZOO_PATH
+
+    batch = 32
+    records = 256 if quick else 2048
+    warm_rows = 64
+    pool_n = 2048
+    model_def = "deepfm_edl_embedding.deepfm_edl_embedding.custom_model"
+    model_params = "embedding_dim=16,fc_unit=16,vocab_size=5383"
+
+    def zipf_frappe_file(n, tmp, name):
+        """FRAPPE-schema file, ids zipf-drawn from a pool far larger
+        than the warm budget: the head stays warm, the long tail
+        spills and recurs — the disk-tier workload shape. Returns
+        (path, per-shard distinct-id counts) so the caller can PROVE
+        the feature table outgrows the warm tier on every shard
+        (PSClient routes id -> id %% num_ps)."""
+        from elasticdl_tpu.data.example import encode_example
+        from elasticdl_tpu.data.recordio import RecordIOWriter
+
+        rng = np.random.default_rng(13)
+        pool = rng.permutation(5383)[:pool_n]
+        w = 1.0 / np.arange(1, pool_n + 1) ** 1.05
+        w /= w.sum()
+        path = os.path.join(tmp, "%s_%d.edlr" % (name, n))
+        seen = set()
+        with RecordIOWriter(path) as f:
+            for _ in range(n):
+                ids = rng.choice(pool, size=(10,), p=w).astype(np.int64)
+                seen.update(int(i) for i in ids)
+                f.write(
+                    encode_example(
+                        {
+                            "feature": ids,
+                            "label": np.array(
+                                [rng.integers(2)], dtype=np.int64
+                            ),
+                        }
+                    )
+                )
+        per_shard = [
+            sum(1 for i in seen if i % 2 == s) for s in range(2)
+        ]
+        return path, per_shard
+
+    def run_job(addrs, data, n):
+        shards = {data: (0, n)}
+        task_d = TaskDispatcher(shards, {}, {}, batch * 4, 1)
+        master = MasterServicer(
+            1,
+            batch,
+            None,
+            task_d,
+            checkpoint_service=CheckpointService("", 0, 0, False),
+            use_async=True,
+        )
+        ps_client = PSClient([BoundPS(a) for a in addrs])
+        worker = Worker(
+            worker_id=1,
+            job_type=JobType.TRAINING_ONLY,
+            minibatch_size=batch,
+            model_zoo=MODEL_ZOO_PATH,
+            model_def=model_def,
+            model_params=model_params,
+            ps_client=ps_client,
+            sparse_dedup=True,
+        )
+        worker._stub = InProcessMaster(master)
+        t0 = time.perf_counter()
+        try:
+            worker.run()
+        finally:
+            ps_client.close()
+        dt = time.perf_counter() - t0
+        if not task_d.finished():
+            raise RuntimeError("tiered bench job did not finish")
+        return n / dt
+
+    def probe_tiered(addrs):
+        """Summed ps_status 'tiered' counters + the per-shard list."""
+        shards = []
+        for a in addrs:
+            c = BoundPS(a, deadline_s=10.0)
+            try:
+                shards.append(dict(c.ps_status({}).get("tiered") or {}))
+            finally:
+                c.close()
+        total = {}
+        for st in shards:
+            for k, v in st.items():
+                if isinstance(v, (int, float)):
+                    total[k] = total.get(k, 0) + v
+        return total, shards
+
+    results = {"warm_rows": warm_rows, "pool_ids": pool_n}
+    with tempfile.TemporaryDirectory() as tmp:
+        results["equivalence"] = _bench_tiered_equivalence(quick, tmp)
+        if not results["equivalence"]["ok"]:
+            return results  # no point timing a wrong store
+
+        f, per_shard = zipf_frappe_file(records, tmp, "zipf")
+        warm_f, _ = zipf_frappe_file(batch * 4, tmp, "zipf_warm")
+        results["distinct_rows_per_shard"] = per_shard
+        arms = {
+            "examples_per_sec_memory": [],
+            "examples_per_sec_tiered": [
+                "--ps_warm_rows", str(warm_rows),
+                "--ps_spill_dir", os.path.join(tmp, "spill"),
+            ],
+        }
+        for key, extra in arms.items():
+            procs, addrs = _launch_ps_fleet(
+                tmp,
+                MODEL_ZOO_PATH,
+                model_def,
+                "tier-" + key[-6:],
+                extra_args=extra,
+            )
+            try:
+                run_job(addrs, warm_f, batch * 4)
+                results[key] = run_job(addrs, f, records)
+                if extra:
+                    total, shards = probe_tiered(addrs)
+                    results["tiered_counters"] = total
+                    results["tiered_counters_per_shard"] = shards
+            finally:
+                _stop_ps_fleet(procs)
+    return results
 
 
 def bench_chaos(quick=False):
@@ -5232,6 +5514,97 @@ def main(argv=None):
         )
         return 0
 
+    if "--tiered" in argv:
+        res = bench_tiered(quick)
+        eq = res.get("equivalence", {})
+        if not eq.get("ok"):
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_tiered_examples_per_sec",
+                        "error": "all-in-memory/tiered equivalence "
+                        "pre-pass FAILED (%s): the tiered store is not "
+                        "bitwise the same table; throughput withheld"
+                        % ", ".join(
+                            k for k, v in eq.items() if k != "ok" and not v
+                        ),
+                    }
+                )
+            )
+            return 1
+        min_distinct = min(res["distinct_rows_per_shard"])
+        if min_distinct < 4 * res["warm_rows"]:
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_tiered_examples_per_sec",
+                        "error": "workload too small to prove the tier: "
+                        "a shard sees only %d distinct feature rows "
+                        "against its %d-row warm budget (need >= 4x)"
+                        % (min_distinct, res["warm_rows"]),
+                    }
+                )
+            )
+            return 1
+        counters = res.get("tiered_counters", {})
+        spilled = counters.get("spilled_rows", 0)
+        cold = counters.get("cold_pull_rows", 0)
+        if spilled <= 0 or cold <= 0:
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_tiered_examples_per_sec",
+                        "error": "disk tier not provably exercised: "
+                        "spilled_rows=%d cold_pull_rows=%d (both must "
+                        "be > 0 in the fleet's ps_status counters)"
+                        % (spilled, cold),
+                    }
+                )
+            )
+            return 1
+        floor = float(os.environ.get("EDL_BENCH_TIERED_FLOOR", "0.5"))
+        eps_mem = res["examples_per_sec_memory"]
+        eps_tier = res["examples_per_sec_tiered"]
+        ratio = eps_tier / max(eps_mem, 1e-9)
+        if ratio < floor:
+            print(
+                json.dumps(
+                    {
+                        "metric": "ps_tiered_examples_per_sec",
+                        "error": "tiered fleet %.1f ex/s is %.2fx the "
+                        "all-in-memory fleet (%.1f ex/s) — below the "
+                        "%.2fx floor (EDL_BENCH_TIERED_FLOOR)"
+                        % (eps_tier, ratio, eps_mem, floor),
+                    }
+                )
+            )
+            return 1
+        _emit(
+            "ps_tiered_examples_per_sec",
+            round(eps_tier, 1),
+            "examples/sec, deepfm vs a 2-process PS fleet whose "
+            "per-table warm tier is %d rows against a %d-id zipf "
+            "stream putting >= %d distinct rows on each shard — >= 4x "
+            "its warm budget (%.2fx the all-in-memory fleet's %.1f "
+            "ex/s, floor %.2fx; fleet counters: %d rows spilled, %d "
+            "cold-pulled). Equivalence pre-pass: tiered arm matches "
+            "the all-in-memory arm bitwise on lookups, applied rows "
+            "and the full table from one common init, across a forced "
+            "tier crossing (rc 1 on miss; docs/tiered_store.md)"
+            % (
+                res["warm_rows"],
+                res["pool_ids"],
+                min_distinct,
+                ratio,
+                eps_mem,
+                floor,
+                spilled,
+                cold,
+            ),
+            update,
+        )
+        return 0
+
     if "--hybrid" in argv:
         res = bench_hybrid(quick)
         eq = res.get("equivalence", {})
@@ -5968,6 +6341,10 @@ def main(argv=None):
     section("wire_dense_roundtrip_speedup", ["--wire"], 300)
     section("sharded_dense_examples_per_sec", ["--sharded"], 600)
     section("ps_deepfm_examples_per_sec", ["--ps"], 900)
+    # the tiered-store gate: bitwise equivalence vs the all-in-memory
+    # shard, then the throughput floor with the disk tier provably
+    # exercised (docs/tiered_store.md)
+    section("ps_tiered_examples_per_sec", ["--tiered"], 900)
     section("ps_deepfm_examples_per_sec_hybrid", ["--hybrid"], 900)
     # the recovery-plane gates: SIGKILL one PS shard mid-job under a
     # snapshot cadence (docs/ps_recovery.md) AND SIGKILL the MASTER

@@ -294,6 +294,28 @@ def add_ps_snapshot_params(parser):
         "published",
     )
     parser.add_argument(
+        "--ps_warm_rows",
+        type=non_neg_int,
+        default=0,
+        help="Tiered store (docs/tiered_store.md): per-table warm-tier "
+        "row budget on each PS shard. Rows past the budget spill to "
+        "disk segments (coldest first, recently-applied rows pinned) "
+        "and promote back on demand, so a table can be far larger "
+        "than the shard's memory tier. 0 (default) disables; requires "
+        "--ps_spill_dir. Composes with --ps_device (the tier wraps "
+        "the arena) and with snapshots (a spill segment IS a snapshot "
+        "shard; snapshot/restore round-trips across tier configs)",
+    )
+    parser.add_argument(
+        "--ps_spill_dir",
+        default="",
+        help="Base directory for tiered-store spill segments (the "
+        "shard writes under <dir>/ps-<id>/<table>/). Needs only "
+        "shard-lifetime durability — segments are re-attached on "
+        "relaunch when present, and a cadence-snapshot restore "
+        "supersedes them",
+    )
+    parser.add_argument(
         "--ps_telemetry_port",
         type=int,
         default=-1,

@@ -182,12 +182,18 @@ def export_model(
     metadata=None,
     serving_fn=None,
     example_features=None,
+    extra_named=None,
 ):
     """Write the full artifact; returns the manifest dict.
 
     ``params`` is the model parameter pytree (host or device arrays).
     ``serving_fn(params, features) -> outputs`` plus one
     ``example_features`` batch enables the source-free serving plane.
+    ``extra_named``: additional {name: array} entries merged into the
+    LEGACY checkpoint only (the master-KV embedding-table export: the
+    prefixed keys round-trip through ``checkpoint_filename_for_init``,
+    which re-imports them into the embedding store; they are not model
+    pytree leaves, so the orbax/serving artifacts don't carry them).
     """
     import jax
 
@@ -198,10 +204,14 @@ def export_model(
     os.makedirs(export_dir, exist_ok=True)
     params = jax.tree_util.tree_map(np.asarray, params)
 
+    legacy_named = pytree_to_named_arrays(params)
+    if extra_named:
+        legacy_named = dict(legacy_named)
+        legacy_named.update(
+            {name: np.asarray(arr) for name, arr in extra_named.items()}
+        )
     legacy_path = os.path.join(export_dir, _LEGACY_CHKPT)
-    save_checkpoint_to_file(
-        pytree_to_named_arrays(params), version, legacy_path
-    )
+    save_checkpoint_to_file(legacy_named, version, legacy_path)
 
     params_path = os.path.join(export_dir, _PARAMS_DIR)
     has_params = _write_orbax_params(params_path, params, legacy_path)
@@ -222,6 +232,7 @@ def export_model(
         "created_unix": int(time.time()),
         "jax_version": jax.__version__,
         "metadata": dict(metadata or {}),
+        "extra_named": sorted(extra_named) if extra_named else [],
         "leaves": _leaf_spec(params),
         "artifacts": {
             "params": _PARAMS_DIR if has_params else None,

@@ -161,6 +161,18 @@ class MasterRpcService:
         )
         return {"rows": rows}
 
+    def export_embedding_tables(self, req):
+        """Master-central-storage embedding tables as named arrays —
+        the worker's SAVE_MODEL export pulls these to close the
+        checkpoint gap (get_model strips them by design). Shipped
+        UNCOMPRESSED on purpose: this is checkpoint material, and a
+        bf16 wire narrowing would bake rounding into the artifact."""
+        named = self._s.export_embedding_tables()
+        return {
+            "params": [Tensor(n, v) for n, v in sorted(named.items())],
+            "compressed_f32": [],
+        }
+
     def get_comm_world(self, req):
         """Membership poll for the elastic allreduce plane (no reference
         counterpart: the PS plane needs no inter-worker world)."""
@@ -233,6 +245,7 @@ class MasterRpcService:
                     "report_evaluation_metrics": self.report_evaluation_metrics,
                     "push_embedding_info": self.push_embedding_info,
                     "pull_embedding_vectors": self.pull_embedding_vectors,
+                    "export_embedding_tables": self.export_embedding_tables,
                 }.items()
             },
             role="master",
@@ -427,6 +440,12 @@ class MasterClient:
             ids=np.asarray(ids, dtype=np.int64),
         )
         return resp["rows"]
+
+    def export_embedding_tables(self):
+        """{export-prefixed name: array} of the master's embedding
+        store (SAVE_MODEL's table half in master-KV mode)."""
+        resp = self._client.call("export_embedding_tables")
+        return {t.name: t.values for t in resp.get("params", [])}
 
     def get_comm_world(self, worker_id, host="localhost", awaiting=True):
         return self._client.call(

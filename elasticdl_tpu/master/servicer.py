@@ -171,6 +171,17 @@ class MasterServicer:
             out[_EMBEDDING_EXPORT_PREFIX + name + ":rows"] = rows
         return out
 
+    def export_embedding_tables(self):
+        """The embedding store as checkpointable named arrays — the
+        worker's SAVE_MODEL path pulls these so a master-central-storage
+        export artifact carries the tables, not just the dense params
+        (without this, SAVE_MODEL silently dropped every embedding
+        table: ``get_model`` strips the export keys by design, and the
+        tables lived nowhere else). Locked: the async apply path
+        mutates the store concurrently."""
+        with self._lock:
+            return self._export_embedding_tables()
+
     def _import_embedding_tables(self, named):
         """Split embedding-export keys out of a checkpoint; returns the
         remaining dense params."""
@@ -379,9 +390,10 @@ class MasterServicer:
 
     def push_embedding_info(self, embedding_infos):
         """Register elastic embedding tables (proto EmbeddingTableInfo
-        analog, elasticdl.proto:76-80)."""
-        with self._lock:
-            self._embedding_store.init_embedding_params(embedding_infos)
+        analog, elasticdl.proto:76-80). No master lock: the store
+        installs first-write-wins under its own lock, and a tiered
+        store's table build does file IO (spill-dir reattach)."""
+        self._embedding_store.init_embedding_params(embedding_infos)
 
     def pull_embedding_vectors(self, layer_name, ids):
         """Rows for ``ids`` from the master-central store (lazy init)."""

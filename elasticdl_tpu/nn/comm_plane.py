@@ -170,6 +170,11 @@ class HotRowCache:
         self._latest = {}  # shard -> newest version seen in any response
         self.hits = 0
         self.misses = 0
+        # per-table [hits, misses, evictions] — the tiered store's
+        # admission-signal series, exported as labeled edl_cache_*
+        # counters (worker telemetry + scorer); the aggregate
+        # hits/misses attributes above stay for existing readers
+        self._table_stats = {}
 
     def note_version(self, shard, version):
         """Record a version observed in shard ``shard``'s response."""
@@ -289,19 +294,28 @@ class HotRowCache:
         with self._mu:
             return [self._get_locked(name, r) for r in row_ids]
 
+    def _table_stat_locked(self, name):
+        stat = self._table_stats.get(name)
+        if stat is None:
+            stat = self._table_stats[name] = [0, 0, 0]
+        return stat
+
     def _get_locked(self, name, row_id):
         key = (name, int(row_id))
         entry = self._rows.get(key)
         if entry is None:
             self.misses += 1
+            self._table_stat_locked(name)[1] += 1
             return None
         shard, version, row = entry
         if version < self._latest.get(shard, -1) - self._window:
             del self._rows[key]
             self.misses += 1
+            self._table_stat_locked(name)[1] += 1
             return None
         self._rows.move_to_end(key)
         self.hits += 1
+        self._table_stat_locked(name)[0] += 1
         return row
 
     def put(self, name, row_id, shard, version, row):
@@ -326,7 +340,25 @@ class HotRowCache:
         self._rows[key] = (shard, version, np.array(row, np.float32))
         self._rows.move_to_end(key)
         while len(self._rows) > self._max_rows:
-            self._rows.popitem(last=False)
+            victim_key, _ = self._rows.popitem(last=False)
+            # capacity eviction, charged to the VICTIM's table — the
+            # signal that says which table's working set is being
+            # squeezed out of the top tier
+            self._table_stat_locked(victim_key[0])[2] += 1
+
+    def table_stats(self):
+        """``{table: {"hits": n, "misses": n, "evictions": n}}`` — a
+        consistent copy of the per-table counters (the tiered store's
+        admission-policy input, exported as ``edl_cache_*{table=}``)."""
+        with self._mu:
+            return {
+                name: {
+                    "hits": stat[0],
+                    "misses": stat[1],
+                    "evictions": stat[2],
+                }
+                for name, stat in self._table_stats.items()
+            }
 
     def __len__(self):
         with self._mu:

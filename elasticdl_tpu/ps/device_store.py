@@ -15,8 +15,11 @@ arena) plus a host-side ``{id: slot}`` index, so:
   (ps/embedding_table._make_initializer) — so host and device shards
   mint bitwise-identical fresh rows in any materialization order.
 
-Capacity grows by doubling (slots are append-only between
-``clear``/``load_snapshot``); gather/scatter index vectors are padded
+Capacity grows by doubling; slot assignment draws from a free list
+(filled by ``evict_rows`` — the tiered store's demotion path) before
+advancing the high-water mark, so a table that cycles rows through the
+disk tier keeps its arena at the warm working-set size instead of
+growing with total vocabulary. Gather/scatter index vectors are padded
 to the next power of two with an out-of-range sentinel (gather
 ``mode="fill"`` returns zeros, scatter ``mode="drop"`` ignores them)
 so jit recompiles are bounded by ``log2`` of the working-set size, not
@@ -112,8 +115,10 @@ class DeviceEmbeddingTable:
         self.is_slot = is_slot
         self._initializer = _make_initializer(initializer)
         self._lock = threading.Lock()
-        self._slots = {}  # id -> arena row, append-only between resets
+        self._slots = {}  # id -> arena row
         self._arena = None  # jax.Array (capacity, dim) float32
+        self._free = []  # evicted arena rows, reused before growing
+        self._next = 0  # high-water mark: first never-assigned row
 
     # -- device plane -------------------------------------------------------
 
@@ -135,13 +140,25 @@ class DeviceEmbeddingTable:
     def _materialize_locked(self, ids, init=True):
         """Assign arena slots for unseen ids; ``init=True`` fills their
         rows from the id-seeded initializer (one vectorized scatter of
-        only the missing slots). ``ids``: iterable of python ints."""
+        only the missing slots). ``ids``: iterable of python ints.
+
+        Slots come from the free list first (rows ``evict_rows``
+        released), then from the high-water mark. A reused slot is
+        always WRITTEN before any read: this method scatters the fresh
+        init rows itself, and the ``init=False`` caller (``set``)
+        scatters the caller's values in the same lock hold."""
         missing = [i for i in dict.fromkeys(ids) if i not in self._slots]
         if not missing:
             return
-        base = len(self._slots)
         m = len(missing)
-        self._grow_locked(base + m)
+        alloc = []
+        while self._free and len(alloc) < m:
+            alloc.append(self._free.pop())
+        fresh_n = m - len(alloc)
+        if fresh_n:
+            self._grow_locked(self._next + fresh_n)
+            alloc.extend(range(self._next, self._next + fresh_n))
+            self._next += fresh_n
         if init:
             gather, scatter, _ = _jitted()
             m_pad = next_pow2(m)
@@ -149,14 +166,12 @@ class DeviceEmbeddingTable:
             fresh[:m] = self._initializer(
                 np.asarray(missing, dtype=np.int64), self.dim
             )
-            idx = _pad_idx(
-                np.arange(base, base + m, dtype=np.int32), m_pad
-            )
+            idx = _pad_idx(np.asarray(alloc, dtype=np.int32), m_pad)
             self._arena = scatter(
                 self._arena, idx, device_from_host_view(fresh)
             )
         for pos, i in enumerate(missing):
-            self._slots[i] = base + pos
+            self._slots[i] = alloc[pos]
 
     def ensure_rows(self, unique_ids):
         """Slots for ``unique_ids`` (materializing missing rows with
@@ -251,27 +266,56 @@ class DeviceEmbeddingTable:
         with self._lock:
             self._slots = {}
             self._arena = None
+            self._free = []
+            self._next = 0
+
+    def missing_ids(self, indices):
+        """The subset of ``indices`` with no arena slot — a pure
+        membership probe, NO lazy init (the tiered store uses this to
+        route ids without minting fresh rows)."""
+        with self._lock:
+            return [int(i) for i in indices if int(i) not in self._slots]
+
+    def evict_rows(self, indices):
+        """Release the given rows' arena slots onto the free list
+        (tiered-store demotion: the caller sealed them into a disk
+        segment first). Returns the number released. No arena write
+        happens here — a freed slot is unreachable (its id left the
+        index) and every reuse path writes it before any read."""
+        dropped = 0
+        with self._lock:
+            for i in indices:
+                slot = self._slots.pop(int(i), None)
+                if slot is not None:
+                    self._free.append(slot)
+                    dropped += 1
+        return dropped
 
     def snapshot(self):
         """Consistent (ids, rows) HOST COPY of every materialized row —
         the device->disk drain's capture half (docs/ps_device.md).
 
-        Slots are append-only, so rows live contiguously in
-        ``arena[:n]`` in insertion order; one batched ``device_get``
-        under the table lock drains them. The explicit ``.copy()`` is
-        load-bearing: a CPU ``device_get`` may alias the arena buffer,
+        One batched ``device_get`` under the table lock, then a fancy
+        index in slot order (slots are free-list-recycled, so rows are
+        NOT contiguous). The fancy index materializes a fresh buffer,
+        which matters: a CPU ``device_get`` may alias the arena buffer,
         which the very next apply DONATES."""
         import jax
 
         with self._lock:
             n = len(self._slots)
+            if n == 0 or self._arena is None:
+                ids = np.fromiter(
+                    self._slots.keys(), dtype=np.int64, count=n
+                )
+                return ids, np.zeros((0, int(self.dim or 0)), np.float32)
             ids = np.fromiter(
                 self._slots.keys(), dtype=np.int64, count=n
             )
-            if n == 0 or self._arena is None:
-                rows = np.zeros((0, int(self.dim or 0)), np.float32)
-            else:
-                rows = jax.device_get(self._arena)[:n].copy()
+            slots = np.fromiter(
+                self._slots.values(), dtype=np.int64, count=n
+            )
+            rows = jax.device_get(self._arena)[slots]
         return ids, rows
 
     def load_snapshot(self, ids, rows):
@@ -284,6 +328,8 @@ class DeviceEmbeddingTable:
         with self._lock:
             self._slots = {}
             self._arena = None
+            self._free = []
+            self._next = 0
             if not ids:
                 return
             self._grow_locked(len(ids))
@@ -297,6 +343,7 @@ class DeviceEmbeddingTable:
                 device_from_host_view(padded),
             )
             self._slots = {i: pos for pos, i in enumerate(ids)}
+            self._next = len(ids)
 
     def __len__(self):
         return len(self._slots)

@@ -141,6 +141,30 @@ class EmbeddingTable:
         with self._lock:
             self.embedding_vectors.clear()
 
+    def missing_ids(self, indices):
+        """The subset of ``indices`` with no materialized row — a pure
+        membership probe, NO lazy init (the tiered store uses this to
+        route ids without minting fresh rows)."""
+        with self._lock:
+            return [
+                int(i)
+                for i in indices
+                if int(i) not in self.embedding_vectors
+            ]
+
+    def evict_rows(self, indices):
+        """Drop the given rows from the store (tiered-store demotion:
+        the caller has already sealed them into a disk segment).
+        Returns the number actually dropped. A later lookup of an
+        evicted id lazy-inits again UNLESS a tier above intercepts it —
+        which is exactly the tiered store's contract."""
+        dropped = 0
+        with self._lock:
+            for i in indices:
+                if self.embedding_vectors.pop(int(i), None) is not None:
+                    dropped += 1
+        return dropped
+
     def snapshot(self):
         """Consistent (ids, rows) copy of every materialized row.
 

@@ -375,6 +375,14 @@ class OptimizerWrapper:
                     else:
                         non_row[key] = leaf
 
+        # post-apply boundary, OUTSIDE the apply lock: a tiered table
+        # (docs/tiered_store.md) wakes its background demoter here —
+        # an Event.set, never IO, so the apply hot path stays clean
+        for t in (table, *slot_tables.values()):
+            pressure = getattr(t, "signal_pressure", None)
+            if pressure is not None:
+                pressure()
+
     def apply_gradients(self, dense_grads=None, embedding_grads=None):
         """Combined apply: {name: ndarray} dense + {layer: Tensor} sparse."""
         if dense_grads:

@@ -46,7 +46,29 @@ class ParameterServer:
         # (docs/ps_device.md); everything downstream — snapshots, the
         # delta log, the RPC protocol — is mode-agnostic
         self.ps_device = bool(getattr(args, "ps_device", False))
-        self.parameters = Parameters(device=self.ps_device)
+        # --ps_warm_rows + --ps_spill_dir: tiered store
+        # (docs/tiered_store.md) — tables spill cold rows past the
+        # per-table warm budget to disk segments under the spill dir
+        warm_rows = int(getattr(args, "ps_warm_rows", 0) or 0)
+        spill_dir = getattr(args, "ps_spill_dir", "") or ""
+        tier_config = None
+        if warm_rows > 0 and spill_dir:
+            import os as _os
+
+            tier_config = {
+                "warm_rows": warm_rows,
+                "spill_dir": _os.path.join(
+                    spill_dir, "ps-%d" % args.ps_id
+                ),
+            }
+        elif warm_rows > 0 or spill_dir:
+            logger.warning(
+                "tiered store needs BOTH --ps_warm_rows and "
+                "--ps_spill_dir; running untiered"
+            )
+        self.parameters = Parameters(
+            device=self.ps_device, tier_config=tier_config
+        )
 
         # durability plane: build the per-shard snapshotter (a no-op
         # object when the cadence/dir flags are unset), mint this
@@ -228,6 +250,11 @@ class ParameterServer:
             except Exception as err:  # noqa: BLE001 — teardown
                 logger.warning("snapshotter close failed: %s", err)
             self.snapshotter = None
+        if self.parameters is not None:
+            # tiered tables run a background demoter thread each; a
+            # stopped shard must not leave them spilling to a dir the
+            # relaunch is about to re-attach
+            self.parameters.close()
         if self._owns_flight_recorder:
             # the recorder is process-global; embedded/test instances
             # must not leave it pointed at a torn-down tmpdir

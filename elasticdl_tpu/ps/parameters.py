@@ -30,7 +30,7 @@ class EmbeddingTableInfo:
 
 
 class Parameters:
-    def __init__(self, device=False):
+    def __init__(self, device=False, tier_config=None):
         """``device=True`` makes this a DEVICE-RESIDENT store
         (docs/ps_device.md): dense params live as ``jax.Array``s,
         embedding/slot tables are
@@ -38,10 +38,18 @@ class Parameters:
         arenas, and the optimizer wrapper picks its jitted apply
         paths. Snapshot format, RPC protocol, and lazy-init values
         are bitwise-identical to the host mode (the parity suite,
-        tests/test_ps_device_parity.py, pins this on every RPC)."""
+        tests/test_ps_device_parity.py, pins this on every RPC).
+
+        ``tier_config``: ``{"warm_rows": int, "spill_dir": str}`` wraps
+        every embedding/slot table in a
+        :class:`~elasticdl_tpu.ps.tiered_store.TieredEmbeddingTable`
+        (docs/tiered_store.md) so tables larger than ``warm_rows`` per
+        table spill cold rows to disk segments under ``spill_dir``.
+        Composes with ``device``: the tier wraps the arena."""
         self.version = 0
         self.initialized = False
         self.device = bool(device)
+        self.tier_config = dict(tier_config) if tier_config else None
         self.non_embedding_params = {}
         self.embedding_params = {}
         self._lock = threading.Lock()
@@ -50,10 +58,34 @@ class Parameters:
         if self.device:
             from elasticdl_tpu.ps.device_store import DeviceEmbeddingTable
 
-            return DeviceEmbeddingTable(
+            table = DeviceEmbeddingTable(
                 name, dim, initializer, is_slot=is_slot
             )
-        return EmbeddingTable(name, dim, initializer, is_slot=is_slot)
+        else:
+            table = EmbeddingTable(name, dim, initializer, is_slot=is_slot)
+        if self.tier_config:
+            import os
+
+            from elasticdl_tpu.ps.tiered_store import TieredEmbeddingTable
+
+            table = TieredEmbeddingTable(
+                table,
+                spill_dir=os.path.join(
+                    self.tier_config["spill_dir"], name.replace("/", "_")
+                ),
+                warm_rows=int(self.tier_config["warm_rows"]),
+            )
+        return table
+
+    def close(self):
+        """Stop table background machinery (tiered demoter threads).
+        Safe to call on a plain store; idempotent."""
+        with self._lock:
+            tables = list(self.embedding_params.values())
+        for table in tables:
+            closer = getattr(table, "close", None)
+            if closer is not None:
+                closer()
 
     def get_non_embedding_param(self, name, default=None):
         return self.non_embedding_params.get(name, default)
@@ -116,11 +148,14 @@ class Parameters:
         EmbeddingTableInfo. Returns True if this call initialized.
         Parity: reference parameters.py:104-124, ps/servicer.py:70-79.
         """
+        # tables first, OUTSIDE _lock: a tiered table's __init__
+        # reattaches spill segments from disk (file IO), and _lock is
+        # on the RPC hot path. init_embedding_params installs
+        # first-write-wins under _lock itself, so ordering vs the
+        # dense init below is free.
+        self.init_embedding_params(embedding_infos)
         with self._lock:
             if self.initialized:
-                # embedding infos may still arrive later (new layers after
-                # a PS restart re-push)
-                self.init_embedding_params(embedding_infos)
                 return False
             for name, arr in dense_params.items():
                 host = np.asarray(arr, dtype=np.float32)
@@ -132,17 +167,38 @@ class Parameters:
                     self.non_embedding_params[name] = jax.device_put(host)
                 else:
                     self.non_embedding_params[name] = host.copy()
-            self.init_embedding_params(embedding_infos)
             self.version = max(0, int(version))
             self.initialized = True
             return True
 
     def init_embedding_params(self, embedding_infos):
+        """Create missing tables; existing names always win.
+
+        Builds candidate tables with NO lock held — a tiered table's
+        constructor reattaches spill segments from disk, and file IO
+        under ``_lock`` would stall every concurrent pull/push — then
+        installs first-write-wins under ``_lock``. A candidate that
+        lost the install race is closed (its demoter thread stopped)
+        off-lock."""
+        candidates = {}
         for info in embedding_infos or ():
             if info.name not in self.embedding_params:
-                self.embedding_params[info.name] = self._new_table(
+                candidates[info.name] = self._new_table(
                     info.name, info.dim, info.initializer
                 )
+        if not candidates:
+            return
+        losers = []
+        with self._lock:
+            for name, table in candidates.items():
+                if name in self.embedding_params:
+                    losers.append(table)
+                else:
+                    self.embedding_params[name] = table
+        for table in losers:
+            closer = getattr(table, "close", None)
+            if closer is not None:
+                closer()
 
     def has_embedding_params(self):
         return len(self.embedding_params) > 0
@@ -249,6 +305,11 @@ class Parameters:
         as before the crash, and marks the store initialized — a
         restored shard serves immediately instead of waiting for a
         worker's first-write push."""
+        if self.tier_config:
+            # the replacement tiered tables claim the SAME spill dirs;
+            # the outgoing demoter threads must be gone before the new
+            # tables scan/reset those dirs
+            self.close()
         tables = {}
         for name, snap in state["tables"].items():
             table = self._new_table(

@@ -257,6 +257,7 @@ class PserverServicer:
                     new_version = self._parameters.version + 1
                     for name, t in self._indexed_sum.items():
                         self._delta.note(name, t.indices, new_version)
+                        self._note_applied(name, t.indices, new_version)
                     self._parameters.version = new_version
                 self._dense_sum.clear()
                 self._indexed_sum.clear()
@@ -300,8 +301,26 @@ class PserverServicer:
                 new_version = self._parameters.version + 1
                 for name, t in sparse.items():
                     self._delta.note(name, t.indices, new_version)
+                    self._note_applied(name, t.indices, new_version)
                 self._parameters.version = new_version
         self._maybe_snapshot()
+
+    def _note_applied(self, name, ids, version):
+        """Forward the delta note to a tiered table (docs/
+        tiered_store.md): rows a recent version applied to are the
+        demoter's do-not-evict set and the promotion signal. The same
+        update feeds the row table and its slot tables, so the note
+        fans out to the layer's whole table family (slot naming is
+        ``"{layer}-{slot}"``, embedding_table.get_slot_table_name)."""
+        tables = self._parameters.embedding_params
+        family = [tables.get(name)]
+        for key, t in tables.items():
+            if t.is_slot and key.startswith(name + "-"):
+                family.append(t)
+        for t in family:
+            note = getattr(t, "note_applied", None)
+            if note is not None:
+                note(ids, version)
 
     def ps_status(self, req):
         """Shard liveness/identity probe (docs/ps_recovery.md).
@@ -310,8 +329,11 @@ class PserverServicer:
         data-plane failure to learn whether the shard came back as a
         NEW incarnation (shard_epoch changed), how far its restored
         state rolled back (version), and whether it needs the model
-        re-pushed (initialized False — relaunch with no snapshot)."""
-        return self._reply({
+        re-pushed (initialized False — relaunch with no snapshot).
+        A tiered shard (docs/tiered_store.md) additionally reports its
+        aggregated tier counters under ``tiered`` — the bench's
+        disk-tier-exercised gate reads them here."""
+        resp = {
             "version": self._parameters.version,
             "initialized": bool(self._parameters.initialized),
             "restored_version": self._restored_version,
@@ -320,7 +342,20 @@ class PserverServicer:
                 if self._snapshotter is not None
                 else 0
             ),
-        })
+        }
+        tiered = None
+        for table in list(self._parameters.embedding_params.values()):
+            stats = getattr(table, "stats", None)
+            if stats is None:
+                continue
+            s = stats()
+            if tiered is None:
+                tiered = dict.fromkeys(s, 0)
+            for key, value in s.items():
+                tiered[key] = tiered.get(key, 0) + int(value)
+        if tiered is not None:
+            resp["tiered"] = tiered
+        return self._reply(resp)
 
     # -- serving-plane RPCs (docs/serving.md) -------------------------------
 

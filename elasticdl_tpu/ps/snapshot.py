@@ -37,6 +37,15 @@ Directory layout (one per shard; ``--ps_snapshot_dir/ps-{id}/``)::
       manifest.json             # version, dense names, table metadata
       dense.npz                 # {name: float32 array}
       table.{i}.npz             # ids + rows per embedding/slot table
+
+This format is ALSO the on-disk layout of the tiered store's spill
+segments (ps/tiered_store.py): a cold-row segment is written with
+``write_shard_snapshot`` (one table, ``version`` = the segment
+generation) into the table's spill dir, so a spill segment is a
+restorable snapshot shard and inherits the manifest-last +
+atomic-rename crash story for free. ``snapshot_versions`` /
+``snapshot_path`` / ``remove_snapshot_dir`` are the public surface the
+tiered store (and anything else reusing the layout) builds on.
 """
 
 import glob
@@ -91,6 +100,22 @@ def _snapshot_versions(shard_dir):
         except ValueError:
             continue
     return sorted(out)
+
+
+def snapshot_versions(shard_dir):
+    """Public alias of :func:`_snapshot_versions` — every published
+    (manifest-sealed) version in ``shard_dir``, oldest first."""
+    return _snapshot_versions(shard_dir)
+
+
+def snapshot_path(shard_dir, version):
+    """The published directory for ``version`` under ``shard_dir``."""
+    return os.path.join(shard_dir, "%s%d" % (_SNAP_PREFIX, int(version)))
+
+
+def remove_snapshot_dir(directory):
+    """Public alias of :func:`_remove_dir` (best-effort, never raises)."""
+    _remove_dir(directory)
 
 
 def write_shard_snapshot(shard_dir, state, ps_id=0, shard_epoch=0):

@@ -1,0 +1,721 @@
+"""Tiered embedding row store: warm tier + disk spill behind one table.
+
+ROADMAP item 3 (docs/tiered_store.md): a PS shard's tables no longer
+have to fit in the shard's warm tier. :class:`TieredEmbeddingTable`
+wraps the shard's resident store — the host dict-of-rows
+:class:`~elasticdl_tpu.ps.embedding_table.EmbeddingTable` or the
+``--ps_device`` :class:`~elasticdl_tpu.ps.device_store.DeviceEmbeddingTable`
+arena — and spills cold rows to disk segments, promoting them back on
+demand. Together with the plane-shared worker/scorer ``HotRowCache``
+(nn/comm_plane.py) that gives three tiers of residence:
+
+    HotRowCache (workers/scorers)  ->  warm store (host dict / device
+    arena)                         ->  disk segments (this module)
+
+Design points ("Elastic Model Aggregation with Parameter Service",
+PAPERS.md 2204.03211 — aggregation decoupled from residence):
+
+- **A spill segment IS a snapshot shard.** Segments are written with
+  ``ps.snapshot.write_shard_snapshot`` and read back with
+  ``read_shard_snapshot`` — the PR-10 manifest-last + atomic-rename
+  format, one table per segment. Crash recovery and tiering share one
+  on-disk layout: a torn segment (manifest-less temp dir) is invisible
+  to both re-attach and reads, so the previous generation keeps
+  serving, and any sealed segment restores with the ordinary snapshot
+  reader.
+- **Signal-driven eviction, not hand tuning.** Victims are the
+  oldest-touched warm rows, EXCLUDING rows the last
+  ``pin_versions`` optimizer versions applied to (the PR-14 delta log
+  doubles as the promotion signal — the servicer forwards every
+  ``DeltaLog.note`` to :meth:`note_applied`), and the per-table warm
+  hit rate (the same series the telemetry plane exports) sets the
+  eviction depth: a table whose pulls almost always hit warm demotes
+  below budget for headroom, a thrashing table demotes only strict
+  overflow.
+- **Off the apply hot path.** Demotion runs on a background thread
+  with the journal's enqueue-only, no-lock-across-IO discipline: the
+  victim rows are captured (copied) under the tier lock, the segment
+  is written and sealed with NO lock held, and only after the manifest
+  seals are the victims actually evicted from the warm store —
+  verified untouched-since-capture, so a row modified mid-spill stays
+  warm and its stale segment copy is never indexed. A SIGKILL at any
+  point mid-demotion therefore never loses a row: it lives in warm
+  until the segment is manifest-sealed AND the index flips.
+- **Batched cold pulls.** A pull that misses to disk reads one
+  segment per cold CLUSTER, not one file per row: cold ids are grouped
+  by owning segment and each segment is opened once
+  (``cold_pull_segments`` counts opens, ``cold_pull_rows`` rows).
+
+Consistency invariants:
+
+- warm and disk are disjoint: promotion/overwrite pops the disk index
+  entry before (under the same lock hold as) the warm install, and
+  demotion indexes a row on disk only in the same hold that evicts it
+  from warm.
+- demotion never changes a value, only residence — so a snapshot cut
+  (:meth:`snapshot`, the union of warm + indexed disk rows, warm wins)
+  is value-identical to the untiered table's cut, and restores
+  all-in-memory (:meth:`load_snapshot` resets the disk tier; the
+  demoter re-spills overflow afterwards). Tier configuration is not
+  part of the snapshot format.
+- ids indexed on disk are never lazily re-initialized: every read path
+  promotes before it touches the inner store.
+
+See docs/tiered_store.md for the operator view (flags, metrics).
+"""
+
+import collections
+import os
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.ps.snapshot import (
+    read_shard_snapshot,
+    remove_snapshot_dir,
+    snapshot_path,
+    snapshot_versions,
+    write_shard_snapshot,
+)
+from elasticdl_tpu.utils import profiling
+
+# one demotion pass spills at most this many rows per segment — keeps
+# segment files bounded and the phase-3 verification window short
+_SPILL_BATCH = 4096
+# warm hit rate above which the demoter keeps pre-emptive headroom
+# below the budget (cheap to refill a tier that almost never misses)
+_SLACK_HIT_RATE = 0.98
+_SLACK = 0.9
+
+
+class TieredEmbeddingTable:
+    """Wrap a warm-tier table with a disk spill tier (same interface).
+
+    ``inner``: an :class:`EmbeddingTable` or
+    :class:`DeviceEmbeddingTable` (anything with the shared table
+    surface plus ``missing_ids``/``evict_rows``). ``spill_dir`` is this
+    table's own segment directory; ``warm_rows`` the warm-tier row
+    budget. ``reattach=True`` (default) re-indexes sealed segments
+    already in ``spill_dir`` (newest generation wins per id; torn or
+    manifest-less dirs are ignored, so the previous generation serves).
+
+    Lock order: the tier lock ``_mu`` is always taken BEFORE the inner
+    table's lock (inner methods are called under ``_mu``; the inner
+    never calls back out). No disk IO ever runs under ``_mu``.
+    """
+
+    def __init__(
+        self, inner, spill_dir, warm_rows, pin_versions=2, reattach=True
+    ):
+        if warm_rows <= 0:
+            raise ValueError("warm_rows must be positive")
+        self._inner = inner
+        self._dir = spill_dir
+        self._warm_rows = int(warm_rows)
+        self._pin_versions = max(0, int(pin_versions))
+        self._mu = threading.Lock()
+        self._ticks = {}  # warm id -> last-touch tick
+        self._tick = 0
+        self._disk = {}  # id -> owning segment generation
+        self._seg_live = {}  # generation -> indexed (live) row count
+        self._gen = 1
+        self._pins = collections.Counter()  # in-flight read pins
+        self._apply_pins = frozenset()  # last apply's ids (device plane)
+        self._applied = collections.deque()  # (version, ids) ring
+        self._gc_pending = collections.deque()  # segment dirs to delete
+        # stat counters (exported per-table via the metrics collector
+        # and aggregated into the servicer's ps_status reply)
+        self._spilled_rows = 0
+        self._spill_segments = 0
+        self._cold_pull_rows = 0
+        self._cold_pull_segments = 0
+        self._promoted_rows = 0
+        self._warm_hit_rows = 0
+        os.makedirs(self._dir, exist_ok=True)
+        if reattach:
+            self._reattach()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._demote_loop,
+            name="tiered-demoter-%s" % self.name,
+            daemon=True,
+        )
+        self._thread.start()
+        profiling.metrics.register_collector(self._collect)
+
+    # -- delegated identity --------------------------------------------------
+
+    @property
+    def name(self):
+        return self._inner.name
+
+    @property
+    def dim(self):
+        return self._inner.dim
+
+    @property
+    def initializer_name(self):
+        return self._inner.initializer_name
+
+    @property
+    def is_slot(self):
+        return self._inner.is_slot
+
+    def __len__(self):
+        # logical size: every row this table owns, wherever it sleeps
+        with self._mu:
+            return len(self._inner) + len(self._disk)
+
+    def warm_len(self):
+        return len(self._inner)
+
+    # -- boot re-attach ------------------------------------------------------
+
+    def _segment_path(self, gen):
+        return snapshot_path(self._dir, gen)
+
+    def _reattach(self):
+        """Index sealed segments left by a previous incarnation.
+
+        Oldest-to-newest so a row spilled twice resolves to its newest
+        sealed generation; a segment whose manifest never sealed is not
+        listed at all (``snapshot_versions`` is publication-gated), so
+        a crash mid-spill leaves the previous generation serving."""
+        gens = snapshot_versions(self._dir)
+        for gen in gens:
+            try:
+                state = read_shard_snapshot(self._segment_path(gen))
+            except Exception as err:  # noqa: BLE001 — skip torn segment
+                logger.warning(
+                    "tiered %s: segment v%d unreadable at re-attach "
+                    "(%s); previous generation serves",
+                    self.name,
+                    gen,
+                    err,
+                )
+                continue
+            for snap in state["tables"].values():
+                for i in np.asarray(snap["ids"], dtype=np.int64):
+                    i = int(i)
+                    old = self._disk.get(i)
+                    if old is not None:
+                        self._seg_live[old] -= 1
+                    self._disk[i] = gen
+                    self._seg_live[gen] = self._seg_live.get(gen, 0) + 1
+        for gen, live in list(self._seg_live.items()):
+            if live <= 0:
+                del self._seg_live[gen]
+                self._gc_pending.append(self._segment_path(gen))
+        if gens:
+            self._gen = max(gens) + 1
+        if self._disk:
+            logger.info(
+                "tiered %s: re-attached %d disk rows across %d segments",
+                self.name,
+                len(self._disk),
+                len(self._seg_live),
+            )
+
+    # -- tier bookkeeping (all under _mu) ------------------------------------
+
+    def _touch_locked(self, ids):
+        # _ticks doubles as the warm-id recency index, so a
+        # disk-resident id must NOT gain an entry (a signal-only touch,
+        # e.g. note_applied on a cold row, would otherwise make the
+        # demoter treat it as a warm victim and spill a lazy-init row
+        # over the real one in a newer generation)
+        self._tick += 1
+        t = self._tick
+        for i in ids:
+            if i not in self._disk:
+                self._ticks[i] = t
+
+    def _seg_deref_locked(self, gen):
+        live = self._seg_live.get(gen, 0) - 1
+        if live > 0:
+            self._seg_live[gen] = live
+        else:
+            self._seg_live.pop(gen, None)
+            self._gc_pending.append(self._segment_path(gen))
+
+    def _cold_plan_locked(self, ids):
+        """Group disk-resident ids by owning segment — the batched
+        promotion plan (one segment read per cold cluster)."""
+        plan = {}
+        for i in ids:
+            gen = self._disk.get(i)
+            if gen is not None:
+                plan.setdefault(gen, []).append(i)
+        return plan
+
+    def _install_promoted_locked(self, got):
+        """Move read-back rows into warm and unindex them from disk."""
+        if not got:
+            return
+        ids = np.fromiter(got.keys(), dtype=np.int64, count=len(got))
+        rows = np.stack(list(got.values()))
+        self._inner.set(ids, rows)
+        for i in got:
+            gen = self._disk.pop(i, None)
+            if gen is not None:
+                self._seg_deref_locked(gen)
+        self._touch_locked(got.keys())
+        self._promoted_rows += len(got)
+
+    def _overflow(self):
+        return len(self._inner) - self._warm_rows
+
+    def _maybe_wake(self):
+        if self._overflow() > 0 or self._gc_pending:
+            self._wake.set()
+
+    # -- promotion (the read paths) ------------------------------------------
+
+    def _read_segment_rows(self, gen, wanted, count=True):
+        """Rows for ``wanted`` ids out of segment ``gen`` — ONE read of
+        the segment regardless of how many of its rows the pull needs.
+        Returns ``{id: row}`` (possibly partial) or None when the
+        segment is unreadable (GC'd under us / torn)."""
+        try:
+            state = read_shard_snapshot(self._segment_path(gen))
+        except Exception as exc:  # noqa: BLE001 — caller re-plans
+            # expected when a concurrent promotion GC'd the segment
+            # under this read; anything else (torn bytes, perms) gets
+            # the same treatment — the caller re-plans and, if the ids
+            # stay indexed to an unreadable segment, unindexes them
+            # loudly after its final attempt
+            logger.warning(
+                "tiered[%s]: segment gen=%d unreadable (%s); re-planning",
+                self.name,
+                gen,
+                exc,
+            )
+            return None
+        want = set(wanted)
+        got = {}
+        for snap in state["tables"].values():
+            seg_ids = np.asarray(snap["ids"], dtype=np.int64)
+            seg_rows = np.asarray(snap["rows"], dtype=np.float32)
+            for pos, i in enumerate(seg_ids):
+                i = int(i)
+                if i in want:
+                    got[i] = seg_rows[pos]
+        if count:
+            with self._mu:
+                self._cold_pull_segments += 1
+                self._cold_pull_rows += len(got)
+        return got
+
+    def _promote(self, uniq):
+        """Bring every disk-resident id of ``uniq`` into warm.
+
+        Loops because a concurrent promotion can GC a planned segment
+        mid-read: the re-plan sees those ids warm (or still indexed)
+        and converges. A segment that stays unreadable while its ids
+        stay indexed is real corruption-after-seal — those ids are
+        unindexed (with an error log) so lazy init takes over rather
+        than wedging every pull forever."""
+        for attempt in range(3):
+            with self._mu:
+                plan = self._cold_plan_locked(uniq)
+            if not plan:
+                return
+            for gen, ids in sorted(plan.items()):
+                got = self._read_segment_rows(gen, ids)
+                with self._mu:
+                    if got is None:
+                        # re-check: promoted under us is fine; still
+                        # indexed means the segment itself is bad
+                        if attempt == 2:
+                            stuck = [
+                                i
+                                for i in ids
+                                if self._disk.get(i) == gen
+                            ]
+                            for i in stuck:
+                                del self._disk[i]
+                                self._seg_deref_locked(gen)
+                            if stuck:
+                                logger.error(
+                                    "tiered %s: segment v%d unreadable"
+                                    " with %d rows still indexed; "
+                                    "dropping to lazy init",
+                                    self.name,
+                                    gen,
+                                    len(stuck),
+                                )
+                        continue
+                    self._install_promoted_locked(
+                        {
+                            i: row
+                            for i, row in got.items()
+                            if self._disk.get(i) == gen
+                        }
+                    )
+
+    def _pin_window(self, uniq):
+        """Context bookkeeping for one read: pin ``uniq`` against
+        demotion, classify, and count the warm-hit share."""
+        with self._mu:
+            self._pins.update(uniq)
+            self._touch_locked(uniq)
+            cold = sum(1 for i in uniq if i in self._disk)
+            self._warm_hit_rows += len(uniq) - cold
+
+    def _unpin(self, uniq):
+        with self._mu:
+            self._pins.subtract(uniq)
+            self._pins += collections.Counter()  # drop zero/neg entries
+
+    # -- the shared table surface --------------------------------------------
+
+    def get(self, indices):
+        if len(indices) == 0:
+            return None
+        ids = [
+            int(i) for i in np.asarray(indices, dtype=np.int64).reshape(-1)
+        ]
+        uniq = list(dict.fromkeys(ids))
+        self._pin_window(uniq)
+        try:
+            self._promote(uniq)
+            out = self._inner.get(ids)
+        finally:
+            self._unpin(uniq)
+        self._maybe_wake()
+        return out
+
+    def set(self, indices, values):
+        ids = [
+            int(i) for i in np.asarray(indices, dtype=np.int64).reshape(-1)
+        ]
+        with self._mu:
+            self._inner.set(indices, values)
+            for i in dict.fromkeys(ids):
+                gen = self._disk.pop(i, None)
+                if gen is not None:
+                    # overwritten while cold: the warm write supersedes
+                    # the disk copy (warm wins), so unindex it
+                    self._seg_deref_locked(gen)
+            self._touch_locked(dict.fromkeys(ids))
+        self._maybe_wake()
+
+    def clear(self):
+        with self._mu:
+            self._inner.clear()
+            self._ticks.clear()
+            self._disk.clear()
+            self._seg_live.clear()
+        for gen in snapshot_versions(self._dir):
+            remove_snapshot_dir(self._segment_path(gen))
+
+    def snapshot(self):
+        """One (ids, rows) cut of EVERY row, wherever it sleeps.
+
+        Value-identical to the untiered table's snapshot: the warm cut
+        and the disk plan are captured under one lock hold (warm and
+        disk are disjoint by invariant), segments are read with no lock
+        held, and ids whose segment vanished mid-read (promoted + GC'd
+        under us — promotion never changes values) are re-fetched
+        through :meth:`get`. Under the snapshotter's apply lock this is
+        a consistent between-applies cut, exactly like the inner
+        table's."""
+        with self._mu:
+            warm_ids, warm_rows = self._inner.snapshot()
+            plan = {}
+            for i, gen in self._disk.items():
+                plan.setdefault(gen, []).append(i)
+        dim = int(self.dim or 0)
+        parts_ids = [np.asarray(warm_ids, dtype=np.int64)]
+        parts_rows = [np.asarray(warm_rows, dtype=np.float32)]
+        lost = []
+        for gen, ids in sorted(plan.items()):
+            got = self._read_segment_rows(gen, ids, count=False)
+            if got is None:
+                lost.extend(ids)
+                continue
+            hit = [i for i in ids if i in got]
+            lost.extend(i for i in ids if i not in got)
+            if hit:
+                parts_ids.append(np.asarray(hit, dtype=np.int64))
+                parts_rows.append(np.stack([got[i] for i in hit]))
+        if lost:
+            rows = self.get(np.asarray(lost, dtype=np.int64))
+            parts_ids.append(np.asarray(lost, dtype=np.int64))
+            parts_rows.append(np.asarray(rows, dtype=np.float32))
+        ids = np.concatenate(parts_ids)
+        if ids.size == 0:
+            return ids, np.zeros((0, dim), np.float32)
+        rows = np.concatenate(
+            [p.reshape(-1, dim) for p in parts_rows]
+        )
+        # warm-first dedup: np.unique's return_index picks the FIRST
+        # occurrence, and warm parts were concatenated first
+        _, first = np.unique(ids, return_index=True)
+        return ids[first], rows[first]
+
+    def load_snapshot(self, ids, rows):
+        """Restore a snapshot cut — tier configuration is NOT part of
+        the format, so a tiered snapshot restores into a plain table
+        and vice versa. Everything lands warm; the disk tier resets
+        (old segments are deleted — the snapshot supersedes them) and
+        the demoter re-spills overflow in the background."""
+        with self._mu:
+            self._disk.clear()
+            self._seg_live.clear()
+            self._ticks.clear()
+        for gen in snapshot_versions(self._dir):
+            remove_snapshot_dir(self._segment_path(gen))
+        with self._mu:
+            self._inner.load_snapshot(ids, rows)
+            self._touch_locked(
+                int(i)
+                for i in np.asarray(ids, dtype=np.int64).reshape(-1)
+            )
+        self._wake.set()
+
+    # -- the device plane (DeviceEmbeddingTable delegation) ------------------
+
+    def ensure_rows(self, unique_ids):
+        """Promote-then-delegate: disk-resident ids must reach the
+        arena BEFORE the inner's lazy init can see them. The id set
+        replaces the previous apply's pin set — applies are serialized
+        under the optimizer wrapper's lock, and pinning through the
+        gather/scatter window keeps a victim's arena slot from being
+        freed (and reused) while this apply still scatters into it."""
+        uniq = [
+            int(i)
+            for i in np.asarray(unique_ids, dtype=np.int64).reshape(-1)
+        ]
+        with self._mu:
+            self._apply_pins = frozenset(uniq)
+            self._touch_locked(uniq)
+            cold = sum(1 for i in uniq if i in self._disk)
+            self._warm_hit_rows += len(uniq) - cold
+        self._promote(uniq)
+        self._maybe_wake()
+        return self._inner.ensure_rows(unique_ids)
+
+    def gather_slots(self, slots, k_pad):
+        return self._inner.gather_slots(slots, k_pad)
+
+    def scatter_slots(self, slots, k_pad, rows):
+        return self._inner.scatter_slots(slots, k_pad, rows)
+
+    def sync(self):
+        return self._inner.sync()
+
+    # -- the eviction/promotion signals --------------------------------------
+
+    def note_applied(self, ids, version):
+        """The delta-log promotion signal (wired by the PS servicer
+        beside every ``DeltaLog.note``): rows a recent optimizer
+        version touched are hot by definition — touch them AND pin
+        them against demotion for ``pin_versions`` versions."""
+        uniq = {
+            int(i) for i in np.asarray(ids, dtype=np.int64).reshape(-1)
+        }
+        version = int(version)
+        with self._mu:
+            self._touch_locked(uniq)
+            self._applied.append((version, uniq))
+            floor = version - self._pin_versions
+            while self._applied and self._applied[0][0] < floor:
+                self._applied.popleft()
+
+    def signal_pressure(self):
+        """Post-apply boundary hook (optimizer wrapper): wake the
+        demoter OFF the apply path — enqueue-only, never blocks."""
+        self._maybe_wake()
+
+    # -- demotion ------------------------------------------------------------
+
+    def _demote_target_locked(self):
+        """Warm-row target, set by the table's own hit-rate signal."""
+        pulls = self._warm_hit_rows + self._cold_pull_rows
+        hit = (self._warm_hit_rows / pulls) if pulls else 1.0
+        if hit >= _SLACK_HIT_RATE:
+            return int(self._warm_rows * _SLACK)
+        return self._warm_rows
+
+    def _demote_once(self):
+        """One spill pass; returns the number of rows demoted.
+
+        Phase 1 (under ``_mu``): pick victims — oldest-touched warm
+        rows, excluding read-pinned, apply-pinned, and recently-applied
+        ids — and CAPTURE their rows. Phase 2 (no lock): write + seal
+        one segment. Phase 3 (under ``_mu``): evict only victims still
+        untouched since capture; a row that moved mid-spill stays warm
+        and its segment copy is simply never indexed."""
+        with self._mu:
+            target = self._demote_target_locked()
+            overflow = len(self._inner) - target
+            if overflow <= 0:
+                return 0
+            excluded = set(self._pins)
+            excluded.update(self._apply_pins)
+            for _, applied in self._applied:
+                excluded.update(applied)
+            candidates = [i for i in self._ticks if i not in excluded]
+            candidates.sort(key=self._ticks.__getitem__)
+            victims = candidates[: min(overflow, _SPILL_BATCH)]
+            # belt-and-braces: a ticked id with no warm row must never
+            # reach inner.get below (it would lazy-init a fresh row and
+            # seal THAT into the segment); drop its stale tick instead
+            missing = set(self._inner.missing_ids(victims))
+            if missing:
+                for i in missing:
+                    self._ticks.pop(i, None)
+                victims = [i for i in victims if i not in missing]
+            if not victims:
+                return 0
+            vids = np.asarray(victims, dtype=np.int64)
+            # the one contract-required copy (R10-ratcheted): the
+            # captured rows cross to the demoter's off-lock segment
+            # write, and the inner get() may hand back a zero-copy view
+            # of a device gather buffer whose backing the next donated
+            # apply retires — the spill block must own its bytes
+            rows = np.asarray(
+                self._inner.get(vids), dtype=np.float32
+            ).copy()
+            tick_snap = {i: self._ticks[i] for i in victims}
+            gen = self._gen
+            self._gen += 1
+            seg_state = {
+                "version": gen,
+                "initialized": True,
+                "dense": {},
+                "tables": {
+                    self.name: {
+                        "ids": vids,
+                        "rows": rows,
+                        "dim": int(self.dim or 0),
+                        "initializer": self.initializer_name,
+                        "is_slot": bool(self.is_slot),
+                    }
+                },
+            }
+        # phase 2, NO lock: write + manifest-seal the segment (the
+        # PR-10 format's commit point — crash here leaves a temp dir
+        # both re-attach and reads ignore)
+        try:
+            seg_dir = write_shard_snapshot(self._dir, seg_state)
+        except Exception as err:  # noqa: BLE001 — spill is best-effort
+            logger.warning(
+                "tiered %s: segment write failed (%s); rows stay warm",
+                self.name,
+                err,
+            )
+            return 0
+        with self._mu:
+            clean = [
+                i
+                for i in victims
+                if self._ticks.get(i) == tick_snap[i]
+                and i not in self._pins
+                and i not in self._apply_pins
+            ]
+            if not clean:
+                self._gc_pending.append(seg_dir)
+                return 0
+            self._inner.evict_rows(clean)
+            for i in clean:
+                del self._ticks[i]
+                self._disk[i] = gen
+            self._seg_live[gen] = len(clean)
+            self._spilled_rows += len(clean)
+            self._spill_segments += 1
+        profiling.events.emit(
+            "tiered_spill",
+            table=self.name,
+            rows=len(clean),
+            generation=gen,
+        )
+        return len(clean)
+
+    def _drain_gc(self):
+        """Delete dead segment dirs — enqueue-only callers, IO here."""
+        while True:
+            try:
+                victim = self._gc_pending.popleft()
+            except IndexError:
+                return
+            remove_snapshot_dir(victim)
+
+    def _demote_loop(self):
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            if self._stop.is_set():
+                self._drain_gc()
+                return
+            self._drain_gc()
+            try:
+                while not self._stop.is_set() and self._demote_once():
+                    pass
+            except Exception:  # noqa: BLE001 — demoter must survive
+                logger.warning(
+                    "tiered %s: demotion pass failed", self.name,
+                    exc_info=True,
+                )
+            self._drain_gc()
+
+    # -- telemetry / teardown ------------------------------------------------
+
+    def stats(self):
+        with self._mu:
+            return {
+                "warm_rows": len(self._inner),
+                "disk_rows": len(self._disk),
+                "spilled_rows": self._spilled_rows,
+                "spill_segments": self._spill_segments,
+                "cold_pull_rows": self._cold_pull_rows,
+                "cold_pull_segments": self._cold_pull_segments,
+                "promoted_rows": self._promoted_rows,
+                "warm_hit_rows": self._warm_hit_rows,
+            }
+
+    def _collect(self):
+        s = self.stats()
+        labels = {"table": self.name}
+        pulls = s["warm_hit_rows"] + s["cold_pull_rows"]
+        return [
+            ("edl_tiered_warm_rows", labels, s["warm_rows"]),
+            ("edl_tiered_disk_rows", labels, s["disk_rows"]),
+            ("edl_tiered_spilled_rows_total", labels, s["spilled_rows"]),
+            (
+                "edl_tiered_cold_pull_rows_total",
+                labels,
+                s["cold_pull_rows"],
+            ),
+            (
+                "edl_tiered_cold_pull_segments_total",
+                labels,
+                s["cold_pull_segments"],
+            ),
+            (
+                "edl_tiered_promoted_rows_total",
+                labels,
+                s["promoted_rows"],
+            ),
+            (
+                "edl_tiered_warm_hit_rate",
+                labels,
+                (s["warm_hit_rows"] / pulls) if pulls else 1.0,
+            ),
+        ]
+
+    def close(self):
+        """Stop the demoter and settle pending segment GC. Rows stay
+        where they are — a close is not a drain; the snapshot plane
+        owns durability."""
+        if self._thread is None:
+            return
+        profiling.metrics.unregister_collector(self._collect)
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10.0)
+        self._thread = None
+        self._drain_gc()

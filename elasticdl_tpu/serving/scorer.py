@@ -339,6 +339,29 @@ class Scorer:
                     (self._cache.hits / probes) if probes else 0.0,
                 )
             )
+            # per-table cache counters (docs/tiered_store.md): which
+            # table's working set the read-through tier is churning
+            table_stats = getattr(self._cache, "table_stats", None)
+            if table_stats is not None:
+                for table, stats in table_stats().items():
+                    labels = {"table": table}
+                    out.append(
+                        ("edl_cache_hits_total", labels, stats["hits"])
+                    )
+                    out.append(
+                        (
+                            "edl_cache_misses_total",
+                            labels,
+                            stats["misses"],
+                        )
+                    )
+                    out.append(
+                        (
+                            "edl_cache_evictions_total",
+                            labels,
+                            stats["evictions"],
+                        )
+                    )
         with self._mu:
             version = (
                 self._current.version if self._current is not None else -1

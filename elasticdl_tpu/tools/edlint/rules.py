@@ -917,6 +917,9 @@ RPC_IDEMPOTENT = frozenset(
         "report_version",
         "push_embedding_info",
         "pull_embedding_vectors",
+        # pure read of the master-central embedding store (the
+        # SAVE_MODEL export path); a resend re-reads
+        "export_embedding_tables",
         # PS data plane reads + replace-style writes
         "pull_variable",
         "pull_embedding_vector",
@@ -1202,7 +1205,13 @@ class CopyOnWireRule(Rule):
         "device-resident end to end, so bare np.asarray, "
         "jax.device_get AND .copy() are findings there (the "
         "deliberate host sites — the snapshot drain, the host-mode "
-        "D2H writeback — are reason-ratcheted)"
+        "D2H writeback — are reason-ratcheted). The tiered store "
+        "(docs/tiered_store.md) extends it again: inside "
+        "TIERED_SCOPED_FILES' promotion/demotion bodies rows move "
+        "between tiers by reference, so the same bare-copy shapes are "
+        "findings (the one contract-required capture copy — the "
+        "demoter must own its bytes across the off-lock segment "
+        "write — is reason-ratcheted)"
     )
 
     SCOPE_PREFIXES = ("elasticdl_tpu/rpc/",)
@@ -1223,12 +1232,20 @@ class CopyOnWireRule(Rule):
         "elasticdl_tpu/ps/device_store.py",
         "elasticdl_tpu/ps/optimizer_wrapper.py",
     )
+    # the tiered store (docs/tiered_store.md): promotion reads a disk
+    # segment into warm, demotion captures warm rows into a segment —
+    # both move the SAME bytes between tiers, and any extra staging
+    # copy (bare np.asarray, bare .copy()) doubles the tier-crossing
+    # cost for every cold cluster. Same bar as the device scope,
+    # applied to the pull/spill verb set.
+    TIERED_SCOPED_FILES = ("elasticdl_tpu/ps/tiered_store.py",)
 
     def _in_scope(self, path):
         return (
             path in self.SCOPE_FILES
             or path in self.METHOD_SCOPED_FILES
             or path in self.DEVICE_SCOPED_FILES
+            or path in self.TIERED_SCOPED_FILES
             or any(path.startswith(p) for p in self.SCOPE_PREFIXES)
         )
 
@@ -1253,6 +1270,30 @@ class CopyOnWireRule(Rule):
                 "materialize",
                 "get",
                 "set",
+                "snapshot",
+                "load",
+            )
+        )
+
+    @staticmethod
+    def _tiered_plane_fn(name):
+        # the tiered store's tier-crossing plane: the host-facing row
+        # interface (get/set/ensure/snapshot/load_snapshot) plus the
+        # promotion/demotion verbs that move rows between warm and
+        # disk (promote/demote/spill/read_segment/install)
+        return name.lstrip("_").startswith(
+            (
+                "push",
+                "pull",
+                "apply",
+                "promote",
+                "demote",
+                "spill",
+                "read",
+                "install",
+                "get",
+                "set",
+                "ensure",
                 "snapshot",
                 "load",
             )
@@ -1342,21 +1383,25 @@ class CopyOnWireRule(Rule):
             return []
         method_scoped = ctx.path in self.METHOD_SCOPED_FILES
         device_scoped = ctx.path in self.DEVICE_SCOPED_FILES
+        tiered_scoped = ctx.path in self.TIERED_SCOPED_FILES
         out = []
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if method_scoped or device_scoped:
+            if method_scoped or device_scoped or tiered_scoped:
                 fn = ctx.enclosing(
                     node, (ast.FunctionDef, ast.AsyncFunctionDef)
                 )
-                in_plane = self._device_plane_fn if device_scoped else (
-                    self._data_plane_fn
-                )
+                if device_scoped:
+                    in_plane = self._device_plane_fn
+                elif tiered_scoped:
+                    in_plane = self._tiered_plane_fn
+                else:
+                    in_plane = self._data_plane_fn
                 if fn is None or not in_plane(fn.name):
                     continue
             why = self._why(ctx, node)
-            if why is None and device_scoped:
+            if why is None and (device_scoped or tiered_scoped):
                 why = self._why_device(node)
             if why:
                 out.append(

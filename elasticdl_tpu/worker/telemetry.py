@@ -62,6 +62,19 @@ class WorkerTelemetry:
             "train loop spent waiting on an empty input buffer",
             labels=("worker",),
         )
+        # per-table HotRowCache counters (docs/tiered_store.md): the
+        # tiered store's admission signal, exported labeled so /metrics
+        # shows WHICH table's working set thrashes the top tier.
+        # Monotonic totals written gauge-style each interval (the
+        # cache owns the counters; this plane only mirrors them)
+        self._g_cache = {
+            stat: r.gauge(
+                "edl_cache_%s_total" % stat,
+                "Per-table worker hot-row cache %s (cumulative)" % stat,
+                labels=("table", "worker"),
+            )
+            for stat in ("hits", "misses", "evictions")
+        }
 
     @property
     def enabled(self):
@@ -130,6 +143,16 @@ class WorkerTelemetry:
         hit_rate = self._hot_row_hit_rate()
         if hit_rate is not None:
             snap["hot_row_hit_rate"] = round(hit_rate, 4)
+        cache_stats = self._hot_row_table_stats()
+        if cache_stats:
+            snap["cache_tables"] = cache_stats
+            for table, stats in cache_stats.items():
+                for stat, gauge in self._g_cache.items():
+                    gauge.set(
+                        stats[stat],
+                        table=table,
+                        worker=str(self._worker_id),
+                    )
         shipped_spans = profiling.spans.drain_pending()
         if shipped_spans:
             # span records are JSON-safe by construction (SpanLog
@@ -166,6 +189,11 @@ class WorkerTelemetry:
             return None
         total = cache.hits + cache.misses
         return cache.hits / total if total else 0.0
+
+    def _hot_row_table_stats(self):
+        cache = getattr(self._ps_client, "hot_row_cache", None)
+        stats = getattr(cache, "table_stats", None)
+        return stats() if stats is not None else None
 
     def ship(self, stub, force=False):
         """Build + send one snapshot over ``stub`` if due; best-effort

@@ -1104,6 +1104,18 @@ class Worker:
                 self._minibatch_size,
                 Mode.PREDICTION,
             )
+        extra_named = None
+        if self._embedding_dims and self._ps_client is None:
+            # master-central-storage mode: the embedding tables live in
+            # the MASTER's KV store, not in self._params — get_model
+            # strips their export keys by design, so without this pull
+            # the artifact would silently drop every table (the gap
+            # flagged at master/servicer._export_embedding_tables)
+            export_tables = getattr(
+                self._stub, "export_embedding_tables", None
+            )
+            if export_tables is not None:
+                extra_named = export_tables()
         export_model(
             saved_model_path,
             self._params,
@@ -1115,6 +1127,7 @@ class Worker:
                 else None
             ),
             example_features=example,
+            extra_named=extra_named,
         )
         self.report_task_result(task_id=task.task_id, err_msg="")
 

@@ -94,6 +94,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_comm_plane.py \
     tests/test_ps_snapshot.py \
     tests/test_ps_device_parity.py \
+    tests/test_tiered_store.py \
     tests/test_chaos.py \
     tests/test_master_journal.py \
     tests/test_serving.py \

@@ -71,6 +71,7 @@ _LOCKTRACE_SUITES = {
     "test_comm_plane",
     "test_ps_snapshot",
     "test_ps_device_parity",
+    "test_tiered_store",
     "test_chaos",
     "test_master_journal",
     "test_serving",

@@ -33,6 +33,9 @@ class InProcessMaster:
     def pull_embedding_vectors(self, layer_name, ids):
         return self._m.pull_embedding_vectors(layer_name, ids)
 
+    def export_embedding_tables(self):
+        return self._m.export_embedding_tables()
+
     def report_gradient(self, gradients, model_version):
         for callback in self._callbacks:
             if ON_REPORT_GRADIENT_BEGIN in callback.call_times:

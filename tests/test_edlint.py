@@ -1672,6 +1672,87 @@ def test_r10_device_scope_flags_host_roundtrips(tmp_path):
     )
 
 
+R10_TIERED_SPILL_STAGED = '''
+import numpy as np
+
+
+class TieredEmbeddingTable:
+    def _demote_once(self):
+        # staging shapes the tier-crossing plane must not grow: a bare
+        # asarray pass over the victim rows, an extra duplicate of the
+        # already-owned capture, and a flatten through .tobytes()
+        rows = np.asarray(self._inner.get(self._victims))
+        dup = rows.copy()
+        return dup.tobytes()
+
+    def _promote(self, uniq):
+        got = self._read_segment_rows(3, uniq)
+        return np.asarray(got)
+'''
+
+R10_TIERED_RATCHETED_CAPTURE = '''
+import numpy as np
+
+
+class TieredEmbeddingTable:
+    def _demote_once(self):
+        # the one contract-required capture copy: the demoter must own
+        # its bytes across the off-lock segment write
+        return np.asarray(self._inner.get(self._victims),
+                          dtype=np.float32).copy()
+'''
+
+R10_TIERED_RESIDENT = '''
+import numpy as np
+
+
+class TieredEmbeddingTable:
+    def _promote(self, uniq):
+        # the resident idiom: typed decode (a view unless the dtype
+        # really differs), rows installed into warm by reference
+        ids = np.asarray(uniq, dtype=np.int64)
+        return self._inner.get(ids)
+
+    def _overflow_histogram(self, rows):
+        # out-of-plane helpers may copy freely: the contract is about
+        # rows crossing tiers, not bookkeeping
+        return np.asarray(rows).copy()
+'''
+
+
+def test_r10_tiered_scope_flags_tier_crossing_copies(tmp_path):
+    # the tiered-store extension (docs/tiered_store.md): inside the
+    # promotion/demotion bodies of ps/tiered_store.py, bare np.asarray,
+    # .tobytes() AND .copy() are findings — rows move between tiers by
+    # reference. The real file's ratchet budget (max 1, the demoter's
+    # capture copy) absorbs exactly one, so 4 findings -> 3 violations.
+    bad = _lint(
+        tmp_path,
+        R10_TIERED_SPILL_STAGED,
+        relpath="elasticdl_tpu/ps/tiered_store.py",
+    )
+    assert _rules_of(bad) == ["R10"] and len(bad) == 3, bad
+    # the contract-required capture copy alone fits the reason-ratchet
+    assert not _lint(
+        tmp_path,
+        R10_TIERED_RATCHETED_CAPTURE,
+        relpath="elasticdl_tpu/ps/tiered_store.py",
+    )
+    # the resident idiom is clean, and out-of-plane helpers may copy
+    assert not _lint(
+        tmp_path,
+        R10_TIERED_RESIDENT,
+        relpath="elasticdl_tpu/ps/tiered_store.py",
+    )
+    # the tiered scope is file-scoped: the same staging shapes in the
+    # host EmbeddingTable (one tier, no crossing) stay un-flagged
+    assert not _lint(
+        tmp_path,
+        R10_TIERED_SPILL_STAGED,
+        relpath="elasticdl_tpu/ps/embedding_table.py",
+    )
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: the AST cache and --json
 # ---------------------------------------------------------------------------

@@ -62,3 +62,70 @@ def test_embedding_tables_roundtrip_through_checkpoint(tmp_path):
     # dense params restored without embedding-export keys leaking in
     _, named = restored.get_model(1)
     assert set(named) == {"w"}
+
+
+def test_save_model_export_carries_master_kv_tables(tmp_path):
+    """The SAVE_MODEL gap: get_model strips embedding-export keys by
+    design, so a master-central-storage export artifact must pull the
+    tables explicitly (worker._process_save_model_task_if_needed ->
+    stub.export_embedding_tables -> export_model extra_named) or every
+    table silently vanishes from the artifact."""
+    import os
+
+    from elasticdl_tpu.common.export import export_model, load_export
+
+    master = MasterServicer(
+        1,
+        4,
+        optax.sgd(0.5),
+        _dispatcher(),
+        use_async=True,
+    )
+    master.report_variable({"w": np.ones((2, 2), np.float32)})
+    master.push_embedding_info([EmbeddingTableInfo("emb", 3)])
+    master.report_gradient(
+        [
+            Tensor("w", np.zeros((2, 2), np.float32)),
+            Tensor("emb", np.ones((2, 3), np.float32), indices=[4, 9]),
+        ],
+        0,
+    )
+    rows = master.pull_embedding_vectors("emb", [4, 9])
+
+    # the worker's SAVE_MODEL path: dense params from get_model (which
+    # must NOT carry the tables), tables from the explicit export RPC
+    _, dense = master.get_model(master.get_model_version())
+    assert set(dense) == {"w"}
+    extra = master.export_embedding_tables()
+    assert {
+        "edl_embedding:emb:ids",
+        "edl_embedding:emb:rows",
+    } <= set(extra)
+
+    export_dir = str(tmp_path / "exp")
+    manifest = export_model(
+        export_dir,
+        dense,
+        version=master.get_model_version(),
+        extra_named=extra,
+    )
+    assert "edl_embedding:emb:rows" in manifest["extra_named"]
+    # the orbax/serving params stay dense-only...
+    loaded = load_export(export_dir)
+    assert set(loaded.params) == {"w"}
+
+    # ...while the legacy checkpoint member re-seeds a fresh master's
+    # embedding store through checkpoint_filename_for_init
+    restored = MasterServicer(
+        1,
+        4,
+        optax.sgd(0.5),
+        _dispatcher(),
+        checkpoint_filename_for_init=os.path.join(
+            export_dir, "model.chkpt"
+        ),
+        use_async=True,
+    )
+    np.testing.assert_allclose(
+        restored.pull_embedding_vectors("emb", [4, 9]), rows, rtol=1e-6
+    )
