@@ -3188,15 +3188,267 @@ def _scorer_boot_code():
     ) % here
 
 
+def _serve_batch_arms(addrs, export_root, staleness_window, pool,
+                      weights, quick):
+    """Micro-batching arms (docs/serving.md "Micro-batching"): an
+    in-process scorer over the live PS fleet runs (1) a bitwise
+    equivalence pre-pass (coalesced+repeat-row-padded forward vs
+    scoring each request alone), (2) a closed-loop max-QPS A/B —
+    one-request-per-forward vs MicroBatcher.submit from the same
+    driver pool, and (3) an open-loop bursty arm with scheduled
+    arrivals: a base rate the plane absorbs, a burst past capacity
+    that admission control must shed, and a shed-rate-outside-burst
+    measurement. All three are gated rc-1 in main."""
+    import threading
+
+    from elasticdl_tpu.serving.batcher import MicroBatcher, Overloaded
+    from elasticdl_tpu.serving.scorer import (
+        ModelDirectoryWatcher,
+        Scorer,
+    )
+    from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
+
+    rows_per_req = 4
+
+    def small_req(drng):
+        return {
+            "feature": drng.choice(
+                pool, size=(rows_per_req, 10), p=weights
+            ).astype(np.int64)
+        }
+
+    client = PSClient(
+        [BoundPS(a, deadline_s=20.0, retries=3) for a in addrs]
+    )
+    scorer = Scorer(
+        ps_client=client, staleness_versions=staleness_window
+    )
+    # SLO aligned with the bench p99 gate: predicted queue wait past
+    # ~2 s sheds. The deliberately small 64-row cap is what sheds the
+    # bursty arm's past-capacity window — a 2-batch backlog bound, so
+    # admitted requests clear fast and sheds stop with the burst.
+    batcher = MicroBatcher(
+        scorer,
+        max_batch=32,
+        timeout_ms=2.0,
+        p99_slo_ms=2000.0,
+        queue_rows=64,
+    )
+    out = {}
+    try:
+        scorer.set_warm_batch_sizes(batcher.buckets)
+        watcher = ModelDirectoryWatcher(export_root, scorer)
+        if watcher.poll_once() is None:
+            raise RuntimeError(
+                "A/B scorer found no complete export under %s"
+                % export_root
+            )
+        batcher.start()
+
+        # -- (1) bitwise equivalence pre-pass ----------------------
+        rng = np.random.default_rng(77)
+        eq_ok = True
+        for n in (3, 4, 5, 6):  # 3 and 5 pad up to the 4/8 buckets
+            feats = {
+                "feature": rng.choice(
+                    pool, size=(n, 10), p=weights
+                ).astype(np.int64)
+            }
+            ref, _v = scorer.score(feats)
+            got, _v2 = batcher.submit(feats)
+            ref = ref if isinstance(ref, dict) else {"out": ref}
+            got = got if isinstance(got, dict) else {"out": got}
+            for key in ref:
+                if not np.array_equal(
+                    np.asarray(ref[key]), np.asarray(got[key])
+                ):
+                    eq_ok = False
+        out["equivalence_ok"] = eq_ok
+
+        # -- (2) closed-loop A/B: solo forwards vs coalesced -------
+        ab_threads = 8
+        ab_secs = 2.0 if quick else 4.0
+
+        def run_arm(call, name):
+            stop = threading.Event()
+            counts = [0] * ab_threads
+            errs = []
+
+            def loop(i):
+                drng = np.random.default_rng(500 + i)
+                while not stop.is_set():
+                    feats = small_req(drng)
+                    try:
+                        call(feats)
+                    except Exception as err:  # noqa: BLE001
+                        errs.append(err)
+                        return
+                    counts[i] += 1
+
+            ts = [
+                threading.Thread(
+                    target=loop, args=(i,), daemon=True,
+                    name="serve-ab-%s-%d" % (name, i),
+                )
+                for i in range(ab_threads)
+            ]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            time.sleep(ab_secs)
+            stop.set()
+            for t in ts:
+                t.join(timeout=60)
+            if errs:
+                raise errs[0]
+            done = sum(counts)
+            return done / max(1e-9, time.monotonic() - t0), done
+
+        unbatched_qps, _ = run_arm(
+            lambda f: scorer.score(f), "solo"
+        )
+        forwards_before = batcher._c_batches.value()
+        batched_qps, batched_reqs = run_arm(
+            lambda f: batcher.submit(f), "coalesced"
+        )
+        forwards = batcher._c_batches.value() - forwards_before
+        out["unbatched_qps"] = unbatched_qps
+        out["batched_qps"] = batched_qps
+        out["batch_speedup"] = batched_qps / max(1e-9, unbatched_qps)
+        out["batched_rows_per_forward"] = (
+            batched_reqs * rows_per_req / max(1, forwards)
+        )
+
+        # -- (3) open-loop bursty arm ------------------------------
+        base_s = 1.5 if quick else 3.0
+        burst_s = 1.0
+        # closed-loop capacity rides 8-deep coalescing; open-loop base
+        # arrivals coalesce barely at all (1-2 requests per forward),
+        # so the absorbable base rate is a fraction of batched_qps —
+        # 12% keeps the single dispatcher at comfortable utilization
+        base_qps = max(20.0, min(0.12 * batched_qps, 80.0))
+        burst_qps = min(
+            max(2.0 * batched_qps, 8.0 * base_qps), 1200.0
+        )
+        arrivals = []  # (t_rel, in_burst_window)
+        for phase_t0, phase_s, qps in (
+            (0.0, base_s, base_qps),
+            (base_s, burst_s, burst_qps),
+            (base_s + burst_s, base_s, base_qps),
+        ):
+            n = int(phase_s * qps)
+            for k in range(n):
+                t_rel = phase_t0 + k / qps
+                # the post-burst drain tail still counts as "burst"
+                # for the shed-outside gate: sheds there are the
+                # queue emptying, not steady-state overload
+                in_burst = (
+                    base_s - 0.05
+                    <= t_rel
+                    <= base_s + burst_s + 0.5
+                )
+                arrivals.append((t_rel, in_burst))
+        arrivals.sort(key=lambda a: a[0])
+
+        idx = [0]
+        idx_mu = threading.Lock()
+        rec = []  # (in_burst, status, dt)
+        rec_mu = threading.Lock()
+        t0 = time.monotonic()
+
+        def issuer(k):
+            drng = np.random.default_rng(900 + k)
+            while True:
+                with idx_mu:
+                    if idx[0] >= len(arrivals):
+                        return
+                    j = idx[0]
+                    idx[0] += 1
+                t_rel, in_burst = arrivals[j]
+                delay = t0 + t_rel - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                # a request the pool issued late — inside the burst's
+                # ACTUAL window or its 0.5 s drain tail — is burst
+                # traffic no matter when the schedule wanted it
+                t_iss = time.monotonic() - t0
+                in_burst = in_burst or (
+                    base_s - 0.05 <= t_iss <= base_s + burst_s + 0.5
+                )
+                feats = small_req(drng)
+                ts = time.perf_counter()
+                try:
+                    batcher.submit(feats)
+                    status = "ok"
+                except Overloaded:
+                    status = "shed"
+                except Exception:  # noqa: BLE001 — counted + gated
+                    status = "error"
+                dt = time.perf_counter() - ts
+                with rec_mu:
+                    rec.append((in_burst, status, dt))
+
+        # the pool must HOLD the open-loop schedule through the burst
+        # (offered x in-flight latency, with headroom) — a starved
+        # pool re-issues the burst's backlog after it ends and turns
+        # scheduled base traffic into a compressed storm
+        issuers = [
+            threading.Thread(
+                target=issuer, args=(k,), daemon=True,
+                name="serve-bursty-%d" % k,
+            )
+            for k in range(192)
+        ]
+        for t in issuers:
+            t.start()
+        for t in issuers:
+            t.join(timeout=120)
+        oks = [r for r in rec if r[1] == "ok"]
+        lat = sorted(r[2] for r in oks)
+        outside = [r for r in rec if not r[0]]
+        shed_outside = sum(1 for r in outside if r[1] == "shed")
+        out["bursty"] = {
+            "base_qps_offered": base_qps,
+            "burst_qps_offered": burst_qps,
+            "requests": len(rec),
+            "ok": len(oks),
+            "errors": sum(1 for r in rec if r[1] == "error"),
+            "shed_in_burst": sum(
+                1 for r in rec if r[0] and r[1] == "shed"
+            ),
+            "shed_outside_burst": shed_outside,
+            "n_outside": len(outside),
+            "shed_rate_outside": (
+                shed_outside / max(1, len(outside))
+            ),
+            "ok_qps": len(oks) / (2 * base_s + burst_s),
+            "p99_ms": (
+                1e3 * lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+                if lat
+                else -1.0
+            ),
+        }
+    finally:
+        batcher.stop(drain=True)
+        batcher.close()
+        scorer.close()
+        client.close()
+    return out
+
+
 def bench_serve(quick=False):
     """The serving plane's gate (docs/serving.md): a 2-process scorer
     fleet answering sustained score traffic from the live export
     stream + PS-resident embeddings WHILE an in-process streaming
     trainer churns versions, with a mid-bench PS shard SIGKILL +
-    relaunch. Gated (explicit rc-1 in main): p99 latency, the
+    relaunch, THEN the micro-batching arms (_serve_batch_arms):
+    bitwise batched-vs-unbatched equivalence, a coalesced-vs-solo
+    max-QPS A/B, and an open-loop bursty arm exercising SLO admission
+    control. Gated (explicit rc-1 in main): p99 latency, the
     staleness bound (no served row older than the configured window,
     scraped via each scorer's /metrics), at least one hot swap under
-    churn, and post-recovery health."""
+    churn, post-recovery health, batched >= the speedup gate x solo,
+    and shed-rate ~0 outside the burst."""
     return _bench_serve_impl(quick)
 
 
@@ -3380,6 +3632,12 @@ def _bench_serve_impl(quick=False):
                                 str(staleness_window),
                                 "--serving_sync_interval_s", "0.25",
                                 "--watch_interval_s", "0.5",
+                                # micro-batching ON for the whole
+                                # drive: the SIGKILL drill must stay
+                                # green THROUGH the coalescing path
+                                "--serve_max_batch", "64",
+                                "--serve_batch_timeout_ms", "2",
+                                "--serve_p99_slo_ms", "2000",
                             ],
                             env=env,
                             stdout=subprocess.DEVNULL,
@@ -3568,6 +3826,20 @@ def _bench_serve_impl(quick=False):
                     "outage_s": recovered_t - kill_t,
                     "drive_s": drive_s,
                 }
+            )
+
+            # -- micro-batching A/B + bursty admission (docs/serving.md,
+            # PR-18): in-process scorer against the SAME live PS fleet
+            # and newest export, so the arms isolate the batcher itself
+            # (no gRPC front door, no training churn — trainer drained
+            # above). Small 4-row requests make per-forward host
+            # overhead (jit dispatch + embedding plan/pull RTT)
+            # dominate: exactly the regime coalescing exists for.
+            results.update(
+                _serve_batch_arms(
+                    addrs, export_root, staleness_window, pool,
+                    weights, quick,
+                )
             )
         finally:
             stop_drive.set()
@@ -5861,6 +6133,61 @@ def main(argv=None):
                     res["n_scorers"],
                 )
             )
+        # -- micro-batching gates (PR-18, docs/serving.md) ----------
+        def _env_float(name, default):
+            try:
+                return float(os.environ.get(name, str(default)))
+            except ValueError:
+                return default
+
+        speedup_gate = _env_float("EDL_BENCH_SERVE_BATCH_SPEEDUP", 2.0)
+        qps_floor = _env_float("EDL_BENCH_SERVE_QPS_FLOOR", 20.0)
+        shed_gate = _env_float("EDL_BENCH_SERVE_SHED_OUTSIDE", 0.01)
+        if not res.get("equivalence_ok", False):
+            problems.append(
+                "coalesced+padded forward was NOT bitwise-identical "
+                "to scoring each request alone"
+            )
+        if res.get("batched_qps", 0.0) < speedup_gate * res.get(
+            "unbatched_qps", 0.0
+        ):
+            problems.append(
+                "batched arm %.0f qps < %.1fx the "
+                "one-request-per-forward arm's %.0f qps"
+                % (
+                    res.get("batched_qps", 0.0),
+                    speedup_gate,
+                    res.get("unbatched_qps", 0.0),
+                )
+            )
+        bursty = res.get("bursty", {})
+        if not (0 < bursty.get("p99_ms", -1.0) < p99_gate_ms):
+            problems.append(
+                "bursty-arm p99 %.0f ms outside the <%.0f ms gate"
+                % (bursty.get("p99_ms", -1.0), p99_gate_ms)
+            )
+        if bursty.get("ok_qps", 0.0) < qps_floor:
+            problems.append(
+                "bursty arm served %.1f qps, under the %.1f qps floor"
+                % (bursty.get("ok_qps", 0.0), qps_floor)
+            )
+        if bursty.get("shed_rate_outside", 1.0) > shed_gate:
+            problems.append(
+                "shed rate %.3f OUTSIDE the burst window exceeds "
+                "%.3f (%d/%d requests; admission must only shed "
+                "under the burst)"
+                % (
+                    bursty.get("shed_rate_outside", 1.0),
+                    shed_gate,
+                    bursty.get("shed_outside_burst", -1),
+                    bursty.get("n_outside", -1),
+                )
+            )
+        if bursty.get("errors", 1):
+            problems.append(
+                "%d bursty-arm request(s) errored (only Overloaded "
+                "sheds are acceptable there)" % bursty.get("errors", 1)
+            )
         if problems:
             print(
                 json.dumps(
@@ -5876,14 +6203,20 @@ def main(argv=None):
             "serving_scorer_qps",
             round(res["qps"], 1),
             "score requests/sec (batch 32) sustained by a %d-process "
-            "scorer fleet under LIVE streaming training churn "
-            "(train->export->serve loop, docs/serving.md): p50 %.0f "
-            "ms, p99 %.0f ms (gate <%.0f ms), %d ok / %d failed over "
-            "%.0f s, every scorer hot-swapped (v%s -> v%s), served-row "
-            "staleness %s <= %d-version window scraped via /metrics "
-            "AFTER a mid-bench PS shard SIGKILL+snapshot-relaunch "
-            "(outage %.1f s; failures confined to it), cache hit "
-            "rates %s"
+            "scorer fleet (micro-batching ON) under LIVE streaming "
+            "training churn (train->export->serve loop, "
+            "docs/serving.md): p50 %.0f ms, p99 %.0f ms (gate <%.0f "
+            "ms), %d ok / %d failed over %.0f s, every scorer "
+            "hot-swapped (v%s -> v%s), served-row staleness %s <= "
+            "%d-version window scraped via /metrics AFTER a mid-bench "
+            "PS shard SIGKILL+snapshot-relaunch (outage %.1f s; "
+            "failures confined to it), cache hit rates %s; "
+            "micro-batching arms (4-row requests, bitwise-equal to "
+            "solo scoring): coalesced %.0f qps vs solo %.0f qps = "
+            "%.1fx (gate >=%.1fx, %.1f rows/forward), bursty arm "
+            "%.0f->%.0f offered qps served %.1f qps at p99 %.0f ms "
+            "with %d burst sheds and %d/%d sheds outside it "
+            "(gate <=%.3f)"
             % (
                 res["n_scorers"],
                 res["p50_ms"],
@@ -5898,6 +6231,19 @@ def main(argv=None):
                 window,
                 res["outage_s"],
                 [round(h, 3) for h in res["hit_rates"]],
+                res["batched_qps"],
+                res["unbatched_qps"],
+                res["batch_speedup"],
+                speedup_gate,
+                res["batched_rows_per_forward"],
+                bursty["base_qps_offered"],
+                bursty["burst_qps_offered"],
+                bursty["ok_qps"],
+                bursty["p99_ms"],
+                bursty["shed_in_burst"],
+                bursty["shed_outside_burst"],
+                bursty["n_outside"],
+                shed_gate,
             ),
             update,
         )
@@ -6356,7 +6702,7 @@ def main(argv=None):
     # the serving-plane gate: a 2-process scorer fleet under live
     # streaming training churn, p99 + staleness-bound + hot-swap +
     # shard-kill-recovery gates (docs/serving.md)
-    section("serving_scorer_qps", ["--serve"], 600)
+    section("serving_scorer_qps", ["--serve"], 900)
     # device sections, cheapest diagnosis first (each shrinks its
     # workload and renames its metric _cpu when the backend is plain
     # CPU, so the suite fits the budget without an accelerator)

@@ -835,6 +835,41 @@ def parse_scorer_args(scorer_args=None):
         help="Export-directory poll cadence for new model versions",
     )
     parser.add_argument(
+        "--serve_max_batch",
+        type=non_neg_int,
+        default=64,
+        help="Micro-batching row budget: concurrent score requests "
+        "coalesce into one jitted forward against power-of-two "
+        "buckets up to this (docs/serving.md, Micro-batching); "
+        "0 or 1 disables batching (the pre-PR-18 inline path)",
+    )
+    parser.add_argument(
+        "--serve_batch_timeout_ms",
+        type=float,
+        default=2.0,
+        help="Latency-budget cutoff: a coalesced batch dispatches at "
+        "a full bucket or this many ms after its oldest request "
+        "enqueued, whichever first — a lone request never waits for "
+        "a full bucket",
+    )
+    parser.add_argument(
+        "--serve_p99_slo_ms",
+        type=float,
+        default=0.0,
+        help="SLO admission control: shed (explicit "
+        "{'error': 'overloaded'}) when the predicted completion time "
+        "— queued batches ahead x the p99 forward estimate from the "
+        "request-latency histogram — exceeds this; 0 disables",
+    )
+    parser.add_argument(
+        "--serve_queue_rows",
+        type=non_neg_int,
+        default=0,
+        help="Hard cap on queued rows before shedding queue_full "
+        "(0 -> 8 x --serve_max_batch) — bounds memory and tail "
+        "latency even before the SLO estimate warms up",
+    )
+    parser.add_argument(
         "--model_zoo",
         default="",
         help="Override the artifact metadata's model_zoo path when "

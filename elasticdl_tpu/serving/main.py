@@ -9,9 +9,12 @@ scorer never blocks the boot on the trainer: it answers
 ``scorer_status``/``/healthz`` immediately and ``score`` errors cleanly
 until the first export lands.
 
-SIGTERM drains: health flips to ``draining``, the RPC plane stops
-taking requests, sync/watcher threads join, channels close, exit 0 —
-scorers are stateless, so there is nothing to snapshot.
+SIGTERM drains: health flips to ``draining``, the micro-batcher stops
+admitting (new submits shed ``draining``) and answers everything
+already queued — an in-flight batch finishes on the model version it
+acquired — then the RPC plane stops taking requests, sync/watcher
+threads join, channels close, exit 0 — scorers are stateless, so there
+is nothing to snapshot.
 """
 
 import signal
@@ -23,8 +26,10 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 
 def build_scorer(args):
     """Construct the scorer stack from parsed args; returns
-    (scorer, watcher, sync, bound_channels)."""
+    (scorer, watcher, sync, bound_channels, batcher). ``batcher`` is
+    None when ``--serve_max_batch <= 1`` (the pre-PR-18 inline path)."""
     from elasticdl_tpu.nn.comm_plane import HotRowCache
+    from elasticdl_tpu.serving.batcher import MicroBatcher
     from elasticdl_tpu.serving.delta_sync import EmbeddingDeltaSync
     from elasticdl_tpu.serving.scorer import ModelDirectoryWatcher, Scorer
     from elasticdl_tpu.worker.ps_client import BoundPS, PSClient
@@ -66,7 +71,18 @@ def build_scorer(args):
             cache,
             interval_s=args.serving_sync_interval_s,
         )
-    return scorer, watcher, sync, bound
+    batcher = None
+    if args.serve_max_batch > 1:
+        batcher = MicroBatcher(
+            scorer,
+            max_batch=args.serve_max_batch,
+            timeout_ms=args.serve_batch_timeout_ms,
+            p99_slo_ms=args.serve_p99_slo_ms,
+            queue_rows=args.serve_queue_rows,
+        )
+        # hot swaps pre-trace every bucket shape, never a request
+        scorer.set_warm_batch_sizes(batcher.buckets)
+    return scorer, watcher, sync, bound, batcher
 
 
 def main():
@@ -80,11 +96,12 @@ def main():
     profiling.spans.set_process("scorer-%d" % args.scorer_id)
     profiling.maybe_arm_flight_recorder()
 
-    scorer, watcher, sync, bound = build_scorer(args)
+    scorer, watcher, sync, bound, batcher = build_scorer(args)
     server = ScorerServer(
         scorer,
         port=args.port,
         telemetry_port=args.scorer_telemetry_port,
+        batcher=batcher,
     )
     watcher.start()
     if sync is not None:

@@ -33,6 +33,31 @@ from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.utils import profiling
 
 
+def _resize_rows(template, rows):
+    """The features template re-tiled to ``rows`` leading rows — how
+    warm-on-swap reaches every micro-batching bucket shape. np.resize
+    repeats cyclically; the values are zeros and never matter, only
+    the traced shapes/dtypes."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: np.resize(a, (int(rows),) + a.shape[1:])
+        if a.ndim >= 1
+        else a,
+        template,
+    )
+
+
+def _template_rows(template):
+    """Leading row count of a features template (None when 0-d)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(template):
+        if getattr(leaf, "ndim", 0) >= 1:
+            return int(leaf.shape[0])
+    return None
+
+
 # Rebuilt models + their jitted forwards, shared ACROSS artifact
 # versions: a streaming trainer exports the same model config every
 # cadence point, and a fresh jit per version would recompile an
@@ -287,6 +312,7 @@ class Scorer:
         self._draining = {}  # model_version -> ScorerModel awaiting drain
         self._drained = threading.Condition(self._mu)
         self._features_template = None
+        self._warm_batch_sizes = ()
         self._swaps = 0
         cache = ps_client.hot_row_cache if ps_client is not None else None
         self._cache = cache
@@ -314,6 +340,12 @@ class Scorer:
             "edl_scorer_requests_total",
             "Score requests by outcome",
             labels=("outcome",),
+        )
+        self._c_errors = r.counter(
+            "edl_scorer_errors_total",
+            "Degraded-path score failures by kind — the reply-payload "
+            "errors /metrics previously could not alert on",
+            labels=("kind",),
         )
         r.register_collector(self._collect)
 
@@ -373,6 +405,18 @@ class Scorer:
         out.append(("edl_scorer_model_swaps_total", {}, swaps))
         return out
 
+    def note_error(self, kind):
+        """Count a degraded-path failure under a bounded ``kind`` label
+        (``bad_request``/``no_model``/``overloaded``/``predict``) so
+        /metrics can alert on reply-payload errors."""
+        self._c_errors.inc(kind=kind)
+
+    def latency_p99(self):
+        """p99 estimate (seconds) from the request-latency histogram —
+        what the micro-batcher's SLO admission control feeds on; None
+        until the first success lands."""
+        return self._h_latency.quantile(0.99)
+
     def close(self):
         profiling.metrics.unregister_collector(self._collect)
 
@@ -410,28 +454,50 @@ class Scorer:
                 self._current.version if self._current is not None else -1
             )
 
+    def set_warm_batch_sizes(self, sizes):
+        """Row counts :meth:`install` warms in addition to the last
+        request's own shape — the micro-batcher registers its bucket
+        ladder here so a hot swap pre-traces EVERY bucket and no
+        post-swap batch pays a first-request compile."""
+        with self._mu:
+            self._warm_batch_sizes = tuple(
+                sorted({int(s) for s in sizes if int(s) > 0})
+            )
+
     def install(self, model, warm=True):
         """Swap the serving model to ``model`` (idempotent on version).
 
         ``warm`` pre-traces the new executable against the last
-        request's feature shapes BEFORE the flip, so no request ever
-        pays the per-version jit compile; the capture lock is held
-        through the warm forward because a first trace runs the module
-        body on the tracing thread (docs/serving.md). In-flight
+        request's feature shapes — and every registered micro-batching
+        bucket (:meth:`set_warm_batch_sizes`) — BEFORE the flip, so no
+        request ever pays the per-version jit compile; the capture lock
+        is held through the prepare because a first trace runs the
+        module body on the tracing thread (docs/serving.md). In-flight
         requests keep the model they acquired; the superseded version
         drops from the ledger when its count drains to zero.
         """
         with self._mu:
             template = self._features_template
+            warm_sizes = self._warm_batch_sizes
         if warm and template is not None:
+            t_rows = _template_rows(template)
+            sizes = [None]  # the template's own shape, always
+            if t_rows is not None and warm_sizes:
+                sizes = sorted(set(warm_sizes) | {t_rows})
             try:
                 with self._capture_mu:
                     model.prepare(template)
-                model.predict(
-                    template,
-                    plane=self._plane,
-                    capture_lock=self._capture_mu,
-                )
+                for n in sizes:
+                    shaped = (
+                        template
+                        if n is None or n == t_rows
+                        else _resize_rows(template, n)
+                    )
+                    model.predict(
+                        shaped,
+                        plane=self._plane,
+                        capture_lock=self._capture_mu,
+                    )
             except Exception:  # noqa: BLE001 — warm is best-effort
                 logger.warning(
                     "warming export v%d failed; first request pays "
@@ -518,7 +584,12 @@ class Scorer:
 
     def score(self, features):
         """Score one batch -> (output, model_version)."""
-        model = self._acquire()
+        try:
+            model = self._acquire()
+        except Exception:
+            self._c_requests.inc(outcome="error")
+            self.note_error("no_model")
+            raise
         try:
             with self._mu:
                 need_template = self._features_template is None
@@ -544,6 +615,7 @@ class Scorer:
             return out, model.version
         except Exception:
             self._c_requests.inc(outcome="error")
+            self.note_error("predict")
             raise
         finally:
             self._release(model)

@@ -10,7 +10,10 @@ payloads ride slots, and every method is instrumented with the
 
 Both RPCs are idempotent reads (edlint R9): scoring mutates nothing but
 cache residency, so a client may retry a timed-out ``score`` freely —
-the serving plane's retry discipline (docs/serving.md).
+the serving plane's retry discipline (docs/serving.md). That includes
+the micro-batcher's shed reply: ``{"error": "overloaded"}`` is an
+explicit degrade BEFORE any work happened, the safest retry there is
+(against another scorer, or after backoff).
 """
 
 import threading
@@ -18,15 +21,20 @@ import threading
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
+from elasticdl_tpu.serving.batcher import Overloaded
 from elasticdl_tpu.utils import profiling
 
 
 class ScorerServicer:
     """Dict-method servicer over one :class:`~elasticdl_tpu.serving.
-    scorer.Scorer` — served via rpc.core or called in-process."""
+    scorer.Scorer` — served via rpc.core or called in-process. With a
+    :class:`~elasticdl_tpu.serving.batcher.MicroBatcher`, ``score``
+    enqueues into the coalescing queue instead of calling the scorer
+    inline (docs/serving.md, "Micro-batching")."""
 
-    def __init__(self, scorer):
+    def __init__(self, scorer, batcher=None):
         self._scorer = scorer
+        self._batcher = batcher
 
     def score(self, req):
         """Score the request's feature arrays.
@@ -36,17 +44,27 @@ class ScorerServicer:
         models) or ``out:<name>`` fields (dict outputs), plus
         ``model_version``. Failures return ``{"error": ...}`` instead
         of a transport error: the request was well-formed, the plane
-        is degraded (e.g. the PS fleet is mid-relaunch) — callers gate
-        on the field and retry on their own policy."""
+        is degraded (e.g. the PS fleet is mid-relaunch) or shedding
+        (``overloaded`` + ``reason``) — callers gate on the field and
+        retry on their own policy."""
         features = {
             k: np.asarray(v)
             for k, v in req.items()
             if not k.startswith("_")
         }
         if not features:
+            # counted here, not in Scorer.score: the request never
+            # reaches it (the no_model/predict kinds are counted there)
+            self._scorer.note_error("bad_request")
             return {"error": "score request carried no feature arrays"}
         try:
-            out, version = self._scorer.score(features)
+            if self._batcher is not None:
+                out, version = self._batcher.submit(features)
+            else:
+                out, version = self._scorer.score(features)
+        except Overloaded as err:
+            self._scorer.note_error("overloaded")
+            return {"error": "overloaded", "reason": err.reason}
         except Exception as err:  # noqa: BLE001 — degraded, reported
             logger.warning("score request failed: %s", err)
             return {"error": str(err)[:500]}
@@ -82,12 +100,15 @@ class ScorerServer:
     model installs, then ``serving``, ``draining`` through stop).
     """
 
-    def __init__(self, scorer, port=0, telemetry_port=-1):
+    def __init__(self, scorer, port=0, telemetry_port=-1, batcher=None):
         from elasticdl_tpu.rpc.core import serve
         from elasticdl_tpu.rpc.shm_transport import install_shm_endpoint
 
         self._scorer = scorer
-        self.servicer = ScorerServicer(scorer)
+        self._batcher = batcher
+        if batcher is not None:
+            batcher.start()
+        self.servicer = ScorerServicer(scorer, batcher=batcher)
         self._draining = threading.Event()
         self._telemetry_http = None
         if telemetry_port is not None and telemetry_port >= 0:
@@ -124,6 +145,13 @@ class ScorerServer:
 
     def stop(self):
         self._draining.set()
+        if self._batcher is not None:
+            # drain BEFORE the transport goes: new submits shed as
+            # "draining", queued requests get their replies, in-flight
+            # batches finish on the version they acquired
+            self._batcher.stop(drain=True)
+            self._batcher.close()
+            self._batcher = None
         if self._server is not None:
             self._server.stop(grace=None)
             self._server = None

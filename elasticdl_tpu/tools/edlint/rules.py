@@ -381,7 +381,32 @@ class BlockingUnderLockRule(Rule):
         "do the slow thing after release; call chains are followed "
         "through same-class methods AND, via the whole-program call "
         "graph, across module boundaries (imported functions, "
-        "self._field.method() with constructor-typed fields)"
+        "self._field.method() with constructor-typed fields); in the "
+        "micro-batcher (serving/batcher.py) jit dispatch and padding "
+        "copies count as blocking too — the queue lock serializes "
+        "every submitter, so the forward and the batch assembly must "
+        "run off it (docs/serving.md, Micro-batching)"
+    )
+
+    # PR-18 batcher scope: inside serving/batcher.py a jitted forward
+    # (score/predict) or a padding copy (concatenate & friends) under
+    # the batcher lock stalls every concurrent submitter behind the
+    # slowest thing in the file — the whole point of the off-lock
+    # dispatch discipline. Scoped: elsewhere these names are ordinary
+    # compute calls.
+    DISPATCH_SCOPED_FILES = ("elasticdl_tpu/serving/batcher.py",)
+    _DISPATCH_CALLS = frozenset(("score", "predict", "submit"))
+    _PAD_COPY_CALLS = frozenset(
+        (
+            "concatenate",
+            "stack",
+            "vstack",
+            "hstack",
+            "tile",
+            "repeat",
+            "resize",
+            "pad",
+        )
     )
 
     def _lockish(self, ctx, expr):
@@ -407,6 +432,11 @@ class BlockingUnderLockRule(Rule):
             return None
         b, rname = _receiver(call)
         low = rname.lower()
+        if ctx.path in self.DISPATCH_SCOPED_FILES:
+            if tail in self._DISPATCH_CALLS:
+                return "jit dispatch (%s)" % tail
+            if tail in self._PAD_COPY_CALLS:
+                return "padding copy (%s)" % tail
         if tail == "sleep":
             return "sleep"
         if tail in ("put", "get"):
@@ -854,6 +884,10 @@ class LocksetRaceRule(Rule):
         "elasticdl_tpu/common/",
         "elasticdl_tpu/data/",
         "elasticdl_tpu/rpc/",
+        # PR-18: the serving plane joined when the micro-batcher made
+        # its request path multi-threaded by construction (submitters
+        # x dispatcher x watcher x delta sync)
+        "elasticdl_tpu/serving/",
     )
     SCOPE_FILES = ("elasticdl_tpu/utils/profiling.py",)
 
@@ -951,7 +985,12 @@ RPC_IDEMPOTENT = frozenset(
         "pull_embedding_delta",
         # the scorer's own RPC surface (serving/server.py): scoring
         # mutates nothing but cache residency, and scorer_status is a
-        # pure read — a client may retry a timed-out score
+        # pure read — a client may retry a timed-out score. Still true
+        # under PR-18 micro-batching: a coalesced forward is the same
+        # pure read, and the admission-control shed reply
+        # ({"error": "overloaded"}) happens BEFORE any work, so a
+        # retry against another scorer (or after backoff) is always
+        # safe — the degrade is the retry signal, not a side effect.
         "score",
         "scorer_status",
     )

@@ -344,6 +344,25 @@ class Histogram(_Metric):
                 return None
             return list(slot[0]), slot[1], slot[2]
 
+    def quantile(self, q, **labels):
+        """Upper-bound estimate of the ``q`` quantile (0 < q <= 1):
+        the smallest bucket edge whose cumulative count covers
+        ``q * count``. Returns None for an empty series, and the last
+        finite edge when the quantile lands in +Inf — a conservative
+        (never-understated... up to the top edge) read that is exactly
+        what SLO admission control wants (docs/serving.md)."""
+        got = self.data(**labels)
+        if got is None or got[2] == 0:
+            return None
+        counts, _, total = got
+        need = q * total
+        cum = 0
+        for i, edge in enumerate(self.buckets):
+            cum += counts[i]
+            if cum >= need:
+                return edge
+        return self.buckets[-1]
+
     def _samples(self):
         for key, slot in self._series.items():
             cum = 0

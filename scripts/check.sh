@@ -98,6 +98,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_chaos.py \
     tests/test_master_journal.py \
     tests/test_serving.py \
+    tests/test_serving_batcher.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 echo "check.sh: all gates green"

@@ -75,6 +75,7 @@ _LOCKTRACE_SUITES = {
     "test_chaos",
     "test_master_journal",
     "test_serving",
+    "test_serving_batcher",
 }
 
 

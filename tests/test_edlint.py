@@ -446,6 +446,96 @@ def test_r5_condition_wait_under_its_own_lock_is_fine(tmp_path):
     assert not good
 
 
+# the PR-18 micro-batcher scope: inside serving/batcher.py the queue
+# lock must stay dispatch- and copy-free — a jitted forward or a
+# padding concatenate under it serializes every concurrent submitter
+# behind the slowest thing in the file (docs/serving.md)
+BATCHER_R5_BAD = """
+import threading
+
+import numpy as np
+
+
+class B:
+    def __init__(self, scorer):
+        self._mu = threading.Lock()
+        self._scorer = scorer
+        self._queue = []
+
+    def submit(self, feats):
+        with self._mu:
+            self._queue.append(feats)
+            batch = np.concatenate(self._queue)
+            out = self._scorer.score(batch)
+            self._queue = []
+        return out
+"""
+
+BATCHER_R5_GOOD = """
+import threading
+
+import numpy as np
+
+
+class B:
+    def __init__(self, scorer):
+        self._mu = threading.Lock()
+        self._scorer = scorer
+        self._queue = []
+
+    def submit(self, feats):
+        with self._mu:
+            self._queue.append(feats)
+            take, self._queue = self._queue, []
+        batch = np.concatenate(take)
+        return self._scorer.score(batch)
+"""
+
+
+def test_r5_batcher_no_dispatch_or_padding_copy_under_lock(tmp_path):
+    bad = _lint(
+        tmp_path,
+        BATCHER_R5_BAD,
+        relpath="elasticdl_tpu/serving/batcher.py",
+    )
+    assert _rules_of(bad) == ["R5"], bad
+    kinds = " ".join(v.message for v in bad)
+    assert "jit dispatch" in kinds, kinds
+    assert "padding copy" in kinds, kinds
+    # snapshot-under-lock, assemble-and-score after release: clean
+    good = _lint(
+        tmp_path,
+        BATCHER_R5_GOOD,
+        relpath="elasticdl_tpu/serving/batcher.py",
+    )
+    assert not good
+
+
+def test_r5_batcher_scope_is_the_batcher_file(tmp_path):
+    """score/concatenate are ordinary compute everywhere else — the
+    dispatch/padding kinds only arm inside serving/batcher.py."""
+    elsewhere = _lint(
+        tmp_path,
+        BATCHER_R5_BAD,
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert "R5" not in _rules_of(elsewhere), elsewhere
+
+
+def test_r8_serving_plane_joined_the_lockset_scope(tmp_path):
+    """PR-18 made the serving plane's request path multi-threaded by
+    construction (submitters x dispatcher x watcher x sync), so
+    serving/ files now gate under the R8 lockset-race rule."""
+    bad = _lint(
+        tmp_path, R8_RACE, relpath="elasticdl_tpu/serving/fixture.py"
+    )
+    assert _rules_of(bad) == ["R8"], bad
+    good = _lint(
+        tmp_path, R8_LOCKED, relpath="elasticdl_tpu/serving/fixture.py"
+    )
+    assert not good
+
+
 # ---------------------------------------------------------------------------
 # R6 — silent broad except (real pre-fix violation: worker/main's
 # swallowed leave announcement)

@@ -1,0 +1,541 @@
+"""Micro-batching for the serving plane (docs/serving.md, PR 18).
+
+Coverage map (ISSUE 18):
+
+- the latency-budget cutoff: a lone request never waits for a full
+  bucket — it dispatches (padded to its pow2 bucket) within the budget,
+- bitwise equivalence: per-request outputs from a coalesced, padded
+  forward are identical to unbatched scoring from one common artifact
+  (padding REPEATS real rows, so the dedup plan's unique-id set — and
+  therefore the PS pull — is unchanged),
+- de-multiplex order: concurrent callers each get exactly their own
+  rows back,
+- admission control: queue-cap and SLO sheds surface as the explicit
+  ``{"error": "overloaded"}`` degrade payload through the servicer,
+  counted in ``edl_scorer_shed_total`` and
+  ``edl_scorer_errors_total{kind="overloaded"}``,
+- swap/drain discipline: an in-flight coalesced batch finishes on the
+  model version it acquired across a hot swap, and ``stop(drain=True)``
+  (the SIGTERM path) answers everything already queued while new
+  submits shed ``draining``,
+- warm-on-swap: ``Scorer.set_warm_batch_sizes`` makes ``install`` pre-
+  trace every registered bucket shape,
+- the error-kind counter fix: ``bad_request``/``no_model`` degraded
+  paths land in ``edl_scorer_errors_total``.
+
+Runs under EDL_LOCKTRACE=1 in scripts/check.sh (conftest suites): the
+dispatcher thread must be daemon and join on stop.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.serving.batcher import (
+    MicroBatcher,
+    Overloaded,
+    batch_buckets,
+    request_signature,
+)
+from elasticdl_tpu.serving.scorer import ModelDirectoryWatcher, Scorer
+from elasticdl_tpu.serving.server import ScorerServicer
+from elasticdl_tpu.utils import profiling
+from tests.test_serving import (
+    _client,
+    _deepfm_params,
+    _export,
+    _features,
+    _ps_shards,
+)
+
+
+class FakeScorer:
+    """Echo scorer for queue-discipline tests: returns the ``x``
+    feature untouched (so de-multiplexed rows are self-identifying),
+    records every forward's row count, and can block on an event."""
+
+    def __init__(self, version=1):
+        self.version = version
+        self.calls = []
+        self.gate = None  # threading.Event the forward waits on
+        self.entered = threading.Event()
+
+    def score(self, feats):
+        self.calls.append(int(feats["x"].shape[0]))
+        self.entered.set()
+        if self.gate is not None:
+            assert self.gate.wait(10.0)
+        return np.asarray(feats["x"]).copy(), self.version
+
+    def latency_p99(self):
+        return 0.001
+
+
+def _req(value, rows=2):
+    return {"x": np.full((rows,), float(value), dtype=np.float32)}
+
+
+def _as_np(out):
+    """Model outputs (array or dict of arrays) as numpy."""
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    return np.asarray(out)
+
+
+def _assert_bitwise_equal(got, want, label):
+    got, want = _as_np(got), _as_np(want)
+    if isinstance(want, dict):
+        assert sorted(got) == sorted(want), (label, got, want)
+        for k in want:
+            assert np.array_equal(got[k], want[k]), (
+                "%s: output %r differs from unbatched" % (label, k)
+            )
+    else:
+        assert np.array_equal(got, want), (
+            "%s: batched output differs from unbatched" % label
+        )
+
+
+# ---------------------------------------------------------------------------
+# buckets + signatures
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_and_bucket_for():
+    assert batch_buckets(32) == [1, 2, 4, 8, 16, 32]
+    assert batch_buckets(48) == [1, 2, 4, 8, 16, 32, 48]
+    assert batch_buckets(1) == [1]
+    b = MicroBatcher(FakeScorer(), max_batch=8)
+    assert b.bucket_for(3) == 4
+    assert b.bucket_for(8) == 8
+    assert b.bucket_for(9) == 16  # oversize head: next pow2 off-ladder
+    b.close()
+
+
+def test_request_signature_gates_coalescing():
+    rows, sig = request_signature(
+        {"a": np.zeros((4, 3)), "b": np.zeros((4,), np.int64)}
+    )
+    assert rows == 4
+    assert sig == (("a", "float64", (3,)), ("b", "int64", ()))
+    # ragged leading dims, 0-d features, zero rows: inline, not batched
+    assert request_signature(
+        {"a": np.zeros((4, 3)), "b": np.zeros((2,))}
+    ) == (None, None)
+    assert request_signature({"a": np.float32(1.0)}) == (None, None)
+    assert request_signature({"a": np.zeros((0, 3))}) == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# the cutoff + coalescing + de-multiplexing (fake scorer)
+# ---------------------------------------------------------------------------
+
+
+def test_lone_request_cutoff_fires_within_budget():
+    s = FakeScorer()
+    b = MicroBatcher(s, max_batch=32, timeout_ms=20.0)
+    b.start()
+    try:
+        t0 = time.monotonic()
+        out, version = b.submit(_req(7.0, rows=3))
+        waited = time.monotonic() - t0
+        assert version == 1
+        assert np.array_equal(out, np.full((3,), 7.0, np.float32))
+        # the cutoff, not the full bucket, dispatched it: one forward,
+        # padded to the 3-row request's pow2 bucket, well within the
+        # budget plus scheduling slack
+        assert s.calls == [4]
+        assert waited < 5.0, waited
+    finally:
+        b.stop()
+
+
+def test_concurrent_callers_coalesce_and_demux_in_order():
+    s = FakeScorer()
+    b = MicroBatcher(s, max_batch=16, timeout_ms=25.0)
+    b.start()
+    try:
+        n = 8
+        results = [None] * n
+        errs = []
+
+        def call(i):
+            try:
+                results[i] = b.submit(_req(float(i), rows=2))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert not errs, errs
+        for i, (out, version) in enumerate(results):
+            assert version == 1
+            assert np.array_equal(
+                out, np.full((2,), float(i), np.float32)
+            ), "caller %d got someone else's rows: %r" % (i, out)
+        # genuinely coalesced: fewer forwards than callers
+        assert len(s.calls) < n, s.calls
+    finally:
+        b.stop()
+
+
+def test_mixed_signatures_never_share_a_forward():
+    s = FakeScorer()
+    b = MicroBatcher(s, max_batch=16, timeout_ms=25.0)
+    b.start()
+    try:
+        results = {}
+
+        def call(name, feats):
+            results[name] = b.submit(feats)
+
+        a = {"x": np.full((2,), 1.0, np.float32)}
+        c = {"x": np.full((2, 3), 2.0, np.float32)}  # different trailing
+        ts = [
+            threading.Thread(target=call, args=("a", a)),
+            threading.Thread(target=call, args=("c", c)),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10.0)
+        assert np.array_equal(results["a"][0], a["x"])
+        assert np.array_equal(results["c"][0], c["x"])
+        assert len(s.calls) == 2  # one forward per signature
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control + the degrade payload
+# ---------------------------------------------------------------------------
+
+
+def test_shed_path_returns_the_degrade_payload():
+    s = FakeScorer()
+    s.gate = threading.Event()  # dispatcher parks inside the forward
+    b = MicroBatcher(s, max_batch=2, timeout_ms=0.0, queue_rows=2)
+    b.start()
+    try:
+        counting = _CountingScorer(s)
+        servicer = ScorerServicer(counting, batcher=b)
+        shed_before = b._c_shed.value(reason="queue_full")
+        # fill: one batch parked in flight + a provably full queue
+        waiters = [
+            threading.Thread(
+                target=lambda: _swallow(lambda: b.submit(_req(0.0)))
+            )
+            for _ in range(2)
+        ]
+        waiters[0].start()
+        assert s.entered.wait(10.0)  # batch 1 parked in the forward
+        waiters[1].start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and b.queue_depth()[0] < 1:
+            time.sleep(0.005)
+        assert b.queue_depth()[0] == 1  # queue at its 2-row cap
+        reply = servicer.score({"x": np.zeros(2, np.float32)})
+        assert reply == {"error": "overloaded", "reason": "queue_full"}
+        assert b._c_shed.value(reason="queue_full") > shed_before
+        assert counting.kinds == ["overloaded"]
+    finally:
+        s.gate.set()
+        b.stop()
+        for t in waiters:
+            t.join(10.0)
+
+
+def test_slo_admission_sheds_past_the_budget():
+    """One batch ahead x a way-over-SLO p99 estimate sheds; an IDLE
+    plane admits even with the same poisoned estimate (admission
+    predicts queue wait, never the request's own forward)."""
+    s = FakeScorer()
+    s.gate = threading.Event()
+    s.latency_p99 = lambda: 10.0  # the histogram says: way over SLO
+    b = MicroBatcher(s, max_batch=8, timeout_ms=1.0, p99_slo_ms=50.0)
+    b.start()
+    waiter = threading.Thread(target=_swallow, args=(lambda: b.submit(_req(1.0)),))
+    try:
+        waiter.start()
+        assert s.entered.wait(10.0)  # batch 1 parked in its forward
+        with pytest.raises(Overloaded) as exc:
+            b.submit(_req(2.0))  # one batch ahead -> 10 s wait >> 50 ms
+        assert exc.value.reason == "slo"
+    finally:
+        s.gate.set()
+        b.stop()
+        waiter.join(10.0)
+
+
+class _CountingScorer:
+    """note_error pass-through so the servicer tests can run against
+    the FakeScorer (which has no metrics plumbing)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.kinds = []
+
+    def note_error(self, kind):
+        self.kinds.append(kind)
+
+    def score(self, feats):
+        return self._inner.score(feats)
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Overloaded:
+        pass
+
+
+def test_error_kind_counter_on_degraded_paths(tmp_path):
+    """The satellite fix: degraded-path failures land in
+    ``edl_scorer_errors_total{kind=...}``, not only the reply payload."""
+    scorer = Scorer(ps_client=None)
+    try:
+        servicer = ScorerServicer(scorer)
+        c = scorer._c_errors
+        bad_before = c.value(kind="bad_request")
+        none_before = c.value(kind="no_model")
+        reply = servicer.score({"_sctx": "meta-only"})
+        assert "error" in reply
+        assert c.value(kind="bad_request") == bad_before + 1
+        reply = servicer.score({"x": np.zeros(2, np.float32)})
+        assert "error" in reply  # no model installed yet
+        assert c.value(kind="no_model") == none_before + 1
+    finally:
+        scorer.close()
+
+
+# ---------------------------------------------------------------------------
+# bitwise equivalence + swap/drain against the real scoring path
+# ---------------------------------------------------------------------------
+
+
+def _real_scorer(tmp_path):
+    """Scorer over in-process PS shards, v1 exported and installed."""
+    export_root = str(tmp_path / "exports")
+    os.makedirs(export_root, exist_ok=True)
+    _, params = _deepfm_params(seed=0)
+    _export(export_root, params, 1)
+    shards = _ps_shards(1)
+    client, _cache = _client(shards)
+    scorer = Scorer(ps_client=client, staleness_versions=2)
+    scorer._edl_test_client = client  # _close_real joins the fan-out
+    watcher = ModelDirectoryWatcher(export_root, scorer)
+    assert watcher.poll_once() == 1
+    return scorer, watcher, export_root
+
+
+def _close_real(scorer):
+    scorer.close()
+    scorer._edl_test_client.close()
+
+
+def test_batched_outputs_bitwise_equal_unbatched(tmp_path):
+    """Per-request outputs from one coalesced, repeat-row-padded
+    forward are bitwise identical to scoring each request alone from
+    the same artifact — the acceptance-criteria pre-pass, in-process."""
+    scorer, _watcher, _root = _real_scorer(tmp_path)
+    try:
+        requests = [_features(n=n, seed=n) for n in (3, 4, 5)]
+        reference = [
+            _as_np(scorer.score(f)[0]) for f in requests
+        ]  # unbatched, one at a time
+
+        b = MicroBatcher(scorer, max_batch=16, timeout_ms=50.0)
+        b.start()
+        try:
+            batches_before = b._c_batches.value()
+            results = [None] * len(requests)
+
+            def call(i):
+                results[i] = b.submit(requests[i])
+
+            threads = [
+                threading.Thread(target=call, args=(i,))
+                for i in range(len(requests))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30.0)
+            # 3+4+5 = 12 rows -> ONE forward in the 16-bucket
+            assert b._c_batches.value() == batches_before + 1
+            for i, (out, version) in enumerate(results):
+                assert version == 1
+                _assert_bitwise_equal(out, reference[i], "request %d" % i)
+        finally:
+            b.stop()
+    finally:
+        _close_real(scorer)
+
+
+def test_hot_swap_drains_inflight_batch_on_old_version(tmp_path):
+    """An in-flight coalesced batch finishes on the version it
+    acquired; the next batch scores the new version; the superseded
+    version leaves the ledger once drained."""
+    scorer, watcher, export_root = _real_scorer(tmp_path)
+    _, params2 = _deepfm_params(seed=1)
+    try:
+        feats = _features(n=4, seed=0)
+        scorer.score(feats)  # prepare + record the template
+
+        v1_model = scorer.model()
+        entered = threading.Event()
+        proceed = threading.Event()
+        real_predict = v1_model.predict
+
+        def slow_predict(*a, **kw):
+            entered.set()
+            assert proceed.wait(10.0)
+            return real_predict(*a, **kw)
+
+        v1_model.predict = slow_predict
+
+        b = MicroBatcher(scorer, max_batch=8, timeout_ms=1.0)
+        b.start()
+        try:
+            first = {}
+
+            def request_a():
+                first["out"], first["version"] = b.submit(feats)
+
+            ta = threading.Thread(target=request_a)
+            ta.start()
+            assert entered.wait(10.0)  # batch A parked inside v1
+            _export(export_root, params2, 2)
+            v1_model.predict = real_predict  # warm of v2 scores clean
+            assert watcher.poll_once() == 2
+            assert scorer.model_version == 2
+            assert scorer.inflight_versions().get(1) == 1
+            second = {}
+
+            def request_b():
+                second["out"], second["version"] = b.submit(feats)
+
+            tb = threading.Thread(target=request_b)
+            tb.start()
+            proceed.set()
+            ta.join(10.0)
+            tb.join(10.0)
+            assert first["version"] == 1  # finished on what it acquired
+            assert second["version"] == 2  # next batch: new version
+            assert scorer.wait_drained(1, timeout=10.0)
+            assert 1 not in scorer.inflight_versions()
+        finally:
+            proceed.set()
+            b.stop()
+    finally:
+        _close_real(scorer)
+
+
+def test_stop_drains_queue_and_sheds_new_submits():
+    """The SIGTERM discipline: ``stop(drain=True)`` answers everything
+    already queued; submits arriving mid-drain shed ``draining``."""
+    s = FakeScorer()
+    s.gate = threading.Event()
+    b = MicroBatcher(s, max_batch=2, timeout_ms=0.0, queue_rows=64)
+    b.start()
+    results, shed = [], []
+
+    def call(i):
+        try:
+            results.append(b.submit(_req(float(i))))
+        except Overloaded as e:
+            shed.append(e.reason)
+
+    callers = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in callers:
+        t.start()
+    assert s.entered.wait(10.0)  # batch 1 parked; the rest queued
+
+    stopper = threading.Thread(target=lambda: b.stop(drain=True))
+    stopper.start()
+    deadline = time.monotonic() + 10.0
+    late = []
+
+    def late_call():
+        try:
+            b.submit(_req(99.0))
+            late.append("scored")
+        except Overloaded as e:
+            late.append(e.reason)
+
+    # wait until stop() has latched _stopping, then submit late
+    while time.monotonic() < deadline and not b._stopping:
+        time.sleep(0.005)
+    threading.Thread(target=late_call).start()
+    time.sleep(0.05)
+    s.gate.set()  # release the parked forward; drain completes
+    stopper.join(15.0)
+    for t in callers:
+        t.join(10.0)
+    assert len(results) == 4, (results, shed)  # every queued req answered
+    assert not shed
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not late:
+        time.sleep(0.01)
+    assert late == ["draining"], late
+
+
+def test_warm_on_swap_pretraces_every_bucket(tmp_path):
+    """``set_warm_batch_sizes`` + ``install``: a swap pre-traces every
+    registered bucket shape, so no post-swap batch pays a compile."""
+    scorer, watcher, export_root = _real_scorer(tmp_path)
+    try:
+        scorer.set_warm_batch_sizes([2, 4, 8])
+        scorer.score(_features(n=4, seed=0))  # record the template
+
+        from elasticdl_tpu.serving import scorer as scorer_mod
+
+        warmed = []
+        real_predict = scorer_mod.ScorerModel.predict
+
+        def recording_predict(self, features, **kw):
+            warmed.append(int(features["feature"].shape[0]))
+            return real_predict(self, features, **kw)
+
+        _, params2 = _deepfm_params(seed=1)
+        _export(export_root, params2, 2)
+        try:
+            scorer_mod.ScorerModel.predict = recording_predict
+            assert watcher.poll_once() == 2
+        finally:
+            scorer_mod.ScorerModel.predict = real_predict
+        # every registered bucket warmed on the watcher's install
+        assert set(warmed) >= {2, 4, 8}, warmed
+    finally:
+        _close_real(scorer)
+
+
+def test_queue_depth_telemetry_collector():
+    s = FakeScorer()
+    s.gate = threading.Event()
+    b = MicroBatcher(s, max_batch=4, timeout_ms=0.0)
+    b.start()
+    try:
+        holder = threading.Thread(
+            target=lambda: _swallow(lambda: b.submit(_req(1.0, rows=3)))
+        )
+        holder.start()
+        assert s.entered.wait(10.0)
+        samples = {
+            name: value for name, _labels, value in b._collect()
+        }
+        assert samples["edl_scorer_queue_rows"] >= 3
+        text = profiling.metrics.prometheus_text()
+        assert "edl_scorer_queue_depth" in text
+    finally:
+        s.gate.set()
+        b.stop()
+        holder.join(10.0)
