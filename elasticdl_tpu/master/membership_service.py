@@ -320,7 +320,7 @@ class MembershipService:
                     "member",
                     event="join",
                     worker=worker_id,
-                    epoch=self._epoch,
+                    epoch=join_event["epoch"],
                 )
 
     def _register_locked(self, worker_id, host):
@@ -366,6 +366,10 @@ class MembershipService:
             else:
                 self._live[worker_id] = host
                 self._bump_locked()
+            # post-transition epoch, captured under the lock: the epoch
+            # this member actually serves in (a bumping join increments
+            # it above), and the value journal recovery max()es over
+            join_event["epoch"] = self._epoch
             return join_event
 
     # process exit codes whose *announced* exits are protocol-clean:
@@ -433,7 +437,7 @@ class MembershipService:
                     "member",
                     event="leave",
                     worker=worker_id,
-                    epoch=self._epoch,
+                    epoch=leave_event["epoch"],
                 )
 
     def _remove_locked(
@@ -479,6 +483,10 @@ class MembershipService:
                 # job never waits out a detection window
                 self._pending_bump_deadline = None
                 self._bump_locked()
+            # post-transition epoch under the lock, same as register():
+            # a bumping death attributes the leave to the epoch it
+            # created, and the off-lock journal append below reuses it
+            leave_event["epoch"] = self._epoch
             return leave_event
 
     def get_world(self, worker_id, host="localhost", awaiting=True):

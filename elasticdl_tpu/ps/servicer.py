@@ -166,20 +166,24 @@ class PserverServicer:
             EmbeddingTableInfo(i["name"], i["dim"], i.get("initializer", "uniform"))
             for i in req.get("embedding_infos", [])
         ]
-        with self._lock:
-            self._parameters.init_from_model(
-                req.get("version", 0), dense, infos
-            )
+        # no servicer lock: Parameters is self-synchronized (first-
+        # write-wins under ITS lock, tables built off-lock), and a
+        # tiered table's constructor re-attaches spill segments from
+        # disk — file IO under ``_lock`` would stall every concurrent
+        # push_gradient for the whole init
+        self._parameters.init_from_model(
+            req.get("version", 0), dense, infos
+        )
         return self._reply({})
 
     def push_embedding_info(self, req):
-        with self._lock:
-            self._parameters.init_embedding_params(
-                EmbeddingTableInfo(
-                    i["name"], i["dim"], i.get("initializer", "uniform")
-                )
-                for i in req.get("embedding_infos", [])
+        # no servicer lock — same reasoning as push_model above
+        self._parameters.init_embedding_params(
+            EmbeddingTableInfo(
+                i["name"], i["dim"], i.get("initializer", "uniform")
             )
+            for i in req.get("embedding_infos", [])
+        )
         return self._reply({})
 
     def push_gradient(self, req):
@@ -262,10 +266,19 @@ class PserverServicer:
                 self._dense_sum.clear()
                 self._indexed_sum.clear()
                 self._grad_n = 0
-                self._maybe_snapshot()
-            return self._reply(
+                applied = True
+            else:
+                applied = False
+            reply = self._reply(
                 {"accepted": True, "version": self._parameters.version}
             )
+        if applied:
+            # off the accumulation lock: the cadence hook captures under
+            # the optimizer's apply lock and submits to the snapshotter
+            # queue (a blocking put when full) — neither should stall
+            # concurrent push_gradient accumulation
+            self._maybe_snapshot()
+        return reply
 
     def _apply(self, gradients, request_version):
         # async applies consume the request's zero-copy views entirely

@@ -103,6 +103,14 @@ class FileContext:
         self.condition_bindings = set()
         self._collect_bindings()
 
+    def __getstate__(self):
+        # the AST cache pickles whole FileContexts; a live Project
+        # reference would drag the entire cross-file index (and every
+        # other file) into each entry
+        state = dict(self.__dict__)
+        state["project"] = None
+        return state
+
     def line(self, node):
         return self.line_at(node.lineno)
 
@@ -177,31 +185,80 @@ def iter_source_files(root):
                     yield os.path.join(dirpath, name)
 
 
-def scan(root, rule_ids=None, use_cache=True):
+def scan_project(root, rule_ids=None, use_cache=True, only_paths=None):
     """All raw findings over ``root`` (before the ratchet), in
-    (path, lineno) order, plus files that failed to parse.
+    (path, lineno) order, plus files that failed to parse, plus the
+    Project the rules ran against.
 
     Every scan is whole-program: the modules parse once (through the
     mtime-keyed AST cache unless ``use_cache=False``), a Project is
     built over all of them, and each rule sees per-file contexts that
-    carry the cross-file call graph (``ctx.project``)."""
-    from elasticdl_tpu.tools.edlint.project import Project, load_contexts
+    carry the cross-file call graph (``ctx.project``). ``only_paths``
+    (repo-relative) is the incremental mode: rules run ONLY on the
+    named files, but resolution — the call graph, thread roots, R8
+    locksets, the R11 lock graph — still spans the whole tree, so a
+    cross-file finding surfaced in a named file stays correct. When
+    nothing changed since the last cached run, the whole analyzed
+    Project loads from its pickle instead of rebuilding — that is what
+    makes a warm ``--paths`` pre-commit run sub-second."""
+    from elasticdl_tpu.tools.edlint.project import (
+        Project,
+        load_contexts,
+        load_project_cache,
+        save_project_cache,
+        tree_digest,
+    )
     from elasticdl_tpu.tools.edlint.rules import RULES
 
     rules = [
         r for r in RULES if rule_ids is None or r.id in rule_ids
     ]
-    contexts, broken, _stats = load_contexts(
-        root, iter_source_files(root), use_cache=use_cache
-    )
-    project = Project(contexts)
+    paths = list(iter_source_files(root))
+    cached = None
+    digest = None
+    if use_cache:
+        digest = tree_digest(root, paths)
+        cached = load_project_cache(root, digest)
+    if cached is not None:
+        contexts, base_broken, project = cached
+    else:
+        contexts, base_broken, _stats = load_contexts(
+            root, paths, use_cache=use_cache
+        )
+        project = Project(contexts)
+    broken = list(base_broken)
+    targets = sorted(contexts)
+    if only_paths is not None:
+        only = set(only_paths)
+        targets = [rel for rel in targets if rel in only]
+        for rel in sorted(only - set(contexts)):
+            broken.append(
+                (rel, "--paths target not in the scan scope")
+            )
     findings = []
-    for rel in sorted(contexts):
+    for rel in targets:
         ctx = contexts[rel]
         ctx.project = project
         for rule in rules:
             findings.extend(rule.check(ctx))
     findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+    if use_cache and cached is None:
+        # save AFTER the rules ran: the lazy analyses they forced
+        # (R8 summaries, R5 chains, the R11 lock graph) ride along,
+        # so the next run's rule pass is warm too
+        save_project_cache(root, digest, contexts, base_broken, project)
+    return findings, broken, project
+
+
+def scan(root, rule_ids=None, use_cache=True, only_paths=None):
+    """Back-compat wrapper over :func:`scan_project`: (findings,
+    broken) only."""
+    findings, broken, _project = scan_project(
+        root,
+        rule_ids=rule_ids,
+        use_cache=use_cache,
+        only_paths=only_paths,
+    )
     return findings, broken
 
 
@@ -303,6 +360,27 @@ def main(argv=None):
         "(~/.cache/edlint/ast-<root-hash>.pkl): re-parse every file "
         "and do not write the cache back",
     )
+    parser.add_argument(
+        "--paths",
+        nargs="+",
+        default=None,
+        metavar="FILE",
+        help="incremental mode: run rules only on the named files "
+        "(absolute or repo-relative); resolution still spans the "
+        "whole tree through the cached Project, so cross-file "
+        "findings in the named files stay correct — a warm-cache "
+        "pre-commit run is sub-second",
+    )
+    parser.add_argument(
+        "--lock-coverage",
+        default=None,
+        metavar="EXPORT",
+        help="cross-validate a locktrace JSONL edge export against "
+        "the R11 static lock graph: a dynamically witnessed edge "
+        "missing from the static graph means the summaries are "
+        "unsound (exit 1); also reports which static edges no test "
+        "has exercised",
+    )
     args = parser.parse_args(argv)
     if args.list_rules:
         for rule in RULES:
@@ -313,23 +391,58 @@ def main(argv=None):
         if args.rules
         else None
     )
-    findings, broken = scan(
-        args.root, rule_ids=rule_ids, use_cache=not args.no_cache
+    only_paths = None
+    if args.paths is not None:
+        only_paths = [
+            os.path.relpath(os.path.abspath(p), args.root).replace(
+                os.sep, "/"
+            )
+            for p in args.paths
+        ]
+    findings, broken, project = scan_project(
+        args.root,
+        rule_ids=rule_ids,
+        use_cache=not args.no_cache,
+        only_paths=only_paths,
     )
     violations, counts, allowed = apply_ratchet(findings)
-    # scope the stale check to the rules that actually ran: a subset
-    # run (--rules R1,R2,R3) has zero counts for every other rule and
-    # must not read their budgets as slack
+    # scope the stale check to the rules (and, with --paths, files)
+    # that actually ran: a subset run has zero counts for everything
+    # else and must not read their budgets as slack
     stale = (
         [
             s
             for s in stale_entries(counts)
-            if rule_ids is None or s[0] in rule_ids
+            if (rule_ids is None or s[0] in rule_ids)
+            and (only_paths is None or s[1] in only_paths)
         ]
         if args.stale
         else []
     )
-    rc = 1 if (broken or violations or stale) else 0
+    # lock-graph stats + the dynamic cross-check ride the R11 graph
+    # (already composed and cached when R11 ran; skipped for subset
+    # runs that excluded it, unless --lock-coverage asks for it)
+    lock_stats = None
+    lock_cov = None
+    if args.lock_coverage is not None or (
+        rule_ids is None or "R11" in rule_ids
+    ):
+        graph = project.lock_graph()
+        lock_stats = graph.stats()
+        if args.lock_coverage is not None:
+            from elasticdl_tpu.tools.edlint.lockgraph import (
+                coverage,
+                load_export,
+            )
+
+            lock_cov = coverage(graph, load_export(args.lock_coverage))
+            lock_stats["unwitnessed_edges"] = len(lock_cov.unwitnessed)
+    rc = 1 if (
+        broken
+        or violations
+        or stale
+        or (lock_cov is not None and lock_cov.missing)
+    ) else 0
     if args.as_json:
         doc = {
             "root": args.root,
@@ -361,6 +474,21 @@ def main(argv=None):
                 for (r, p), c in sorted(counts.items())
             ],
         }
+        if lock_stats is not None:
+            doc["lock_graph"] = lock_stats
+        if lock_cov is not None:
+            from elasticdl_tpu.tools.edlint.lockgraph import lock_name
+
+            doc["lock_coverage"] = {
+                "dynamic_edges": lock_cov.dynamic_total,
+                "witnessed": len(lock_cov.witnessed),
+                "missing": lock_cov.missing,
+                "unmatched": len(lock_cov.unmatched),
+                "unwitnessed": [
+                    {"src": lock_name(s), "dst": lock_name(d)}
+                    for s, d in lock_cov.unwitnessed
+                ],
+            }
         print(json.dumps(doc, indent=1))
         return rc
     if broken:
@@ -386,6 +514,35 @@ def main(argv=None):
             print(
                 "  %s %s: budget %d, used %d — shrink it"
                 % (rule_id, path, budget, used)
+            )
+    if lock_cov is not None:
+        from elasticdl_tpu.tools.edlint.lockgraph import lock_name
+
+        print(
+            "lock-coverage: %d dynamic edge(s): %d witnessed in the "
+            "static graph, %d unmatched (out-of-scope creation "
+            "sites), %d MISSING; %d/%d static edge(s) unexercised by "
+            "any traced run"
+            % (
+                lock_cov.dynamic_total,
+                len(lock_cov.witnessed),
+                len(lock_cov.unmatched),
+                len(lock_cov.missing),
+                len(lock_cov.unwitnessed),
+                lock_stats["edges"],
+            )
+        )
+        for doc in lock_cov.missing:
+            print(
+                "  UNSOUND: witnessed edge %s -> %s (%s -> %s) is "
+                "absent from the static graph — the R8/R11 summaries "
+                "missed a path the test suite executed"
+                % (
+                    doc.get("static_src"),
+                    doc.get("static_dst"),
+                    doc.get("src_site"),
+                    doc.get("dst_site"),
+                )
             )
     return rc
 

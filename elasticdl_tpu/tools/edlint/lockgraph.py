@@ -1,0 +1,496 @@
+"""edlint R11: the whole-program static lock-order graph.
+
+The R8 lockset walk already knows, per function, which locks are held
+at every point; this module composes its ACQUISITION events ("lock B
+taken while the path already holds A") interprocedurally over the
+Project call graph into one global directed edge graph, and reports
+every cycle in it as a potential deadlock — with full provenance (root
+-> call chain -> acquire site) for each edge of the cycle. It is the
+static complement of the runtime sanitizer
+(elasticdl_tpu/tools/locktrace.py): locktrace sees only the
+interleavings a test actually executes; this graph covers every path
+the call graph can resolve.
+
+Semantics, mirrored from locktrace so the two graphs are comparable:
+
+- a re-entrant acquire (the lock is already in the held set) adds no
+  edge — the RLock owner-thread rule;
+- ``Condition`` follows the owner protocol: ``with cond:`` holds the
+  condition's lock, ``cond.wait()`` is not an acquisition event (the
+  re-acquire on wake restores prior state and records nothing, exactly
+  like locktrace's ``_acquire_restore``);
+- ``threading.Condition(self._mu)`` and ``self.alias = self._mu``
+  assignments ALIAS the two names onto one lock identity (union-find),
+  so ``with self._cv:`` and ``with self._mu:`` do not fabricate a
+  two-node cycle out of one physical lock.
+
+Edges compose from EVERY function as an entry point, not only the R8
+thread roots: lock ORDER is a property of any execution (main paths,
+CLI drivers), and the dynamic cross-check below demands the static
+graph be a superset of anything a test run can witness. Thread roots
+are walked first so cycle provenance prefers a genuinely concurrent
+root when one reaches the edge.
+
+The dynamic cross-check: ``locktrace.export()`` writes the witnessed
+acquisition-edge graph as JSONL (one edge per line, endpoints carry
+their lock CREATION sites). :func:`coverage` maps each dynamic edge
+onto static lock identities via the creation-site table and verifies
+it appears in the static graph — a witnessed edge the summaries missed
+means they are unsound and fails loudly — and reports which static
+edges no test has ever exercised (the untested-ordering surface).
+"""
+
+import ast
+import json
+import logging
+from collections import namedtuple
+
+from elasticdl_tpu.tools.edlint.core import dotted
+
+logger = logging.getLogger(__name__)
+
+_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition"))
+
+# an edge: ``src`` held while acquiring ``dst`` (canonical lock ids),
+# witnessed first from ``root`` through ``chain`` (qualname tuple) at
+# ``path:lineno``
+Edge = namedtuple("Edge", "src dst root chain path lineno")
+
+Coverage = namedtuple(
+    "Coverage",
+    "witnessed missing unmatched unwitnessed dynamic_total",
+)
+
+_MAX_VISITS = 200000
+
+
+def lock_name(lid):
+    """Human name for a lock id: ``Cls._mu``, ``pkg.mod:NAME``, or the
+    bare lexical attribute."""
+    if lid[0] == "f":
+        return "%s.%s" % (lid[1][1], lid[2])
+    if lid[0] == "g":
+        return "%s:%s" % (lid[1], lid[2])
+    return lid[1]
+
+
+class LockGraph:
+    """The composed global acquisition-edge graph for one Project."""
+
+    def __init__(self, project):
+        self.project = project
+        ctor_facts, self.aliases, prop_aliases = _lock_syntax(project)
+        self.kinds = {}  # canonical lock id -> "Lock"|"RLock"|"Condition"
+        self.ctor_sites = {}  # (relpath, lineno) -> canonical lock id
+        self._index_ctors(ctor_facts)
+        self._lexical_property_aliases(prop_aliases)
+        self.edges = {}  # (src, dst) -> Edge
+        self._compose()
+        self._cycles = None
+
+    def canon(self, lid):
+        seen = set()
+        while lid in self.aliases and lid not in seen:
+            seen.add(lid)
+            lid = self.aliases[lid]
+        return lid
+
+    # -- lock object discovery ----------------------------------------
+
+    def _index_ctors(self, ctor_facts):
+        """Every ``<target> = threading.Lock/RLock/Condition(...)``
+        assignment (pre-collected by :func:`_lock_syntax`): records the
+        lock's kind and its creation site — the key the dynamic export
+        matches on (locktrace names traced locks by creation site)."""
+        for rel, lineno, tail, lid in ctor_facts:
+            lid = self.canon(lid)
+            self.kinds.setdefault(lid, tail)
+            # a bare Condition() creates its RLock inside the
+            # threading module — out of locktrace's scope, so no
+            # dynamic edge ever references this site; a
+            # Condition(lock) creates no lock at all. Only Lock/RLock
+            # sites can be witnessed.
+            if tail in ("Lock", "RLock"):
+                self.ctor_sites[(rel, lineno)] = lid
+
+    def _lexical_property_aliases(self, prop_aliases):
+        """When exactly ONE class project-wide exposes a property of a
+        given name returning a known lock field, an untypable
+        ``other.<name>`` acquire (lexical ``('x', name)`` fallback) can
+        only mean that lock — alias it. Ambiguous names stay lexical."""
+        by_name = {}
+        for name, real in prop_aliases:
+            by_name.setdefault(name, set()).add(self.canon(real))
+        for name, reals in sorted(by_name.items()):
+            if len(reals) != 1:
+                continue
+            real = next(iter(reals))
+            if real in self.kinds and ("x", name) not in self.aliases:
+                self.aliases[("x", name)] = real
+
+    # -- edge composition ----------------------------------------------
+
+    def _entry_roots(self):
+        """Pseudo-roots beyond the R8 thread roots: every resolvable
+        function/method is a potential execution entry for lock-order
+        purposes (a main path orders locks just as surely as a spawned
+        thread)."""
+        project = self.project
+        out = []
+        for key in sorted(project.functions):
+            out.append(
+                ("entry:%s.%s" % key, project.functions[key])
+            )
+        for ckey in sorted(project.classes):
+            ci = project.classes[ckey]
+            for name in sorted(ci.methods):
+                out.append(
+                    (
+                        "entry:%s.%s.%s" % (ckey[0], ckey[1], name),
+                        ci.methods[name],
+                    )
+                )
+        return out
+
+    def _compose(self):
+        project = self.project
+        roots = [(r.label, r.fn) for r in project.roots()]
+        roots += self._entry_roots()
+        # the memo is GLOBAL across roots: edges are first-witness
+        # deduped, so once a (fn, lockset) state has been fully pushed
+        # its subtree contributes nothing new from a later root. Thread
+        # roots run first so provenance prefers a concurrent root.
+        seen = set()
+        visits = 0
+        for label, root_fn in roots:
+            stack = [(root_fn, frozenset(), ())]
+            while stack:
+                fn, held, chain = stack.pop()
+                key = (id(fn), held)
+                if key in seen:
+                    continue
+                seen.add(key)
+                visits += 1
+                if visits > _MAX_VISITS:
+                    logger.warning(
+                        "edlint R11: exceeded %d visited (fn, "
+                        "lockset) states; acquisition edges past "
+                        "the cap were NOT composed",
+                        _MAX_VISITS,
+                    )
+                    return
+                summ = project.summary(fn)
+                home = project.fn_home.get(id(fn))
+                ctx = home[0] if home else project._ctx_containing(fn)
+                if ctx is None:
+                    continue
+                qual = (
+                    home[2] if home else getattr(fn, "name", "<lambda>")
+                )
+                chain2 = chain + (qual,)
+                for lid, rel_held, lineno in summ.acquires:
+                    dst = self.canon(lid)
+                    abs_held = {
+                        self.canon(h) for h in (held | rel_held)
+                    }
+                    if dst in abs_held:
+                        continue  # re-entrant acquire: no edge
+                    for src in abs_held:
+                        ekey = (src, dst)
+                        if ekey not in self.edges:
+                            self.edges[ekey] = Edge(
+                                src, dst, label, chain2, ctx.path,
+                                lineno,
+                            )
+                for call, locks, _lineno in summ.calls:
+                    for callee in project.resolve_call_at(ctx, call):
+                        stack.append(
+                            (callee, held | locks, chain2)
+                        )
+
+    # -- cycles ---------------------------------------------------------
+
+    def cycles(self):
+        """One canonical cycle per strongly connected component of the
+        edge graph: a list of Edge lists, each closed (last edge's dst
+        is the first edge's src), sorted for determinism."""
+        if self._cycles is not None:
+            return self._cycles
+        adj = {}
+        for src, dst in self.edges:
+            adj.setdefault(src, set()).add(dst)
+        out = []
+        for comp in _tarjan_sccs(adj):
+            if len(comp) < 2:
+                continue  # self-edges are never composed (re-entry)
+            comp_set = set(comp)
+            start = min(comp)
+            path = _shortest_cycle(adj, comp_set, start)
+            out.append(
+                [
+                    self.edges[(a, b)]
+                    for a, b in zip(path, path[1:])
+                ]
+            )
+        out.sort(key=lambda es: (es[0].path, es[0].lineno, es[0].src))
+        self._cycles = out
+        return out
+
+    def stats(self):
+        nodes = set()
+        for src, dst in self.edges:
+            nodes.add(src)
+            nodes.add(dst)
+        return {
+            "nodes": len(nodes),
+            "edges": len(self.edges),
+            "cycles": len(self.cycles()),
+        }
+
+
+def _tarjan_sccs(adj):
+    """Iterative Tarjan over ``{node: {succ}}``; yields components as
+    sorted lists."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    counter = [0]
+    sccs = []
+    for start in sorted(adj):
+        if start in index:
+            continue
+        work = [(start, iter(sorted(adj.get(start, ()))))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append(
+                        (succ, iter(sorted(adj.get(succ, ()))))
+                    )
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    comp.append(n)
+                    if n == node:
+                        break
+                sccs.append(sorted(comp))
+    return sccs
+
+
+def _shortest_cycle(adj, comp, start):
+    """BFS inside one SCC: the shortest closed path start -> start."""
+    parent = {}
+    queue = [start]
+    qi = 0
+    while qi < len(queue):
+        cur = queue[qi]
+        qi += 1
+        for succ in sorted(adj.get(cur, ())):
+            if succ == start:
+                path = [cur]
+                while cur != start:
+                    cur = parent[cur]
+                    path.append(cur)
+                path.reverse()
+                return path + [start]
+            if succ in comp and succ not in parent:
+                parent[succ] = cur
+                queue.append(succ)
+    # unreachable for a true SCC, but never crash the lint over it
+    return [start, start]
+
+
+def _lock_syntax(project):
+    """One walk over every tree collecting the lock-relevant syntax:
+
+    - ctor facts ``(rel, lineno, kind, lock id)`` for every
+      ``<target> = threading.Lock/RLock/Condition(...)`` assignment;
+    - aliases ``{lock id: canonical lock id}`` from the two alias
+      shapes the codebase uses — ``self._cv =
+      threading.Condition(self._mu)`` (the condition IS the lock) and
+      ``self.apply_lock = self._lock`` (a plain rebind), both keyed
+      within the defining class;
+    - ``(property name, field lock id)`` pairs from
+      ``@property def lock(self): return self._lock`` accessors, for
+      the unique-name lexical aliasing pass."""
+    ctor_facts = []
+    aliases = {}
+    prop_aliases = []
+    for rel in sorted(project.contexts):
+        ctx = project.contexts[rel]
+        mod = project.module_of_ctx(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                # @property def lock(self): return self._lock — callers
+                # acquire obj.lock, the owner acquires self._lock; both
+                # are one physical lock
+                cls_node = ctx.enclosing(node, ast.ClassDef)
+                if cls_node is None:
+                    continue
+                if not any(
+                    dotted(d).rsplit(".", 1)[-1] == "property"
+                    for d in node.decorator_list
+                ):
+                    continue
+                body = [
+                    st
+                    for st in node.body
+                    if not isinstance(st, ast.Expr)
+                    or not isinstance(st.value, ast.Constant)
+                ]
+                if len(body) != 1 or not isinstance(body[0], ast.Return):
+                    continue
+                ret = body[0].value
+                if (
+                    isinstance(ret, ast.Attribute)
+                    and isinstance(ret.value, ast.Name)
+                    and ret.value.id == "self"
+                ):
+                    ckey = (mod, cls_node.name)
+                    prop_id = ("f", ckey, node.name)
+                    real_id = ("f", ckey, ret.attr)
+                    if prop_id != real_id:
+                        aliases[prop_id] = real_id
+                        prop_aliases.append((node.name, real_id))
+                continue
+            if not isinstance(node, ast.Assign):
+                continue
+            cls_node = ctx.enclosing(node, ast.ClassDef)
+            class_key = (mod, cls_node.name) if cls_node else None
+            value = node.value
+            source = None
+            if isinstance(value, ast.Call):
+                tail = dotted(value.func).rsplit(".", 1)[-1]
+                if tail in _LOCK_CTORS:
+                    for t in node.targets:
+                        ctor_facts.append(
+                            (
+                                rel,
+                                value.lineno,
+                                tail,
+                                project.lock_id(ctx, class_key, t),
+                            )
+                        )
+                if tail == "Condition" and value.args:
+                    source = value.args[0]
+            elif isinstance(value, (ast.Attribute, ast.Name)):
+                if project._is_lock_acquire(ctx, value):
+                    source = value
+            if source is None:
+                continue
+            src_id = project.lock_id(ctx, class_key, source)
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Name)):
+                    dst_id = project.lock_id(ctx, class_key, t)
+                    if dst_id != src_id:
+                        aliases[dst_id] = src_id
+    return ctor_facts, aliases, prop_aliases
+
+
+# ---------------------------------------------------------------------------
+# dynamic cross-check (locktrace export -> static graph)
+# ---------------------------------------------------------------------------
+
+
+def load_export(path):
+    """Parse a locktrace JSONL edge export; dedupes repeated edges
+    (suites append per test)."""
+    edges = []
+    seen = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            key = (doc.get("src_site"), doc.get("dst_site"))
+            if key in seen:
+                continue
+            seen.add(key)
+            edges.append(doc)
+    return edges
+
+
+def _site_to_lock(site, graph, rel_index):
+    """Map a dynamic creation site ``/abs/path/pkg/mod.py:123`` onto a
+    static lock id, or None."""
+    if not site or ":" not in site:
+        return None
+    path, _, lineno = site.rpartition(":")
+    try:
+        lineno = int(lineno)
+    except ValueError:
+        return None
+    path = path.replace("\\", "/")
+    for rel in rel_index:
+        if path.endswith("/" + rel) or path == rel:
+            return graph.ctor_sites.get((rel, lineno))
+    return None
+
+
+def coverage(graph, dynamic_edges):
+    """Cross-validate the witnessed (dynamic) edge graph against the
+    static one.
+
+    Returns a :class:`Coverage`: ``witnessed`` static edge keys seen
+    dynamically, ``missing`` dynamic edges that mapped onto static
+    lock identities but are ABSENT from the static graph (the
+    summaries are unsound — callers must fail), ``unmatched`` dynamic
+    edges with an endpoint the creation-site table cannot place
+    (test-local fixture locks, out-of-tree callers), ``unwitnessed``
+    static edge keys no dynamic run has exercised."""
+    rel_index = sorted(
+        {rel for rel, _ in graph.ctor_sites}, key=len, reverse=True
+    )
+    witnessed = set()
+    missing = []
+    unmatched = []
+    for doc in dynamic_edges:
+        src = _site_to_lock(doc.get("src_site", ""), graph, rel_index)
+        dst = _site_to_lock(doc.get("dst_site", ""), graph, rel_index)
+        if src is None or dst is None:
+            unmatched.append(doc)
+            continue
+        src, dst = graph.canon(src), graph.canon(dst)
+        if src == dst:
+            continue  # aliased pair (Condition sharing): re-entry
+        if (src, dst) in graph.edges:
+            witnessed.add((src, dst))
+        else:
+            missing.append(
+                dict(
+                    doc,
+                    static_src=lock_name(src),
+                    static_dst=lock_name(dst),
+                )
+            )
+    unwitnessed = sorted(set(graph.edges) - witnessed)
+    return Coverage(
+        witnessed=witnessed,
+        missing=missing,
+        unmatched=unmatched,
+        unwitnessed=unwitnessed,
+        dynamic_total=len(dynamic_edges),
+    )

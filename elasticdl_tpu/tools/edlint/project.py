@@ -53,7 +53,7 @@ from elasticdl_tpu.tools.edlint.core import (
 
 logger = logging.getLogger(__name__)
 
-CACHE_VERSION = 2
+CACHE_VERSION = 3
 
 _FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 _FUNC_LIKE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -170,9 +170,10 @@ def load_contexts(root, paths, use_cache=True):
             continue
         entry = cache.get(rel)
         if entry is not None and entry.get("key") == key:
-            contexts[rel] = FileContext(
-                rel, entry["source"], tree=entry["tree"]
-            )
+            # the whole FileContext is cached — parent map and binding
+            # tables included (rebuilding them costs more than the
+            # unpickle; identity within one pickle entry is preserved)
+            contexts[rel] = entry["ctx"]
             fresh[rel] = entry
             stats["hits"] += 1
             continue
@@ -185,10 +186,119 @@ def load_contexts(root, paths, use_cache=True):
             broken.append((rel, str(err)))
             continue
         contexts[rel] = ctx
-        fresh[rel] = {"key": key, "source": source, "tree": ctx.tree}
+        fresh[rel] = {"key": key, "ctx": ctx}
     if use_cache and (stats["misses"] or set(fresh) != set(cache)):
         _save_cache(root, fresh)
     return contexts, broken, stats
+
+
+# ---------------------------------------------------------------------------
+# whole-Project cache (the --paths sub-second contract)
+# ---------------------------------------------------------------------------
+#
+# The AST cache above only saves *parse* time; the dominant cost of a
+# scan is the Project build (import/class indexing + the type-flow
+# fixpoint, ~9s on this tree). A pre-commit `edlint --paths <file>` run
+# must not pay that when nothing changed, so the fully-analyzed Project
+# — contexts, fixpoint maps, and whatever lazy analyses (summaries,
+# chains, the R11 lock graph) the saving run computed — is pickled
+# whole, keyed by a digest of every scanned file's (mtime_ns, size)
+# plus the analyzer's own sources (an edlint change must invalidate
+# stale analysis, not serve it). Same trust model as the AST cache:
+# the pickle lives outside the scanned tree.
+
+PROJECT_CACHE_VERSION = 1
+
+
+def tree_digest(root, paths):
+    """Hash of the scanned tree's file state + the analyzer's own."""
+    h = hashlib.sha256()
+    h.update(
+        b"%d\0%d\0" % (CACHE_VERSION, PROJECT_CACHE_VERSION)
+    )
+    own = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(paths) + sorted(
+        os.path.join(own, n)
+        for n in os.listdir(own)
+        if n.endswith(".py")
+    ):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            st = os.stat(path)
+            h.update(
+                ("%s\0%d\0%d\0" % (rel, st.st_mtime_ns, st.st_size))
+                .encode("utf-8")
+            )
+        except OSError:
+            h.update(("%s\0!\0" % rel).encode("utf-8"))
+    return h.hexdigest()
+
+
+def _project_cache_path(root):
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    digest = hashlib.sha256(
+        ("%s\0%d.%d" % (os.path.realpath(root), *sys.version_info[:2]))
+        .encode("utf-8")
+    ).hexdigest()[:16]
+    return os.path.join(base, "edlint", "proj-%s.pkl" % digest)
+
+
+def load_project_cache(root, digest):
+    """``(contexts, broken, project)`` when the cached Project matches
+    ``digest``, else None."""
+    import gc
+
+    try:
+        with open(_project_cache_path(root), "rb") as f:
+            # the load allocates ~10^6 small objects; collection churn
+            # mid-unpickle is most of the wall time
+            was_enabled = gc.isenabled()
+            gc.disable()
+            try:
+                payload = pickle.load(f)
+            finally:
+                if was_enabled:
+                    gc.enable()
+    except (OSError, EOFError, pickle.PickleError, AttributeError,
+            ValueError, ImportError, IndexError, KeyError, TypeError):
+        return None
+    if (
+        not isinstance(payload, dict)
+        or payload.get("digest") != digest
+    ):
+        return None
+    return payload["contexts"], payload["broken"], payload["project"]
+
+
+def save_project_cache(root, digest, contexts, broken, project):
+    path = _project_cache_path(root)
+    tmp = path + ".tmp.%d" % os.getpid()
+    limit = sys.getrecursionlimit()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # pickling recurses the ASTs; default limits are marginal
+        sys.setrecursionlimit(max(limit, 100000))
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {
+                    "digest": digest,
+                    "contexts": contexts,
+                    "broken": broken,
+                    "project": project,
+                },
+                f,
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        os.replace(tmp, path)
+    except (OSError, pickle.PickleError, RecursionError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    finally:
+        sys.setrecursionlimit(limit)
 
 
 # ---------------------------------------------------------------------------
@@ -220,13 +330,41 @@ RaceFinding = namedtuple(
 
 
 class _Summary:
-    __slots__ = ("accesses", "calls", "blocking", "is_init")
+    __slots__ = ("accesses", "calls", "blocking", "acquires", "is_init")
 
     def __init__(self):
         self.accesses = []  # [Access]
         self.calls = []  # [(call node, rel-lockset frozenset, lineno)]
         self.blocking = []  # [(kind str, rel-lockset, lineno)]
+        # lock ACQUISITION events for the R11 lock-order graph
+        # (lockgraph.py): (lock id, rel-lockset held at the acquire,
+        # lineno) — one per `with lock:` item / acquire-try-finally
+        # region entry, recorded with whatever this function already
+        # holds lexically at that point
+        self.acquires = []
         self.is_init = False
+
+
+def _bind_call(fn, is_method, call):
+    """``(param name, argument expr)`` pairs for a resolved call:
+    positional args map in order (past an implicit self/cls when the
+    callee is a method or __init__), keywords by name. ``*args`` stops
+    positional matching; ``**kwargs`` is ignored."""
+    a = fn.args
+    names = [x.arg for x in a.posonlyargs + a.args]
+    if is_method and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    kwonly = {x.arg for x in a.kwonlyargs}
+    binds = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(names):
+            binds.append((names[i], arg))
+    for kw in call.keywords:
+        if kw.arg and (kw.arg in names or kw.arg in kwonly):
+            binds.append((kw.arg, kw.value))
+    return binds
 
 
 class Project:
@@ -246,9 +384,93 @@ class Project:
         self._chain_state = {}
         self._roots = None
         self._races = None
+        self._lock_graph = None
         self._resolved_calls = {}
+        self._local_types_cache = {}
+        self._nested_defs_cache = {}
+        # constructor-argument type flow (ensure_type_flow):
+        self._param_types = {}  # id(fn) -> {param name: set(class key)}
+        self._field_types = {}  # class key -> {attr: set(class key)}
+        # the wider flow the R11 soundness cross-check demanded:
+        self._param_classobjs = {}  # id(fn) -> {param: set(class key)}
+        self._param_locks = {}  # id(fn) -> {param: set(lock id)}
+        self._field_elem_types = {}  # class key -> {attr: set(class key)}
+        self._global_types = {}  # (mod, name) -> set(class key)
+        self._return_types = {}  # id(fn) -> set(class key)
+        self._return_elem_types = {}  # id(fn) -> set(class key)
+        self._lt_inflight = {}
+        self._lock_alias_cache = {}
+        self._boundmeth_cache = {}
+        self._assigned_attrs_cache = {}
+        self._lock_home_cache = {}
+        self._type_flow_done = False
         for rel in sorted(contexts):
             self._index_module(rel, contexts[rel])
+        # constructor-argument type flow, eagerly: every whole-program
+        # analysis (R5 chains, R8 races, the R11 lock graph) resolves
+        # calls through one shared cache — enriching it lazily would
+        # make findings depend on which rule ran first
+        self.ensure_type_flow()
+
+    # -- pickling (the whole-Project cache) -----------------------------
+    #
+    # Most analysis state is keyed by id(node), which is meaningless in
+    # another process. Pickle preserves object IDENTITY within one
+    # payload, so the id-keyed dicts travel as (node, value) pairs —
+    # the node reference is the same object as in ``contexts``' trees —
+    # and are re-keyed by the unpickling process's ids on load. Pure
+    # memo caches are dropped (recomputed lazily, cheap per-file).
+
+    _PKL_ID_KEYED = (
+        "fn_home",
+        "_summaries",
+        "_chains",
+        "_chain_state",
+        "_resolved_calls",
+        "_param_types",
+        "_param_classobjs",
+        "_param_locks",
+        "_return_types",
+        "_return_elem_types",
+    )
+    _PKL_DROPPED = (
+        "_local_types_cache",
+        "_nested_defs_cache",
+        "_lock_alias_cache",
+        "_boundmeth_cache",
+        "_assigned_attrs_cache",
+        "_lt_inflight",
+    )
+
+    def __getstate__(self):
+        id2node = {}
+        for ctx in self.contexts.values():
+            for node in ast.walk(ctx.tree):
+                id2node[id(node)] = node
+        state = dict(self.__dict__)
+        for name in self._PKL_DROPPED:
+            state[name] = {}
+        for name in self._PKL_ID_KEYED:
+            # keys absent from id2node belong to synthetic nodes (e.g.
+            # normalized getattr attributes) — their entries re-derive
+            pairs = [
+                (id2node[k], v)
+                for k, v in state[name].items()
+                if k in id2node
+            ]
+            state[name] = ("__by_node__", pairs)
+        return state
+
+    def __setstate__(self, state):
+        for name in self._PKL_ID_KEYED:
+            packed = state.get(name)
+            if (
+                isinstance(packed, tuple)
+                and len(packed) == 2
+                and packed[0] == "__by_node__"
+            ):
+                state[name] = {id(n): v for n, v in packed[1]}
+        self.__dict__.update(state)
 
     # -- indexing -------------------------------------------------------
 
@@ -258,8 +480,15 @@ class Project:
         imp = self.imports.setdefault(mod, {})
         pkg = mod.rsplit(".", 1)[0] if "." in mod else ""
         is_pkg = rel.endswith("/__init__.py")
+        global_decls = {}  # id(fn) -> (fn, declared names)
         for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Import):
+            if isinstance(node, ast.Global):
+                fn = ctx.enclosing(node, _FUNC_DEFS)
+                if fn is not None:
+                    global_decls.setdefault(
+                        id(fn), (fn, set())
+                    )[1].update(node.names)
+            elif isinstance(node, ast.Import):
                 for alias in node.names:
                     local = alias.asname or alias.name.split(".", 1)[0]
                     target = alias.name if alias.asname else local
@@ -300,16 +529,9 @@ class Project:
                 self._index_class(mod, ctx, node)
         # `global NAME` rebinding anywhere in the module marks NAME as a
         # written global program-wide (R8 only tracks globals someone
-        # actually writes)
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, _FUNC_DEFS):
-                continue
-            declared = set()
-            for n in ast.walk(fn):
-                if isinstance(n, ast.Global):
-                    declared.update(n.names)
-            if not declared:
-                continue
+        # actually writes); the declaring functions were collected in
+        # the single pass above
+        for fn, declared in global_decls.values():
             for n in ast.walk(fn):
                 if (
                     isinstance(n, (ast.Assign, ast.AugAssign))
@@ -462,6 +684,12 @@ class Project:
             r = self.resolve_dotted(class_key[0], ctor)
             if r is not None and r[0] == "cls":
                 out.append(r[1])
+        # constructor-argument flow: ``self.attr = param`` fields typed
+        # from what call sites actually pass (ensure_type_flow)
+        for k in sorted(self._field_types.get(class_key, {}).get(attr, ())):
+            fci = self.classes.get(k)
+            if fci is not None and fci not in out:
+                out.append(fci)
         if not out:
             for base in ci.base_dotted:
                 r = self.resolve_dotted(class_key[0], base)
@@ -472,35 +700,184 @@ class Project:
         return out
 
     def _local_types(self, fn, ctx, class_key):
-        """{local name: [ClassInfo]} from ``x = ClassName(...)``."""
-        mod = self.module_of_ctx(ctx)
+        """{local name: [ClassInfo]} for assigned locals. Cached per
+        function — the R11 edge walk resolves every call site and
+        would otherwise re-walk hot bodies per site.
+
+        Typing goes through :meth:`_expr_class_keys` (two passes, so
+        ``store = self._params`` feeds ``table = store.tables[k]``),
+        and a sibling element table records container-typed locals
+        (``tables = self.embedding_params``, ``x[k] = Cls()``, and
+        ``for k, v in tables.items():`` loop targets). Re-entrant
+        lookups during construction see the partial tables instead of
+        recursing."""
+        cached = self._local_types_cache.get(id(fn))
+        if cached is not None:
+            return cached[0]
+        inflight = self._lt_inflight.get(id(fn))
+        if inflight is not None:
+            return inflight[0]
         out = {}
+        elems = {}  # local name -> set(element class key)
+        self._lt_inflight[id(fn)] = (out, elems)
+        try:
+            # parameters typed by the constructor-argument flow
+            for pname, keys in self._param_types.get(
+                id(fn), {}
+            ).items():
+                for k in sorted(keys):
+                    ci = self.classes.get(k)
+                    if ci is not None:
+                        out.setdefault(pname, []).append(ci)
+            for _ in range(2):
+                for n in ctx.walk_shallow(fn, stop=_FUNC_LIKE):
+                    if isinstance(n, ast.Assign):
+                        self._type_local_assign(
+                            fn, ctx, class_key, n, out, elems
+                        )
+                    elif isinstance(n, ast.For):
+                        self._type_local_for(
+                            fn, ctx, class_key, n, out, elems
+                        )
+                    elif isinstance(n, ast.Call):
+                        # x.setdefault(k, v) / x.append(v) on a local
+                        f = n.func
+                        if (
+                            isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Name)
+                        ):
+                            v = None
+                            if f.attr == "setdefault" and len(n.args) > 1:
+                                v = n.args[1]
+                            elif f.attr == "append" and n.args:
+                                v = n.args[0]
+                            if v is not None:
+                                keys = self._expr_class_keys(
+                                    ctx, class_key, fn, v
+                                )
+                                if keys:
+                                    elems.setdefault(
+                                        f.value.id, set()
+                                    ).update(keys)
+        finally:
+            del self._lt_inflight[id(fn)]
+        self._local_types_cache[id(fn)] = (out, elems)
+        return out
+
+    def _local_elems(self, fn, ctx, class_key):
+        """{local name: set(element class key)} — the element table
+        built alongside :meth:`_local_types`."""
+        self._local_types(fn, ctx, class_key)
+        c = self._local_types_cache.get(id(fn))
+        if c is None:
+            c = self._lt_inflight.get(id(fn))
+        return c[1] if c else {}
+
+    def _type_local_assign(self, fn, ctx, class_key, n, out, elems):
+        value = n.value
+        keys = self._expr_class_keys(ctx, class_key, fn, value)
+        ekeys = self._expr_elem_keys(ctx, class_key, fn, value)
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                if keys:
+                    hits = [
+                        self.classes[k]
+                        for k in sorted(keys)
+                        if k in self.classes
+                    ]
+                    out.setdefault(t.id, []).extend(
+                        ci for ci in hits
+                        if ci not in out.get(t.id, [])
+                    )
+                if ekeys:
+                    elems.setdefault(t.id, set()).update(ekeys)
+            elif (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and keys
+            ):
+                # local[k] = <typed>: the local is a container of them
+                elems.setdefault(t.value.id, set()).update(keys)
+
+    def _type_local_for(self, fn, ctx, class_key, n, out, elems):
+        """Type ``for`` targets iterating containers: a bare typed
+        iterable (``for m in families:``), ``for v in c.values():``
+        or ``for k, v in c.items():``."""
+        it = n.iter
+        tgt = n.target
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in ("values", "items")
+        ):
+            ekeys = self._expr_elem_keys(
+                ctx, class_key, fn, it.func.value
+            )
+            if it.func.attr == "items":
+                if not (
+                    isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2
+                ):
+                    return
+                tgt = tgt.elts[1]
+        else:
+            ekeys = self._expr_elem_keys(ctx, class_key, fn, it)
+        if not ekeys:
+            return
+        if not isinstance(tgt, ast.Name):
+            return
+        hits = [
+            self.classes[k] for k in sorted(ekeys) if k in self.classes
+        ]
+        out.setdefault(tgt.id, []).extend(
+            ci for ci in hits if ci not in out.get(tgt.id, [])
+        )
+
+    def _local_boundmeths(self, fn, ctx, class_key):
+        """{local name: [method fn nodes]} from ``name = obj.meth`` /
+        ``name = getattr(obj, "meth", ...)`` assignments inside ``fn``
+        (cached). Only resolvable typed receivers contribute."""
+        cached = self._boundmeth_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        out = {}
+        self._boundmeth_cache[id(fn)] = out
         for n in ctx.walk_shallow(fn, stop=_FUNC_LIKE):
             if not isinstance(n, ast.Assign):
                 continue
-            if not isinstance(n.value, ast.Call):
+            value = self._as_getattr_attr(n.value)
+            if value is None and isinstance(n.value, ast.Attribute):
+                value = n.value
+            if value is None:
                 continue
-            d = dotted(n.value.func)
-            if not d:
-                continue
-            r = self.resolve_dotted(mod, d)
-            if r is None or r[0] != "cls":
+            meths = []
+            for k in sorted(
+                self._expr_class_keys(ctx, class_key, fn, value.value)
+            ):
+                m = self.lookup_method(k, value.attr)
+                if m is not None and m not in meths:
+                    meths.append(m)
+            if not meths:
                 continue
             for t in n.targets:
                 if isinstance(t, ast.Name):
-                    out.setdefault(t.id, []).append(r[1])
+                    slot = out.setdefault(t.id, [])
+                    slot.extend(m for m in meths if m not in slot)
         return out
 
     def _nested_def(self, enclosing_fn, name):
-        """A def named ``name`` nested anywhere inside ``enclosing_fn``."""
+        """A def named ``name`` nested anywhere inside ``enclosing_fn``
+        (defs per enclosing function are cached, same reason as
+        :meth:`_local_types`)."""
         if enclosing_fn is None:
             return None
-        for n in ast.walk(enclosing_fn):
-            if isinstance(n, _FUNC_DEFS) and n.name == name and n is not (
-                enclosing_fn
-            ):
-                return n
-        return None
+        defs = self._nested_defs_cache.get(id(enclosing_fn))
+        if defs is None:
+            defs = {}
+            for n in ast.walk(enclosing_fn):
+                if isinstance(n, _FUNC_DEFS) and n is not enclosing_fn:
+                    defs.setdefault(n.name, n)
+            self._nested_defs_cache[id(enclosing_fn)] = defs
+        return defs.get(name)
 
     def resolve_call_at(self, ctx, call, enclosing_fn=None, class_key=None):
         """Callee fn/lambda nodes a call expression may reach (cached).
@@ -535,6 +912,15 @@ class Project:
                     init = self.lookup_method(r[1].key, "__init__")
                     if init is not None:
                         out = [init]
+            if not out and enclosing_fn is not None:
+                # a local bound to a method reference — the duck-typed
+                # dispatch idiom (note = getattr(t, "note_applied",
+                # None); note(ids, v))
+                for m in self._local_boundmeths(
+                    enclosing_fn, ctx, class_key
+                ).get(f.id, ()):
+                    if m not in out:
+                        out.append(m)
         elif isinstance(f, ast.Attribute):
             if (
                 isinstance(f.value, ast.Name)
@@ -568,8 +954,474 @@ class Project:
                     m = self.lookup_method(ci.key, f.attr)
                     if m is not None:
                         out.append(m)
+            if not out:
+                # general typed-receiver fallback: any expression the
+                # flow can type (attribute chains, subscript reads,
+                # call returns, module globals) resolves its methods
+                for k in sorted(
+                    self._expr_class_keys(
+                        ctx, class_key, enclosing_fn, f.value
+                    )
+                ):
+                    m = self.lookup_method(k, f.attr)
+                    if m is not None and m not in out:
+                        out.append(m)
         self._resolved_calls[id(call)] = out
         return out
+
+    # -- constructor-argument type flow ---------------------------------
+
+    def ensure_type_flow(self):
+        """Flow class types through call arguments, to a fixpoint.
+
+        The narrow resolution above sees ``self.x = Cls()`` but not
+        ``self.x = param`` — yet most of the real object graph is wired
+        exactly that way (``PserverServicer(self.parameters, ...)``,
+        ``TaskDispatcher(..., journal=journal)``). This pass types
+        callee parameters from what resolvable call sites actually
+        pass, types fields from ``self.attr = <typed expr>``
+        assignments, and iterates: each round can unlock call
+        resolution (``self._journal.append`` needs ``_journal`` typed)
+        which can type further params. Growth is monotone over a
+        finite lattice; 4 rounds cover the deepest wiring chains in
+        practice.
+
+        Idempotent; invoked lazily by :meth:`lock_graph` — the R11
+        walk MUST see through parameter wiring or witnessed dynamic
+        edges would be missing from the static graph (the
+        ``--lock-coverage`` soundness failure)."""
+        if self._type_flow_done:
+            return
+        self._type_flow_done = True
+        calls = []  # (ctx, enclosing class key, enclosing fn, call)
+        fields = []  # (class key, fn, attr, value expr, ctx)
+        elems = []  # (class key, fn, attr, element expr, ctx)
+        rets = []  # (ctx, class key, fn, return expr)
+        gassigns = []  # (ctx, mod, name, value expr) module-level
+        for rel in sorted(self.contexts):
+            ctx = self.contexts[rel]
+            mod = module_name(rel)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    fn = ctx.enclosing(node, _FUNC_DEFS)
+                    ck = self.class_of(fn) if fn is not None else None
+                    calls.append((ctx, ck, fn, node))
+                    # self.attr.setdefault(k, v) / self.attr.append(v):
+                    # container-element writes through a method call
+                    f = node.func
+                    if (
+                        ck is not None
+                        and isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Attribute)
+                        and isinstance(f.value.value, ast.Name)
+                        and f.value.value.id == "self"
+                    ):
+                        if f.attr == "setdefault" and len(node.args) > 1:
+                            elems.append(
+                                (ck, fn, f.value.attr, node.args[1], ctx)
+                            )
+                        elif f.attr == "append" and node.args:
+                            elems.append(
+                                (ck, fn, f.value.attr, node.args[0], ctx)
+                            )
+                elif isinstance(node, ast.Return):
+                    fn = ctx.enclosing(node, _FUNC_DEFS)
+                    if fn is not None and node.value is not None:
+                        rets.append(
+                            (ctx, self.class_of(fn), fn, node.value)
+                        )
+                elif isinstance(node, ast.Assign):
+                    fn = ctx.enclosing(node, _FUNC_DEFS)
+                    ck = self.class_of(fn) if fn is not None else None
+                    if fn is None:
+                        # module-level instance: `metrics =
+                        # MetricsRegistry()` — the type behind every
+                        # `mod.name` / `from mod import name` read
+                        if ctx.enclosing(node, ast.ClassDef) is None:
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    gassigns.append(
+                                        (ctx, mod, t.id, node.value)
+                                    )
+                        continue
+                    if ck is None:
+                        continue
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            fields.append(
+                                (ck, fn, t.attr, node.value, ctx)
+                            )
+                        elif (
+                            isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Attribute)
+                            and isinstance(t.value.value, ast.Name)
+                            and t.value.value.id == "self"
+                        ):
+                            # self.attr[k] = v: element type of the
+                            # container field — what a later
+                            # `self.attr[k]` / `.get(k)` read yields
+                            elems.append(
+                                (ck, fn, t.value.attr, node.value, ctx)
+                            )
+
+        def _merge(table, key, sub, keys):
+            if not keys:
+                return False
+            slot = table.setdefault(key, {}).setdefault(sub, set())
+            if keys <= slot:
+                return False
+            slot |= keys
+            return True
+
+        for _ in range(6):
+            changed = False
+            for ctx, ck, fn, call in calls:
+                mod = self.module_of_ctx(ctx)
+                callees = self.resolve_call_at(
+                    ctx, call, enclosing_fn=fn, class_key=ck
+                )
+                for callee in callees:
+                    home = self.fn_home.get(id(callee))
+                    is_method = home is not None and home[1] is not None
+                    for pname, aexpr in _bind_call(
+                        callee, is_method, call
+                    ):
+                        keys = self._expr_class_keys(ctx, ck, fn, aexpr)
+                        changed |= _merge(
+                            self._param_types, id(callee), pname, keys
+                        )
+                        if not keys:
+                            # a class OBJECT argument (factory params:
+                            # `_get_or_create(Gauge, ...)` then
+                            # `cls(...)` inside)
+                            d = (
+                                dotted(aexpr)
+                                if isinstance(
+                                    aexpr, (ast.Name, ast.Attribute)
+                                )
+                                else None
+                            )
+                            r = (
+                                self.resolve_dotted(mod, d) if d else None
+                            )
+                            if r is not None and r[0] == "cls":
+                                changed |= _merge(
+                                    self._param_classobjs,
+                                    id(callee),
+                                    pname,
+                                    {r[1].key},
+                                )
+                        # a lock-valued argument: the callee acquires
+                        # its parameter, the edge belongs to the lock
+                        # the caller actually passed
+                        lids = self._lock_value_ids(ctx, ck, fn, aexpr)
+                        changed |= _merge(
+                            self._param_locks, id(callee), pname, lids
+                        )
+            for ck, fn, attr, expr, ctx in fields:
+                changed |= _merge(
+                    self._field_types,
+                    ck,
+                    attr,
+                    self._expr_class_keys(ctx, ck, fn, expr),
+                )
+            for ck, fn, attr, expr, ctx in elems:
+                changed |= _merge(
+                    self._field_elem_types,
+                    ck,
+                    attr,
+                    self._expr_class_keys(ctx, ck, fn, expr),
+                )
+            for ctx, mod, name, expr in gassigns:
+                keys = self._expr_class_keys(ctx, None, None, expr)
+                if keys:
+                    slot = self._global_types.setdefault(
+                        (mod, name), set()
+                    )
+                    if not keys <= slot:
+                        slot |= keys
+                        changed = True
+            for ctx, ck, fn, expr in rets:
+                keys = self._expr_class_keys(ctx, ck, fn, expr)
+                if keys:
+                    slot = self._return_types.setdefault(id(fn), set())
+                    if not keys <= slot:
+                        slot |= keys
+                        changed = True
+                ekeys = self._expr_elem_keys(ctx, ck, fn, expr)
+                if ekeys:
+                    slot = self._return_elem_types.setdefault(
+                        id(fn), set()
+                    )
+                    if not ekeys <= slot:
+                        slot |= ekeys
+                        changed = True
+            # typing grew: previously-unresolvable calls and stale
+            # local-type tables must recompute next round (and for
+            # every later consumer)
+            self._resolved_calls = {
+                k: v for k, v in self._resolved_calls.items() if v
+            }
+            self._local_types_cache.clear()
+            self._boundmeth_cache.clear()
+            self._lock_alias_cache.clear()
+            if not changed:
+                break
+
+    def _expr_class_keys(self, ctx, class_key, fn, expr, depth=0):
+        """Class keys an expression may evaluate to (best-effort).
+
+        Beyond constructor calls, params, typed locals and ``self``
+        fields, this follows the shapes the R11 dynamic cross-check
+        proved load-bearing: attribute chains over typed receivers
+        (``@property`` accessors included), module-global instances
+        (``profiling.metrics``), return-type flow through resolvable
+        calls, class-object factory params (``cls(...)``), and
+        container-element reads (``store.embedding_params[name]`` /
+        ``.get(name)``)."""
+        if depth > 6:
+            return set()
+        mod = self.module_of_ctx(ctx)
+        out = set()
+        if isinstance(expr, ast.IfExp):
+            # Cls(...) if flag else None — the optional-wiring idiom
+            return self._expr_class_keys(
+                ctx, class_key, fn, expr.body, depth + 1
+            ) | self._expr_class_keys(
+                ctx, class_key, fn, expr.orelse, depth + 1
+            )
+        if isinstance(expr, ast.BoolOp):
+            # journal = passed or MasterJournal(...)
+            for v in expr.values:
+                out |= self._expr_class_keys(
+                    ctx, class_key, fn, v, depth + 1
+                )
+            return out
+        if isinstance(expr, ast.Call):
+            ga = self._as_getattr_attr(expr)
+            if ga is not None:
+                return self._expr_class_keys(
+                    ctx, class_key, fn, ga, depth + 1
+                )
+            f = expr.func
+            d = dotted(f)
+            r = self.resolve_dotted(mod, d) if d else None
+            if r is not None and r[0] == "cls":
+                out.add(r[1].key)
+                return out
+            if isinstance(f, ast.Name) and fn is not None:
+                # cls(...) where cls is a class-object parameter
+                for k in self._param_classobjs.get(id(fn), {}).get(
+                    f.id, ()
+                ):
+                    out.add(k)
+                if out:
+                    return out
+            if isinstance(f, ast.Attribute) and f.attr == "get":
+                # container.get(k) yields the container's elements
+                out |= self._expr_elem_keys(
+                    ctx, class_key, fn, f.value, depth + 1
+                )
+                if out:
+                    return out
+            # return-type flow through every resolvable callee
+            for callee in self.resolve_call_at(
+                ctx, expr, enclosing_fn=fn, class_key=class_key
+            ):
+                out |= self._return_types.get(id(callee), set())
+            return out
+        if isinstance(expr, ast.Subscript):
+            return self._expr_elem_keys(
+                ctx, class_key, fn, expr.value, depth + 1
+            )
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and class_key is not None:
+                # the back-reference idiom: Acks(self) types the
+                # callee's param as the constructing class
+                out.add(class_key)
+                return out
+            if fn is not None:
+                for k in self._param_types.get(id(fn), {}).get(
+                    expr.id, ()
+                ):
+                    out.add(k)
+                for ci in self._local_types(fn, ctx, class_key).get(
+                    expr.id, ()
+                ):
+                    out.add(ci.key)
+            if not out:
+                out |= self._global_instance_keys(mod, expr.id)
+            return out
+        if isinstance(expr, ast.Attribute):
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and class_key is not None
+            ):
+                for ci in self.attr_classes(class_key, expr.attr):
+                    out.add(ci.key)
+                out |= self._property_return_keys(class_key, expr.attr)
+                return out
+            # module-global instance through a dotted path
+            d = dotted(expr)
+            if d:
+                out |= self._global_instance_keys(mod, d)
+            # attribute chain over any other typed receiver
+            for k in sorted(
+                self._expr_class_keys(
+                    ctx, class_key, fn, expr.value, depth + 1
+                )
+            ):
+                for ci in self.attr_classes(k, expr.attr):
+                    out.add(ci.key)
+                out |= self._property_return_keys(k, expr.attr)
+            return out
+        return out
+
+    def _property_return_keys(self, class_key, attr):
+        """Return-type keys when ``attr`` is a ``@property`` accessor
+        on ``class_key`` (``self._ps_client.cache`` -> HotRowCache)."""
+        m = self.lookup_method(class_key, attr)
+        if m is None or not isinstance(m, ast.FunctionDef):
+            return set()
+        if not any(
+            dotted(dec).rsplit(".", 1)[-1] == "property"
+            for dec in m.decorator_list
+        ):
+            return set()
+        return self._return_types.get(id(m), set())
+
+    def _elem_types_of(self, class_key, attr, _seen=None):
+        """Element class keys of container field ``class_key.attr``
+        (bases included, mirroring :meth:`attr_classes`)."""
+        if _seen is None:
+            _seen = set()
+        if class_key in _seen:
+            return set()
+        _seen.add(class_key)
+        out = set(
+            self._field_elem_types.get(class_key, {}).get(attr, ())
+        )
+        if out:
+            return out
+        ci = self.classes.get(class_key)
+        if ci is None:
+            return out
+        for base in ci.base_dotted:
+            r = self.resolve_dotted(class_key[0], base)
+            if r is not None and r[0] == "cls":
+                out |= self._elem_types_of(r[1].key, attr, _seen)
+        return out
+
+    def _expr_elem_keys(self, ctx, class_key, fn, expr, depth=0):
+        """Element class keys when ``expr`` evaluates to a container:
+        a container-typed field/local, or a call returning one."""
+        if depth > 6:
+            return set()
+        out = set()
+        if isinstance(expr, ast.IfExp):
+            return self._expr_elem_keys(
+                ctx, class_key, fn, expr.body, depth + 1
+            ) | self._expr_elem_keys(
+                ctx, class_key, fn, expr.orelse, depth + 1
+            )
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                out |= self._expr_elem_keys(
+                    ctx, class_key, fn, v, depth + 1
+                )
+            return out
+        if isinstance(expr, ast.Name):
+            if fn is not None:
+                out |= self._local_elems(fn, ctx, class_key).get(
+                    expr.id, set()
+                )
+            return out
+        if isinstance(expr, ast.Attribute):
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and class_key is not None
+            ):
+                return self._elem_types_of(class_key, expr.attr)
+            for k in sorted(
+                self._expr_class_keys(
+                    ctx, class_key, fn, expr.value, depth + 1
+                )
+            ):
+                out |= self._elem_types_of(k, expr.attr)
+            return out
+        if isinstance(expr, ast.Call):
+            ga = self._as_getattr_attr(expr)
+            if ga is not None:
+                return self._expr_elem_keys(
+                    ctx, class_key, fn, ga, depth + 1
+                )
+            f = expr.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in ("list", "sorted", "tuple", "set", "reversed")
+                and expr.args
+            ):
+                # shape passthrough: list(xs) holds xs's elements
+                return self._expr_elem_keys(
+                    ctx, class_key, fn, expr.args[0], depth + 1
+                )
+            if isinstance(f, ast.Attribute) and f.attr in (
+                "values",
+                "copy",
+            ):
+                # d.values() / d.copy() yield d's own elements
+                return self._expr_elem_keys(
+                    ctx, class_key, fn, f.value, depth + 1
+                )
+            for callee in self.resolve_call_at(
+                ctx, expr, enclosing_fn=fn, class_key=class_key
+            ):
+                out |= self._return_elem_types.get(id(callee), set())
+            return out
+        return out
+
+    def _as_getattr_attr(self, expr):
+        """``getattr(x, "lit"[, default])`` viewed as the attribute
+        read ``x.lit`` — the duck-typed optional-protocol idiom
+        (``getattr(t, "note_applied", None)``) the lock graph must see
+        through, or its acquisition edges go missing."""
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id == "getattr"
+            and len(expr.args) >= 2
+            and isinstance(expr.args[1], ast.Constant)
+            and isinstance(expr.args[1].value, str)
+        ):
+            a = ast.Attribute(
+                value=expr.args[0],
+                attr=expr.args[1].value,
+                ctx=ast.Load(),
+            )
+            return ast.copy_location(a, expr)
+        return None
+
+    def _global_instance_keys(self, mod, d):
+        """Class keys of a module-level instance referenced as ``d``
+        from ``mod`` — the plain name, an imported name, or a dotted
+        ``othermod.name`` path."""
+        full = self.expand(mod, d)
+        if not full:
+            return set()
+        if "." in full:
+            m, _, n = full.rpartition(".")
+            if m in self.modules:
+                return self._global_types.get((m, n), set())
+            return set()
+        if full in self.module_globals.get(mod, ()):
+            return self._global_types.get((mod, full), set())
+        return set()
 
     # -- lock identity --------------------------------------------------
 
@@ -591,9 +1443,11 @@ class Project:
 
     def lock_id(self, ctx, class_key, expr):
         """Stable identity for a held lock. ``self._x`` locks key on the
-        defining class; module-level locks on the module; anything else
-        falls back to the attribute/dotted text (lexical identity —
-        aliasing is a documented soundness caveat)."""
+        class that ASSIGNS the field (an inherited ``_Metric._lock``
+        used from ``Gauge.set`` is one lock, not two); module-level
+        locks on the module; anything else falls back to the
+        attribute/dotted text (lexical identity — aliasing is a
+        documented soundness caveat)."""
         mod = self.module_of_ctx(ctx)
         if (
             isinstance(expr, ast.Attribute)
@@ -601,7 +1455,21 @@ class Project:
             and expr.value.id == "self"
             and class_key is not None
         ):
-            return ("f", class_key, expr.attr)
+            return ("f", self._lock_home(class_key, expr.attr), expr.attr)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Attribute)
+            and isinstance(expr.value.value, ast.Name)
+            and expr.value.value.id == "self"
+            and class_key is not None
+        ):
+            # self._field.lock: key on the field's constructor-typed
+            # class so the cross-object acquire shares identity with the
+            # owning class's own uses (property aliasing maps the rest)
+            for ci in self.attr_classes(class_key, expr.value.attr):
+                return (
+                    "f", self._lock_home(ci.key, expr.attr), expr.attr
+                )
         if isinstance(expr, ast.Name):
             if expr.id in self.module_globals.get(mod, ()):
                 return ("g", mod, expr.id)
@@ -610,6 +1478,155 @@ class Project:
         if isinstance(expr, ast.Attribute):
             return ("x", expr.attr)
         return ("x", d or "anon@%d" % getattr(expr, "lineno", 0))
+
+    def _lock_home(self, class_key, attr):
+        """The class in ``class_key``'s MRO that actually assigns
+        ``attr`` — the defining home a subclass's uses key on."""
+        cached = self._lock_home_cache.get((class_key, attr))
+        if cached is not None:
+            return cached
+        home = class_key
+        seen = set()
+        stack = [class_key]
+        while stack:
+            ck = stack.pop(0)
+            if ck in seen:
+                continue
+            seen.add(ck)
+            ci = self.classes.get(ck)
+            if ci is None:
+                continue
+            if attr in ci.attr_ctors or attr in self._assigned_attrs(
+                ci
+            ):
+                home = ck
+                break
+            for base in ci.base_dotted:
+                r = self.resolve_dotted(ck[0], base)
+                if r is not None and r[0] == "cls":
+                    stack.append(r[1].key)
+        self._lock_home_cache[(class_key, attr)] = home
+        return home
+
+    def _assigned_attrs(self, ci):
+        """Every ``self.<attr> = ...`` target in ``ci``'s own methods
+        (``attr_ctors`` only records constructor-call values)."""
+        cached = self._assigned_attrs_cache.get(ci.key)
+        if cached is not None:
+            return cached
+        attrs = set()
+        for m in ci.methods.values():
+            for n in ast.walk(m):
+                if not isinstance(n, ast.Assign):
+                    continue
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attrs.add(t.attr)
+        self._assigned_attrs_cache[ci.key] = attrs
+        return attrs
+
+    def _lock_value_ids(self, ctx, class_key, fn, expr):
+        """Lock ids an expression may EVALUATE to — what flows into a
+        lock-valued parameter or a local alias. Null-ish stand-ins
+        (``_NULL_LOCK``, ``nullcontext()``) contribute nothing; only
+        field/global identities propagate (lexical ids are too noisy
+        to flow)."""
+        out = set()
+        if isinstance(expr, ast.IfExp):
+            return self._lock_value_ids(
+                ctx, class_key, fn, expr.body
+            ) | self._lock_value_ids(ctx, class_key, fn, expr.orelse)
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                out |= self._lock_value_ids(ctx, class_key, fn, v)
+            return out
+        if isinstance(expr, ast.Name):
+            if "null" in expr.id.lower():
+                return out
+            if fn is not None:
+                out |= self._param_locks.get(id(fn), {}).get(
+                    expr.id, set()
+                )
+            if not out and self._is_lock_acquire(ctx, expr):
+                lid = self.lock_id(ctx, class_key, expr)
+                if lid[0] == "g":
+                    out.add(lid)
+            return out
+        if isinstance(expr, ast.Attribute):
+            if "null" in expr.attr.lower():
+                return out
+            if self._is_lock_acquire(ctx, expr):
+                lid = self.lock_id(ctx, class_key, expr)
+                if lid[0] == "f":
+                    out.add(lid)
+            return out
+        return out
+
+    def lock_ids(self, ctx, class_key, fn, expr):
+        """All lock identities a ``with <expr>:`` acquire may take —
+        one id normally, several when ``expr`` is a local alias with
+        lock-valued branches (``lock = self._lock if sync else
+        _NULL_LOCK``) or a lock-valued parameter. An alias whose every
+        branch is a null stand-in acquires nothing real, but falls
+        back to the lexical id rather than vanish."""
+        if isinstance(expr, ast.Name) and fn is not None:
+            ids = self._param_locks.get(id(fn), {}).get(expr.id)
+            if ids:
+                return sorted(ids)
+            aliases = self._local_lock_aliases(fn, ctx, class_key)
+            ids = aliases.get(expr.id)
+            if ids:
+                return sorted(ids)
+        if isinstance(expr, ast.Attribute) and not (
+            isinstance(expr.value, ast.Name) and expr.value.id == "self"
+        ):
+            # m._lock on a typed non-self receiver (a loop variable
+            # over registry.values(), a getattr-bound object): home the
+            # field on the receiver's class like a self-acquire would
+            ids = []
+            for k in sorted(
+                self._expr_class_keys(ctx, class_key, fn, expr.value)
+            ):
+                lid = ("f", self._lock_home(k, expr.attr), expr.attr)
+                if lid not in ids:
+                    ids.append(lid)
+            if ids:
+                return ids
+        return [self.lock_id(ctx, class_key, expr)]
+
+    def _local_lock_aliases(self, fn, ctx, class_key):
+        """{local name: set(lock id)} from ``name = <lock expr>``
+        assignments inside ``fn`` (cached)."""
+        cached = self._lock_alias_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        out = {}
+        for n in ctx.walk_shallow(fn, stop=_FUNC_LIKE):
+            if not isinstance(n, ast.Assign):
+                continue
+            value = n.value
+            ids = self._lock_value_ids(ctx, class_key, fn, value)
+            if not ids and isinstance(value, ast.Call):
+                # a locally constructed lock keeps its lexical id
+                tail = dotted(value.func).rsplit(".", 1)[-1]
+                if tail in ("Lock", "RLock", "Condition"):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            ids = {
+                                self.lock_id(ctx, class_key, t)
+                            }
+                            break
+            if not ids:
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, set()).update(ids)
+        self._lock_alias_cache[id(fn)] = out
+        return out
 
     # -- per-function summaries ----------------------------------------
 
@@ -738,19 +1755,37 @@ class Project:
             if node is None or isinstance(node, _FUNC_LIKE):
                 return
             if isinstance(node, ast.With):
-                acquired = set()
+                cur = set(held)
+                grew = False
                 for item in node.items:
                     visit(item.context_expr, held)
                     if self._is_lock_acquire(ctx, item.context_expr):
-                        acquired.add(
-                            self.lock_id(ctx, class_key, item.context_expr)
-                        )
-                inner = held | acquired if acquired else held
+                        # acquisition event: each identity the item may
+                        # take (a local alias can hold several) is
+                        # acquired while everything to its left (and
+                        # the enclosing region) is already held
+                        for lid in self.lock_ids(
+                            ctx, class_key, fn, item.context_expr
+                        ):
+                            s.acquires.append(
+                                (
+                                    lid,
+                                    frozenset(cur),
+                                    item.context_expr.lineno,
+                                )
+                            )
+                            cur.add(lid)
+                            grew = True
+                inner = frozenset(cur) if grew else held
                 for st in node.body:
                     visit(st, inner)
                 return
             if isinstance(node, ast.Try):
                 lid = try_finally_lock(node)
+                if lid:
+                    s.acquires.append(
+                        (lid, frozenset(held), node.lineno)
+                    )
                 inner = held | {lid} if lid else held
                 for st in node.body:
                     visit(st, inner)
@@ -1158,6 +2193,20 @@ class Project:
         out.sort(key=lambda r: (r.path, r.lineno))
         self._races = out
         return out
+
+    # -- the R11 lock-order graph ---------------------------------------
+
+    def lock_graph(self):
+        """The composed global acquisition-edge graph (cached); see
+        elasticdl_tpu/tools/edlint/lockgraph.py. Constructor-argument
+        type flow runs first: the lock graph must see through
+        ``self._x = param`` wiring or witnessed dynamic edges would be
+        absent from it (the --lock-coverage soundness failure)."""
+        if self._lock_graph is None:
+            from elasticdl_tpu.tools.edlint.lockgraph import LockGraph
+
+            self._lock_graph = LockGraph(self)
+        return self._lock_graph
 
     # -- interprocedural blocking chains (R5 lift) ----------------------
 

@@ -1457,6 +1457,69 @@ class CopyOnWireRule(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# R11 — static lock-order cycles (the whole-program deadlock graph)
+# ---------------------------------------------------------------------------
+
+
+class LockOrderCycleRule(Rule):
+    id = "R11"
+    name = "lock-order-cycle"
+    doc = (
+        "whole-program static deadlock detection (lockgraph.py): every "
+        "`A held while acquiring B` event composes interprocedurally "
+        "over the call graph into one global edge graph (RLock "
+        "re-entry adds no edge; Condition follows the locktrace owner "
+        "protocol; Condition(lock)/rebind assignments alias onto one "
+        "identity); any cycle is a potential deadlock, reported with "
+        "root -> call chain -> acquire-site provenance per edge — "
+        "path coverage the runtime locktrace sanitizer structurally "
+        "lacks (it only orders interleavings a test executes); "
+        "`edlint --lock-coverage <export>` cross-validates the two"
+    )
+
+    def check(self, ctx):
+        project = getattr(ctx, "project", None)
+        if project is None:
+            return []
+        from elasticdl_tpu.tools.edlint.lockgraph import lock_name
+
+        out = []
+        for cycle in project.lock_graph().cycles():
+            # one finding per cycle, reported at its first edge's
+            # acquire site so the per-file ratchet keys stay meaningful
+            rep = cycle[0]
+            if rep.path != ctx.path:
+                continue
+            ring = " -> ".join(
+                [lock_name(e.src) for e in cycle]
+                + [lock_name(cycle[0].src)]
+            )
+            detail = "; ".join(
+                "edge %s->%s: root %s, chain %s, acquire at %s:%d"
+                % (
+                    lock_name(e.src),
+                    lock_name(e.dst),
+                    e.root,
+                    " -> ".join(e.chain),
+                    e.path,
+                    e.lineno,
+                )
+                for e in cycle
+            )
+            out.append(
+                Finding(
+                    self.id,
+                    rep.path,
+                    rep.lineno,
+                    "potential deadlock: lock-order cycle [%s] — %s"
+                    % (ring, detail),
+                    ctx.line_at(rep.lineno),
+                )
+            )
+        return out
+
+
 RULES = (
     DeviceProbeRule(),
     QueuePutRule(),
@@ -1468,4 +1531,5 @@ RULES = (
     LocksetRaceRule(),
     RpcRetrySafetyRule(),
     CopyOnWireRule(),
+    LockOrderCycleRule(),
 )

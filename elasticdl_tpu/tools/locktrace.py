@@ -32,6 +32,7 @@ keep real locks, so the graph stays our code's graph. Explicit
 use in tests.
 """
 
+import json
 import os
 import sys
 import threading as _threading
@@ -56,6 +57,14 @@ def _site(depth=2):
     )
 
 
+def _full_site(depth=2):
+    """Like :func:`_site` but with the FULL path — the key the
+    ``edlint --lock-coverage`` cross-check matches against its static
+    lock-constructor-site table (basenames collide across packages)."""
+    frame = sys._getframe(depth)
+    return "%s:%d" % (frame.f_code.co_filename, frame.f_lineno)
+
+
 class _Tracer:
     """The global acquisition graph plus per-thread held stacks."""
 
@@ -64,6 +73,7 @@ class _Tracer:
         # id(lock) -> {id(successor): "siteA -> siteB" edge provenance}
         self._edges = {}
         self._names = {}  # id(lock) -> display name
+        self._sites = {}  # id(lock) -> full creation site "path:line"
         self._local = _threading.local()
 
     def _held(self):
@@ -101,9 +111,11 @@ class _Tracer:
         if not held:
             with self._mu:
                 self._names[lid] = lock.name
+                self._sites[lid] = getattr(lock, "site", "")
             return
         with self._mu:
             self._names[lid] = lock.name
+            self._sites[lid] = getattr(lock, "site", "")
             for h in held:
                 cycle = self._path(lid, id(h))
                 if cycle is not None:
@@ -131,6 +143,14 @@ class _Tracer:
 
     def on_acquired(self, lock):
         self._held().append(lock)
+        lid = id(lock)
+        if lid not in self._names:
+            # non-blocking try-acquires bypass before_acquire (they
+            # cannot deadlock) but edges FROM the lock still need its
+            # name/site once it is held
+            with self._mu:
+                self._names[lid] = lock.name
+                self._sites[lid] = getattr(lock, "site", "")
 
     def on_release(self, lock):
         held = self._held()
@@ -143,7 +163,7 @@ class _Tracer:
 class _TracedBase:
     _REENTRANT = False
 
-    def __init__(self, name=None):
+    def __init__(self, name=None, site=None):
         self._inner = (
             _REAL_RLOCK() if self._REENTRANT else _REAL_LOCK()
         )
@@ -151,6 +171,9 @@ class _TracedBase:
             type(self).__name__,
             _site(2),
         )
+        # full creation site: the identity the lock-coverage export
+        # carries (edlint maps it onto a static lock id)
+        self.site = site or _full_site(2)
 
     def acquire(self, blocking=True, timeout=-1):
         tracer = _tracer
@@ -229,12 +252,12 @@ class TracedRLock(_TracedBase):
 
 def Lock(name=None):
     """An always-traced mutual-exclusion lock."""
-    return TracedLock(name=name)
+    return TracedLock(name=name, site=_full_site(2))
 
 
 def RLock(name=None):
     """An always-traced reentrant lock."""
-    return TracedRLock(name=name)
+    return TracedRLock(name=name, site=_full_site(2))
 
 
 # ---------------------------------------------------------------------------
@@ -268,12 +291,16 @@ def install(scope=DEFAULT_SCOPE):
 
         def lock_factory():
             if _in_scope(scope):
-                return TracedLock(name="Lock@%s" % _site(2))
+                return TracedLock(
+                    name="Lock@%s" % _site(2), site=_full_site(2)
+                )
             return _REAL_LOCK()
 
         def rlock_factory():
             if _in_scope(scope):
-                return TracedRLock(name="RLock@%s" % _site(2))
+                return TracedRLock(
+                    name="RLock@%s" % _site(2), site=_full_site(2)
+                )
             return _REAL_RLOCK()
 
         _threading.Lock = lock_factory
@@ -289,3 +316,45 @@ def uninstall():
     if _saved is not None:
         _threading.Lock, _threading.RLock = _saved
         _saved = None
+
+
+# ---------------------------------------------------------------------------
+# edge export: the dynamic half of the static<->dynamic cross-check
+# ---------------------------------------------------------------------------
+
+
+def export_edges():
+    """The current tracer's witnessed acquisition-edge graph as a list
+    of dicts (empty when not installed). Each edge carries display
+    names, FULL creation sites (what ``edlint --lock-coverage`` maps
+    onto static lock identities), and the first-witness provenance."""
+    tracer = _tracer
+    if tracer is None:
+        return []
+    out = []
+    with tracer._mu:
+        for src, dsts in sorted(tracer._edges.items()):
+            for dst, prov in sorted(dsts.items()):
+                out.append(
+                    {
+                        "src": tracer._names.get(src, "<lock>"),
+                        "dst": tracer._names.get(dst, "<lock>"),
+                        "src_site": tracer._sites.get(src, ""),
+                        "dst_site": tracer._sites.get(dst, ""),
+                        "provenance": prov,
+                    }
+                )
+    return out
+
+
+def export(path):
+    """Append the witnessed edge graph to ``path`` as JSONL (one edge
+    per line; suites append per test and the reader dedupes). Returns
+    the number of edges written. Call BEFORE :func:`uninstall` — the
+    graph dies with the tracer."""
+    edges = export_edges()
+    if edges:
+        with open(path, "a", encoding="utf-8") as f:
+            for edge in edges:
+                f.write(json.dumps(edge, sort_keys=True) + "\n")
+    return len(edges)

@@ -2,9 +2,10 @@
 # One correctness gate for the threaded data plane
 # (docs/static_analysis.md):
 #
-#   1. edlint — the whole-program AST analyzer (R1-R10: concurrency,
+#   1. edlint — the whole-program AST analyzer (R1-R11: concurrency,
 #      jit-purity, cross-file blocking chains, the R8 lockset race
-#      detector, R9 RPC retry-safety, R10 copy-on-wire) with the
+#      detector, R9 RPC retry-safety, R10 copy-on-wire, R11 lock-order
+#      deadlock detection over the whole-program lock graph) with the
 #      stale-ratchet check on
 #      (allowlists may only shrink). The pass runs under a hard <30s
 #      wall-clock budget — the mtime-keyed AST cache keeps warm runs
@@ -13,15 +14,21 @@
 #   2. the data-plane suites under EDL_LOCKTRACE=1 — every
 #      threading.Lock/RLock our code takes joins the runtime lock-order
 #      sanitizer (ABBA raises deterministically instead of deadlocking)
-#      and every test asserts no non-daemon thread leaks out.
+#      and every test asserts no non-daemon thread leaks out. Each
+#      traced suite also EXPORTS its witnessed acquisition-edge graph.
+#   3. the static<->dynamic cross-check — every edge the sanitizer
+#      witnessed at runtime must appear in R11's static lock graph
+#      (a missing edge means the interprocedural summaries are
+#      unsound: fail loudly, do not ratchet).
 #
 # Run from anywhere: ./scripts/check.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== edlint whole-program (R1-R10 + stale-ratchet check, 30s budget) =="
+echo "== edlint whole-program (R1-R11 + stale-ratchet check, 30s budget) =="
 EDLINT_JSON="${TMPDIR:-/tmp}/edlint_gate.$$.json"
-trap 'rm -f "$EDLINT_JSON"' EXIT
+LOCK_EDGES="${TMPDIR:-/tmp}/edlint_gate.$$.edges.jsonl"
+trap 'rm -f "$EDLINT_JSON" "$LOCK_EDGES"' EXIT
 rc=0
 timeout -k 5 30 python -m elasticdl_tpu.tools.edlint --stale --json \
     > "$EDLINT_JSON" || rc=$?
@@ -77,9 +84,20 @@ for b in doc["broken"]:
 PY
     exit "$rc"
 fi
+EDLINT_JSON="$EDLINT_JSON" python - <<'PY'
+import json
+import os
+
+with open(os.environ["EDLINT_JSON"]) as f:
+    doc = json.load(f)
+lg = doc.get("lock_graph") or {}
+print("   lock graph: %d lock(s), %d edge(s), %d cycle(s)"
+      % (lg.get("nodes", 0), lg.get("edges", 0), lg.get("cycles", 0)))
+PY
 
 echo "== data-plane suites under the lock-order sanitizer =="
-JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
+JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 EDL_LOCKTRACE_EXPORT="$LOCK_EDGES" \
+    python -m pytest \
     tests/test_input_pipeline.py \
     tests/test_ps_overlap.py \
     tests/test_async_concurrency.py \
@@ -100,5 +118,17 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 python -m pytest \
     tests/test_serving.py \
     tests/test_serving_batcher.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
+
+echo "== static<->dynamic lock-graph cross-check =="
+if [ -s "$LOCK_EDGES" ]; then
+    # warm cache from gate 1: well under the same 30s budget
+    timeout -k 5 30 python -m elasticdl_tpu.tools.edlint \
+        --lock-coverage "$LOCK_EDGES"
+else
+    echo "cross-check SKIPPED: the traced suites exported no edges" >&2
+    echo "(EDL_LOCKTRACE_EXPORT produced an empty file — the conftest" >&2
+    echo "export hook or the sanitizer install is broken)" >&2
+    exit 1
+fi
 
 echo "check.sh: all gates green"

@@ -95,6 +95,12 @@ def _edl_locktrace_and_thread_leak_guard(request):
         yield
     finally:
         if traced:
+            export_path = os.environ.get("EDL_LOCKTRACE_EXPORT")
+            if export_path:
+                # the witnessed-edge graph dies with the tracer; dump it
+                # first so edlint --lock-coverage can cross-check the
+                # static lock-order graph against what the suite saw
+                locktrace.export(export_path)
             locktrace.uninstall()
         leaked = [
             t

@@ -4,11 +4,12 @@
 Three layers:
 
 - the tree gate: ``python -m elasticdl_tpu.tools.edlint`` must exit 0
-  over this repo with ALL TEN rules active (the whole-program pass —
+  over this repo with ALL ELEVEN rules active (the whole-program pass —
   cross-file call graph, thread roots, R8 lockset race detection, R9
-  RPC retry-safety, R10 copy-on-wire — included), and every allowlist
+  RPC retry-safety, R10 copy-on-wire, R11 lock-order deadlock
+  detection — included), and every allowlist
   ratchet entry must carry a reason (the acceptance bar);
-- known-bad fixtures per rule R1–R10, each paired with the safe idiom
+- known-bad fixtures per rule R1–R11, each paired with the safe idiom
   the rule must NOT flag — the R4/R5/R6 bad fixtures are the REAL
   pre-fix violations PR 4 fixed; the cross-file R5 fixture re-splits
   the PR-4 ledger-lock chain across a module boundary (the shape only
@@ -85,7 +86,7 @@ def _rules_of(violations):
 # ---------------------------------------------------------------------------
 
 
-def test_tree_is_clean_under_all_ten_rules():
+def test_tree_is_clean_under_all_eleven_rules():
     proc = subprocess.run(
         [sys.executable, "-m", "elasticdl_tpu.tools.edlint", "--stale"],
         capture_output=True,
@@ -1987,3 +1988,395 @@ def test_stale_entries_enforce_only_shrinks(tmp_path):
     stale = stale_entries(counts, allow=allow)
     assert ("R1", "elasticdl_tpu/one.py", 1, 3) in stale
     assert ("R1", "elasticdl_tpu/gone.py", 0, 1) in stale
+
+
+# ---------------------------------------------------------------------------
+# R11 — static lock-order deadlock detection
+# ---------------------------------------------------------------------------
+
+R11_ABBA = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+R11_ORDERED = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+
+def test_r11_same_file_abba(tmp_path):
+    bad = _lint(
+        tmp_path, R11_ABBA, relpath="elasticdl_tpu/worker/fixture.py"
+    )
+    assert _rules_of(bad) == ["R11"], bad
+    msg = bad[0].message
+    assert "Pair._a" in msg and "Pair._b" in msg
+    # full provenance per edge: root, call chain, acquire site
+    assert "root" in msg and "chain" in msg and "acquire at" in msg
+    good = _lint(
+        tmp_path, R11_ORDERED, relpath="elasticdl_tpu/worker/fixture.py"
+    )
+    assert not good
+
+
+R11_XFILE_LEDGER = """
+import threading
+from elasticdl_tpu.worker.acks import Acks
+
+class Ledger:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.acks = Acks(self)
+
+    def note(self):
+        with self._mu:
+            self.acks.confirm()
+
+    def flush(self):
+        with self._mu:
+            pass
+"""
+
+R11_XFILE_ACKS = """
+import threading
+
+class Acks:
+    def __init__(self, ledger):
+        self._pending = threading.Lock()
+        self._ledger = ledger
+
+    def confirm(self):
+        with self._pending:
+            pass
+
+    def requeue(self):
+        with self._pending:
+            self._ledger.flush()
+"""
+
+
+def test_r11_cross_file_abba_through_call_graph(tmp_path):
+    """The ABBA only exists interprocedurally: each file on its own is
+    single-lock; the inversion is Ledger.note -> Acks.confirm vs
+    Acks.requeue -> Ledger.flush, with the back-reference typed from
+    the ctor argument (Acks(self))."""
+    bad = _lint(
+        tmp_path,
+        R11_XFILE_LEDGER,
+        relpath="elasticdl_tpu/worker/ledger.py",
+        extra={"elasticdl_tpu/worker/acks.py": R11_XFILE_ACKS},
+    )
+    assert _rules_of(bad) == ["R11"], bad
+    msg = bad[0].message
+    assert "Ledger._mu" in msg and "Acks._pending" in msg
+    # each edge's chain names the cross-file hop
+    assert "confirm" in msg and "flush" in msg
+
+
+R11_RLOCK_REENTRANT = """
+import threading
+
+class Reent:
+    def __init__(self):
+        self._a = threading.RLock()
+        self._b = threading.Lock()
+
+    def outer(self):
+        with self._a:
+            with self._b:
+                self.inner()
+
+    def inner(self):
+        with self._a:
+            pass
+"""
+
+
+def test_r11_rlock_reentry_adds_no_edge(tmp_path):
+    """inner() re-acquiring the RLock the caller already holds must NOT
+    record a b->a edge (which would close a false a->b->a cycle)."""
+    good = _lint(
+        tmp_path,
+        R11_RLOCK_REENTRANT,
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert not good, good
+
+
+R11_CONDITION_ABBA = """
+import threading
+
+class CondOwner:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._side = threading.Lock()
+
+    def produce(self):
+        with self._mu:
+            with self._side:
+                pass
+
+    def consume(self):
+        with self._side:
+            with self._cv:
+                self._cv.notify_all()
+"""
+
+R11_CONDITION_OWNED = """
+import threading
+
+class CondOwner:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+
+    def produce(self):
+        with self._mu:
+            with self._cv:
+                self._cv.notify_all()
+
+    def consume(self):
+        with self._cv:
+            self._cv.wait()
+"""
+
+
+def test_r11_condition_aliases_onto_its_lock(tmp_path):
+    """Condition(self._mu) IS self._mu for ordering purposes: an ABBA
+    written half through the condition is still a cycle, and acquiring
+    the condition while holding its own lock is re-entry, not an
+    edge."""
+    bad = _lint(
+        tmp_path,
+        R11_CONDITION_ABBA,
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert _rules_of(bad) == ["R11"], bad
+    good = _lint(
+        tmp_path,
+        R11_CONDITION_OWNED,
+        relpath="elasticdl_tpu/worker/fixture.py",
+    )
+    assert not good, good
+
+
+R11_THREE_LOCK = """
+import threading
+
+class Tri:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def bc(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def ca(self):
+        with self._c:
+            with self._a:
+                pass
+"""
+
+
+def test_r11_three_lock_cycle(tmp_path):
+    """No single function holds an inverted pair; only the composed
+    graph closes a->b->c->a."""
+    bad = _lint(
+        tmp_path, R11_THREE_LOCK, relpath="elasticdl_tpu/worker/fixture.py"
+    )
+    assert _rules_of(bad) == ["R11"], bad
+    msg = bad[0].message
+    assert "Tri._a" in msg and "Tri._b" in msg and "Tri._c" in msg
+
+
+# ---------------------------------------------------------------------------
+# --paths incremental mode + the locktrace cross-check round trip
+# ---------------------------------------------------------------------------
+
+
+def test_paths_scans_only_named_files_with_whole_tree_context(tmp_path):
+    """--paths restricts FINDINGS to the named files while cross-file
+    resolution still sees the whole tree: the R5 chain below lives in
+    service.py but blocks in ack_ledger.py."""
+    caller = (
+        "import threading\n"
+        "from elasticdl_tpu.worker.ack_ledger import AckLedger\n"
+        "class Service:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._ledger = AckLedger()\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            self._ledger.drain()\n"
+    )
+    callee = (
+        "import time\n"
+        "class AckLedger:\n"
+        "    def drain(self):\n"
+        "        time.sleep(0.5)\n"
+        "def stray():\n"
+        "    import jax\n"
+        "    return jax.devices()\n"
+    )
+    root = _plant(
+        tmp_path,
+        caller,
+        "elasticdl_tpu/worker/service.py",
+        extra={"elasticdl_tpu/worker/ack_ledger.py": callee},
+    )
+    findings, broken = scan(
+        str(root), only_paths=["elasticdl_tpu/worker/service.py"]
+    )
+    assert not broken, broken
+    # the cross-file R5 surfaces; the R1 violation in the OTHER file
+    # does not (it is context, not a scan target)
+    assert {f.rule for f in findings} == {"R5"}, findings
+    assert all(
+        f.path == "elasticdl_tpu/worker/service.py" for f in findings
+    )
+    # a --paths target outside the scan scope is reported broken
+    _, broken = scan(str(root), only_paths=["not/in/tree.py"])
+    assert broken
+
+
+def test_project_cache_hit_equivalence_and_invalidation(tmp_path):
+    """The whole-Project pickle behind sub-second --paths runs: an
+    unchanged tree must serve the cached analysis WITHOUT rebuilding
+    (same findings), and any file edit must invalidate it — a stale
+    Project serving yesterday's lock graph would un-sound the
+    static<->dynamic cross-check."""
+    import elasticdl_tpu.tools.edlint.project as proj_mod
+
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "def main():\n"
+        "    A().ab()\n"
+    )
+    root = _plant(tmp_path, src, "elasticdl_tpu/fixture.py")
+    first, broken = scan(str(root))
+    assert not broken, broken
+
+    builds = []
+    orig_init = proj_mod.Project.__init__
+
+    def counting_init(self, contexts):
+        builds.append(1)
+        orig_init(self, contexts)
+
+    proj_mod.Project.__init__ = counting_init
+    try:
+        second, _ = scan(str(root))
+        assert not builds, "unchanged tree must hit the Project cache"
+        assert [
+            (f.path, f.lineno, f.rule) for f in second
+        ] == [(f.path, f.lineno, f.rule) for f in first]
+        # edit the file: the cached analysis must NOT survive, and the
+        # fresh scan must see the new code (a new R5 blocking chain)
+        target = root / "elasticdl_tpu/fixture.py"
+        target.write_text(
+            src
+            + "    import time\n"
+            + "    with A()._a:\n"
+            + "        time.sleep(1.0)\n"
+        )
+        os.utime(target, ns=(1, 1))  # defeat same-ns mtime collisions
+        third, _ = scan(str(root))
+        assert builds, "an edited tree must rebuild the Project"
+        assert any(f.rule == "R5" for f in third), third
+    finally:
+        proj_mod.Project.__init__ = orig_init
+
+
+def test_lock_coverage_round_trip(tmp_path):
+    """Dynamic edges witnessed by locktrace map back onto the static
+    graph: execute a planted module under the sanitizer, export the
+    edge graph, and verify coverage() finds every dynamic edge in the
+    static one (the soundness direction check.sh gates on)."""
+    from elasticdl_tpu.tools import locktrace
+    from elasticdl_tpu.tools.edlint.core import scan_project
+    from elasticdl_tpu.tools.edlint.lockgraph import coverage, load_export
+
+    src = (
+        "import threading\n"
+        "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._io = threading.Lock()\n"
+        "    def spill(self):\n"
+        "        with self._mu:\n"
+        "            with self._io:\n"
+        "                pass\n"
+    )
+    root = _plant(tmp_path, src, "elasticdl_tpu/worker/store.py")
+    planted = root / "elasticdl_tpu" / "worker" / "store.py"
+
+    export_path = tmp_path / "edges.jsonl"
+    locktrace.install()  # fresh graph; conftest never traces this module
+    try:
+        namespace = {}
+        exec(
+            compile(src, str(planted), "exec"), namespace
+        )  # creation sites carry the planted path
+        store = namespace["Store"]()
+        store.spill()
+        wrote = locktrace.export(str(export_path))
+        assert wrote == 1
+    finally:
+        locktrace.uninstall()
+
+    _, broken, project = scan_project(str(root))
+    assert not broken, broken
+    graph = project.lock_graph()
+    assert graph.stats()["edges"] == 1
+    cov = coverage(graph, load_export(str(export_path)))
+    assert cov.dynamic_total == 1
+    assert len(cov.witnessed) == 1
+    assert not cov.missing, cov.missing
+    assert not cov.unmatched, cov.unmatched
+    assert not cov.unwitnessed

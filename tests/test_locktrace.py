@@ -210,3 +210,60 @@ def test_uninstall_restores_real_constructors():
     assert threading.Lock is not None
     lk = threading.Lock()
     assert not isinstance(lk, locktrace.TracedLock)
+
+
+def test_edge_graph_resets_per_install():
+    """The acquisition graph dies with uninstall(): an A->B ordering
+    witnessed in one install session must NOT survive into the next —
+    a stale edge would turn the next session's innocent B->A into a
+    phantom inversion (and corrupt the exported graph the static
+    cross-check validates against)."""
+    locktrace.install()
+    try:
+        a, b = locktrace.Lock("A"), locktrace.Lock("B")
+        with a:
+            with b:
+                pass
+        assert len(locktrace.export_edges()) == 1
+    finally:
+        locktrace.uninstall()
+    assert locktrace.export_edges() == []  # graph died with the tracer
+    locktrace.install()
+    try:
+        assert locktrace.export_edges() == []  # fresh graph
+        # the REVERSED order is fine now: no stale A->B edge to close
+        # a cycle against
+        with b:
+            with a:
+                pass
+        edges = locktrace.export_edges()
+        assert [(e["src"], e["dst"]) for e in edges] == [("B", "A")]
+    finally:
+        locktrace.uninstall()
+
+
+def test_export_writes_jsonl_with_creation_sites(tmp_path, traced):
+    """export() appends one JSON object per witnessed edge, carrying
+    the FULL creation sites ``edlint --lock-coverage`` maps onto
+    static lock identities."""
+    import json as _json
+
+    a, b = locktrace.Lock("outer"), locktrace.Lock("inner")
+    with a:
+        with b:
+            pass
+    out = tmp_path / "edges.jsonl"
+    assert locktrace.export(str(out)) == 1
+    assert locktrace.export(str(out)) == 1  # append mode: runs stack
+    lines = out.read_text().splitlines()
+    assert len(lines) == 2
+    for line in lines:
+        edge = _json.loads(line)
+        assert edge["src"] == "outer" and edge["dst"] == "inner"
+        # full paths, not basenames: the cross-check's join key
+        for site in (edge["src_site"], edge["dst_site"]):
+            path, _, line = site.rpartition(":")
+            assert path.endswith("test_locktrace.py") and path != (
+                "test_locktrace.py"
+            ), site
+            assert int(line) > 0
