@@ -1082,6 +1082,246 @@ def bench_compile(quick=False):
     }
 
 
+def bench_resize(quick=False):
+    """Elastic layout re-solve A/B (ISSUE 20; docs/distributed.md
+    "Layout re-solve"), CPU mesh, single process, real ``establish()``.
+
+    A transformer whose per-device memory budget rules out dp-only
+    trains under a :class:`LayoutPlanner`. The journey: establish
+    unbudgeted (the solver picks the dp-widest layout), train, then the
+    budget lands (the over-budget moment) and the next establish
+    re-solves to a tp>=2 layout, moving the state through the DIRECT
+    relayout path. Two arms time that second establish + first step:
+
+    - cold: executable cache disabled — the layout change pays a full
+      re-trace/re-compile (the unplanned re-solve pause);
+    - planned: cache + speculative AOT on — the planner's top-2 layout
+      hints covered the post-budget winner during steady-state
+      training, so the resize finds its executable pre-built.
+
+    Gates (rc 1 on miss):
+    - planned pause <= 0.5x the cold pause
+      (resize_layout_speculative_pause_ratio);
+    - the solver-chosen layout's measured examples/sec >= 1.0x naive
+      dp-only at the micro-batch the budget admits dp-only
+      (resize_solver_vs_naive_examples_ratio) — the budget here admits
+      NO dp-only micro-batch, so naive runs charitably at the smallest
+      table entry (a real dp-only job would simply OOM);
+    - the relayout carries the train state BITWISE (params + optimizer
+      slots), checked in the planned arm across the layout change.
+    """
+    import jax
+
+    from elasticdl_tpu.parallel import distributed as dist_mod
+    from elasticdl_tpu.parallel import layout_solver
+    from elasticdl_tpu.parallel.distributed import WorldSpec
+    from elasticdl_tpu.parallel.elastic import ElasticDPTrainer
+    from elasticdl_tpu.parallel.layout_solver import Layout, LayoutPlanner
+    from model_zoo.transformer_lm import transformer_lm as zoo
+
+    # single-process establish: the world RPC layer is not under test
+    dist_mod.ensure_world = lambda spec, **kwargs: None
+
+    cfg = dict(
+        vocab_size=256, num_layers=2, num_heads=4, head_dim=16,
+        embed_dim=64, mlp_dim=128, use_flash=False,
+    )
+    seq = 32
+    steps = 6 if quick else 12
+    model = zoo.custom_model(**cfg)
+
+    def builder(mesh):
+        # stable module identity: the speculative compile's cache key
+        # includes id(module), so the builder must return THE model
+        return model, zoo.param_shardings(mesh, tensor_parallel=2)
+
+    def make_batches(n, rows, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            ids = r.integers(0, cfg["vocab_size"], size=(rows, seq))
+            ids = ids.astype(np.int32)
+            out.append(({"tokens": ids}, ids))
+        return out
+
+    spec_of = lambda epoch: WorldSpec(
+        coordinator="", num_processes=1, process_id=0, epoch=epoch
+    )
+
+    def host_tree(ts):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), ts
+        )
+
+    def trees_equal(a, b):
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        return len(la) == len(lb) and all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(la, lb)
+        )
+
+    def budget_for(planner):
+        """A per-device budget that rules dp-only OUT at every table
+        micro-batch while admitting tp>=2 at the largest: the
+        'over-budget transformer' of the acceptance gate, derived
+        from the planner's own profile so it tracks the model."""
+        prof = planner.profile
+        return (
+            prof.replicated_bytes
+            + prof.tp_bytes / 2.0
+            + prof.activation_bytes_per_row * max(planner.microbatches)
+        )
+
+    def wait_speculation(t, deadline_s=300):
+        sc = t._spec_compiler
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if sc is None or (sc.idle() and sc.pending_count() == 0):
+                return
+            time.sleep(0.05)
+
+    def measure_eps(t, batches, rows):
+        t.train_step(batches[0][0], batches[0][1], rows, sync=True)
+        t0 = time.perf_counter()
+        for features, labels in batches[1:]:
+            t.train_step(features, labels, rows, sync=True)
+        wall = time.perf_counter() - t0
+        return (len(batches) - 1) * rows / max(wall, 1e-9)
+
+    # the job's GLOBAL batch is constant across the journey (elastic
+    # resizes change the layout under the batch, not the batch): the
+    # speculative AOT compiles against the last-trained batch shape,
+    # so a shape change at the resize would defeat the pre-built
+    # executable in both arms alike
+    rows = 128
+
+    def run_arm(cache, speculative):
+        planner = LayoutPlanner(memory_budget=None)
+        t = ElasticDPTrainer(
+            model,
+            zoo.loss,
+            zoo.optimizer(),
+            distributed_builder=builder,
+            layout_planner=planner,
+        )
+        t.compile_cache_enabled = cache
+        t.speculative_compile = speculative
+        warm = make_batches(1, rows, 31)
+        t.establish(spec_of(0), example_batch=warm[0])
+        assert planner.profile is not None, "profile derivation failed"
+        pre = planner.last_plan.layout
+        # steady state on the unbudgeted layout (speculation, when on,
+        # compiles the planner's top-2 hints for this size meanwhile)
+        for features, labels in make_batches(3, rows, 32):
+            t.train_step(features, labels, rows, sync=True)
+        # the budget lands: next establish re-solves the layout
+        planner.memory_budget = budget_for(planner)
+        post = layout_solver.best(
+            8, planner.profile, planner.memory_budget,
+            planner.microbatches,
+        ).layout
+        if (post.dp, post.tp) == (pre.dp, pre.tp):
+            raise RuntimeError(
+                "budget did not force a layout change (%s -> %s)"
+                % (pre, post)
+            )
+        if speculative:
+            t.hint_world_sizes([8])
+            wait_speculation(t)
+        before = host_tree(t._ts)
+        resize_batch = make_batches(1, rows, 33)[0]
+        # pause = establish + first step; the bitwise relayout check
+        # (a host pull) runs BETWEEN the two timed windows so it costs
+        # neither, and before the step advances the state
+        t0 = time.perf_counter()
+        t.establish(spec_of(1), example_batch=resize_batch)
+        establish_s = time.perf_counter() - t0
+        preserved = trees_equal(before, host_tree(t._ts))
+        t1 = time.perf_counter()
+        t.train_step(resize_batch[0], resize_batch[1], rows, sync=True)
+        pause = establish_s + (time.perf_counter() - t1)
+        return t, planner, pre, post, pause, preserved
+
+    # cold arm: the unplanned re-solve pause
+    t_cold, _, _, _, cold_pause, _ = run_arm(
+        cache=False, speculative=False
+    )
+    t_cold.close()
+    # planned arm: layout-hinted speculation; also the bitwise gate
+    # and the solver-arm throughput measurement
+    t_plan, planner, pre, post, planned_pause, preserved = run_arm(
+        cache=True, speculative=True
+    )
+    if not preserved:
+        t_plan.close()
+        raise RuntimeError(
+            "direct relayout dropped state: train state differs "
+            "across the %s -> %s layout change" % (pre, post)
+        )
+    solver_eps = measure_eps(
+        t_plan, make_batches(steps + 1, rows, 41), rows
+    )
+    t_plan.close()
+
+    # naive dp-only on the SAME over-budget model: the largest
+    # micro-batch the budget admits for dp8 x tp1 (none here — run
+    # charitably at the table's smallest)
+    budget = planner.memory_budget
+    naive_mb = None
+    for mb in sorted(planner.microbatches, reverse=True):
+        if layout_solver.device_bytes(
+            Layout(8, 1, mb), planner.profile
+        ) <= budget:
+            naive_mb = mb
+            break
+    naive_mb = naive_mb or min(planner.microbatches)
+    naive_rows = 8 * naive_mb
+    t_naive = ElasticDPTrainer(
+        model,
+        zoo.loss,
+        zoo.optimizer(),
+        distributed_builder=builder,
+        mesh_axes_fn=lambda n: {"data": 8, "model": 1},
+    )
+    t_naive.compile_cache_enabled = True
+    warm = make_batches(1, naive_rows, 51)
+    t_naive.establish(spec_of(0), example_batch=warm[0])
+    naive_eps = measure_eps(
+        t_naive, make_batches(steps + 1, naive_rows, 52), naive_rows
+    )
+    t_naive.close()
+
+    print(
+        "layout re-solve: %s -> %s; pause cold %.2fs vs planned %.2fs "
+        "(ratio %.2f); solver %.0f ex/s (rows %d) vs naive dp-only "
+        "%.0f ex/s (rows %d, ratio %.2f); state bitwise-preserved"
+        % (
+            (pre.dp, pre.tp, pre.microbatch),
+            (post.dp, post.tp, post.microbatch),
+            cold_pause,
+            planned_pause,
+            planned_pause / max(cold_pause, 1e-9),
+            solver_eps,
+            rows,
+            naive_eps,
+            naive_rows,
+            solver_eps / max(naive_eps, 1e-9),
+        ),
+        file=sys.stderr,
+    )
+    return {
+        "cold_pause_s": cold_pause,
+        "planned_pause_s": planned_pause,
+        "pause_ratio": planned_pause / max(cold_pause, 1e-9),
+        "solver_eps": solver_eps,
+        "naive_eps": naive_eps,
+        "examples_ratio": solver_eps / max(naive_eps, 1e-9),
+        "pre_layout": (pre.dp, pre.tp, pre.microbatch),
+        "post_layout": (post.dp, post.tp, post.microbatch),
+    }
+
+
 def bench_preemption():
     """Wall-clock of the 3-process elastic allreduce job with one worker
     SIGKILLed mid-run, relative to the undisturbed run (CPU/gloo)."""
@@ -5628,6 +5868,94 @@ def main(argv=None):
         )
         return 0
 
+    if "--resize" in argv:
+        # multi-device CPU mesh, pinned BEFORE any jax import below
+        _force_cpu_mesh(8)
+        try:
+            res = bench_resize(quick)
+        except RuntimeError as exc:
+            # the arm's own hard gates (no layout change forced /
+            # bitwise relayout mismatch) — machine-readable, rc 1
+            print(
+                json.dumps(
+                    {
+                        "metric": "resize_layout_speculative_pause_ratio",
+                        "error": "layout re-solve gate failed: %s" % exc,
+                    }
+                )
+            )
+            return 1
+        failures = 0
+        if res["pause_ratio"] > 0.5:
+            failures = 1
+            print(
+                json.dumps(
+                    {
+                        "metric": "resize_layout_speculative_pause_ratio",
+                        "error": "planned resize pause %.2fs is %.2fx "
+                        "the cold re-solve pause %.2fs — above the "
+                        "0.5x ceiling"
+                        % (
+                            res["planned_pause_s"],
+                            res["pause_ratio"],
+                            res["cold_pause_s"],
+                        ),
+                    }
+                )
+            )
+        else:
+            _emit(
+                "resize_layout_speculative_pause_ratio",
+                round(res["pause_ratio"], 2),
+                "x planned (layout-hinted speculative AOT) vs cold "
+                "re-solve pause for the budget-forced %s -> %s layout "
+                "change (planned %.2fs, cold %.2fs; pause = establish "
+                "+ first step; ceiling 0.50x, rc 1 above; state "
+                "carried bitwise through the direct relayout)"
+                % (
+                    "dp%dxtp%d" % res["pre_layout"][:2],
+                    "dp%dxtp%d" % res["post_layout"][:2],
+                    res["planned_pause_s"],
+                    res["cold_pause_s"],
+                ),
+                update,
+                lower_is_better=True,
+            )
+        if res["examples_ratio"] < 1.0:
+            failures = 1
+            print(
+                json.dumps(
+                    {
+                        "metric": "resize_solver_vs_naive_examples_ratio",
+                        "error": "solver-chosen layout trains %.1f "
+                        "ex/s, %.2fx naive dp-only's %.1f ex/s — "
+                        "below the 1.0x floor"
+                        % (
+                            res["solver_eps"],
+                            res["examples_ratio"],
+                            res["naive_eps"],
+                        ),
+                    }
+                )
+            )
+        else:
+            _emit(
+                "resize_solver_vs_naive_examples_ratio",
+                round(res["examples_ratio"], 2),
+                "x examples/sec, solver-chosen %s mb%d vs naive "
+                "dp-only at the micro-batch the budget admits "
+                "(%.0f vs %.0f ex/s on the over-budget transformer; "
+                "floor 1.0x, rc 1 below)"
+                % (
+                    "dp%dxtp%d" % res["post_layout"][:2],
+                    res["post_layout"][2],
+                    res["solver_eps"],
+                    res["naive_eps"],
+                ),
+                update,
+            )
+        return failures
+
     if "--elastic-tax" in argv:
         overhead_pct, fused, elastic = bench_elastic_tax(quick)
         _emit(
@@ -6627,6 +6955,18 @@ def main(argv=None):
         rc, stdout, stderr, timed_out = _run_section_cmd(cmd, timeout)
         if timed_out:
             failures += 1
+            # metrics the section emitted BEFORE the kill are real
+            # measurements — flush them so a wedge late in a section
+            # does not discard the evidence gathered ahead of it (the
+            # partial stdout used to be dropped on the floor here)
+            flushed = 0
+            for line in stdout.splitlines():
+                try:
+                    json.loads(line)
+                except ValueError:
+                    continue
+                print(line)
+                flushed += 1
             # a budget-clamped timeout is NOT evidence of a wedge — a
             # healthy-but-slow section that lost most of its window to
             # the budget must not condemn the remaining device sections
@@ -6636,6 +6976,9 @@ def main(argv=None):
                     json.dumps(
                         {
                             "metric": "bench_wedge_verdict",
+                            "section": name,
+                            "timeout_s": timeout,
+                            "metrics_flushed": flushed,
                             "error": "device transport wedged: "
                             "section %s hung past %ds; skipping the "
                             "remaining device sections" % (name, timeout),
@@ -6646,6 +6989,9 @@ def main(argv=None):
                 json.dumps(
                     {
                         "metric": name,
+                        "section": name,
+                        "timed_out_after_s": timeout,
+                        "metrics_flushed": flushed,
                         "error": "section timed out after %ds "
                         "(wedged device transport?)" % timeout,
                     }
@@ -6684,6 +7030,9 @@ def main(argv=None):
     section("telemetry_overhead_pct", ["--telemetry"], 600)
     section("trace_plane_overhead_pct", ["--trace"], 600)
     section("compile_cached_establish_speedup", ["--compile"], 600)
+    # the layout re-solve gates (ISSUE 20): planned-vs-cold resize
+    # pause ceiling + solver-vs-naive throughput floor, CPU mesh
+    section("resize_layout_speculative_pause_ratio", ["--resize"], 600)
     section("wire_dense_roundtrip_speedup", ["--wire"], 300)
     section("sharded_dense_examples_per_sec", ["--sharded"], 600)
     section("ps_deepfm_examples_per_sec", ["--ps"], 900)

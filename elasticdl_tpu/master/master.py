@@ -275,6 +275,14 @@ class Master:
                 # the stage multiple (mesh_axes raises on non-divisor
                 # worlds, which would otherwise crash-loop formation)
                 tp = int(mp.get("tensor_parallel", 0) or 0)
+                # min_tensor_parallel is the floor the layout solver
+                # respects when re-planning dp x tp at establish; the
+                # world multiple must honour the same floor so the
+                # solver's smallest admissible tp always divides the
+                # formed world (docs/distributed.md, Layout re-solve)
+                tp = max(
+                    tp, int(mp.get("min_tensor_parallel", 0) or 0)
+                )
             except (TypeError, ValueError):
                 pass
             raw_workers = int(getattr(args, "num_workers", 0) or 0)

@@ -328,18 +328,29 @@ class SpeculativeCompiler:
         self._wake = threading.Event()
         self._thread = None
 
-    def hint(self, sizes):
-        """Enqueue world sizes worth pre-compiling (non-blocking)."""
+    def hint(self, candidates):
+        """Enqueue compile candidates (non-blocking, deduplicated).
+
+        A candidate is either a bare world size (devices, int) or a
+        ``(world_size, layout)`` tuple — the layout half is opaque
+        hashable data the owner's ``compile_fn`` understands (the
+        elastic trainer passes the solver's ``mesh_axes`` items, so a
+        PLANNED layout change pre-compiles alongside planned size
+        changes). Both forms dedup against everything already hinted
+        this generation."""
         fresh = []
         with self._lock:
             if self._cancel.is_set():
                 return
-            for size in sizes:
-                size = int(size)
-                if size > 0 and size not in self._seen:
-                    self._seen.add(size)
-                    self._pending.append(size)
-                    fresh.append(size)
+            for cand in candidates:
+                if isinstance(cand, tuple):
+                    key, size = tuple(cand), int(cand[0])
+                else:
+                    key = size = int(cand)
+                if size > 0 and key not in self._seen:
+                    self._seen.add(key)
+                    self._pending.append(key)
+                    fresh.append(key)
         if fresh:
             self.stats.inc("hinted", len(fresh))
             self._wake.set()
@@ -383,7 +394,7 @@ class SpeculativeCompiler:
             except Exception:
                 self.stats.inc("failed")
                 logger.warning(
-                    "speculative compile for world size %d failed",
+                    "speculative compile for candidate %s failed",
                     size,
                     exc_info=True,
                 )

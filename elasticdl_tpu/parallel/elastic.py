@@ -50,8 +50,9 @@ from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.log_utils import default_logger as logger
 from elasticdl_tpu.nn.model_api import apply_model, init_variables, split_variables
-from elasticdl_tpu.parallel import compile_plane, distributed
+from elasticdl_tpu.parallel import compile_plane, distributed, layout_solver
 from elasticdl_tpu.parallel.ring_attention import shard_map
+from elasticdl_tpu.parallel.sharding import tp_degree_candidates
 from elasticdl_tpu.training.step import (
     TrainState,
     accumulate_gradients,
@@ -67,6 +68,17 @@ from elasticdl_tpu.utils import profiling
 from elasticdl_tpu.common.escapable import (  # noqa: F401
     EscapeTimeout,
     escapable_call,
+)
+
+
+# resize pause distribution, scraped via /metrics: one observation per
+# establish(), labeled by whether the step fn came out of the
+# executable cache (a PLANNED resize pays state movement only) or had
+# to trace/compile
+_RESIZE_PAUSE = profiling.metrics.histogram(
+    "edl_resize_pause_seconds",
+    "establish() wall seconds, world re-form through step-fn acquire",
+    labels=("compile_phase",),
 )
 
 
@@ -757,6 +769,47 @@ def specs_use_axis(sharded_paths, axis):
     )
 
 
+def derive_model_profile(abstract_ts, state_specs):
+    """:class:`layout_solver.ModelProfile` from the abstract TrainState
+    and its spec tree — the layout solver's deterministic model input.
+
+    Everything here is a function of the model/optimizer structure
+    alone (shapes, dtypes, which leaves shard over ``model``), so every
+    process derives the identical profile and the solver's establish
+    picks agree without any exchange. The flop/activation terms are
+    RELATIVE proxies (6*N flops per example row, activation volume
+    proportional to the total model-sharded width); telemetry
+    calibration supplies real constants when ordering alone isn't
+    enough (layout_solver module docstring)."""
+    replicated_bytes = 0.0
+    tp_bytes = 0.0
+    model_dims = []
+
+    def visit(leaf, spec):
+        nonlocal replicated_bytes, tp_bytes
+        shape = tuple(leaf.shape)
+        nbytes = float(np.prod(shape)) * np.dtype(leaf.dtype).itemsize
+        axes = tuple(spec) if spec is not None else ()
+        if "model" in axes:
+            tp_bytes += nbytes
+            model_dims.append(int(shape[axes.index("model")]))
+        else:
+            replicated_bytes += nbytes
+
+    jax.tree_util.tree_map(visit, abstract_ts, state_specs)
+    param_count = sum(
+        float(np.prod(tuple(leaf.shape)))
+        for leaf in jax.tree_util.tree_leaves(abstract_ts.params)
+    )
+    return layout_solver.ModelProfile(
+        replicated_bytes=replicated_bytes,
+        tp_bytes=tp_bytes,
+        activation_bytes_per_row=4.0 * float(sum(model_dims)),
+        flops_per_row=6.0 * param_count,
+        tp_degrees=tp_degree_candidates(model_dims),
+    )
+
+
 def make_pjit_train_step(
     module,
     loss_fn,
@@ -988,6 +1041,7 @@ class ElasticDPTrainer:
         restore_provider=None,
         remat=False,
         mesh_axes_fn=None,
+        layout_planner=None,
     ):
         """``distributed_builder``: optional ``mesh -> (module,
         param_specs)`` hook for HBM-sharded parameters (the zoo's
@@ -1006,7 +1060,14 @@ class ElasticDPTrainer:
         model. None/absent means the flat 1-axis ``("data",)`` mesh.
         Raises at establish if the world size doesn't fit (the
         membership layer's world_size_multiple exists to prevent such
-        worlds from forming)."""
+        worlds from forming).
+
+        ``layout_planner``: optional
+        :class:`layout_solver.LayoutPlanner` — resizes then RE-SOLVE
+        the dp x tp layout instead of replaying the static
+        ``mesh_axes_fn`` (which becomes the planner's fallback until
+        the first establish derives the model profile). pjit-dense jobs
+        only; see docs/distributed.md "Layout re-solve"."""
         self._module = module
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -1016,6 +1077,11 @@ class ElasticDPTrainer:
         self._remat = remat
         self._accum_steps = max(1, accum_steps)
         self._builder = distributed_builder
+        self._planner = layout_planner
+        if layout_planner is not None:
+            if layout_planner.fallback_axes_fn is None:
+                layout_planner.fallback_axes_fn = mesh_axes_fn
+            mesh_axes_fn = layout_planner.axes_for
         self._mesh_axes_fn = mesh_axes_fn
         self.restore_provider = restore_provider
         self._sharded_paths = {}
@@ -1136,11 +1202,17 @@ class ElasticDPTrainer:
         # abandoned safely)
         self._shutdown_compile_helpers()
         t0 = _time.time()
+        old_layout = self._layout_fields()
+        # the planner must answer from the profile on EVERY process
+        # from its very first establish (see _maybe_derive_profile),
+        # so derive it before the mesh below is laid out
+        self._maybe_derive_profile(example_batch)
         profiling.events.emit(
             "resize_begin",
             epoch=spec.epoch,
             rank=spec.process_id,
             world_size=spec.num_processes,
+            layout=old_layout,
         )
         distributed.ensure_world(spec)
         t_world = _time.time()
@@ -1221,6 +1293,7 @@ class ElasticDPTrainer:
             t_compile - t_place,
             "cache hit" if cache_hit else "cache miss",
         )
+        compile_phase = "cache_hit" if cache_hit else "cache_miss"
         profiling.events.emit(
             "resize_end",
             epoch=spec.epoch,
@@ -1230,7 +1303,15 @@ class ElasticDPTrainer:
             init_s=round(t_init - t_world, 3),
             place_s=round(t_place - t_init, 3),
             compile_s=round(t_compile - t_place, 3),
-            compile_phase="cache_hit" if cache_hit else "cache_miss",
+            compile_phase=compile_phase,
+            cache_hit=bool(cache_hit),
+            resize_layout={
+                "old": old_layout,
+                "new": self._layout_fields(),
+            },
+        )
+        _RESIZE_PAUSE.observe(
+            t_compile - t0, compile_phase=compile_phase
         )
         self._start_speculative_compiler()
         if self.mirror_enabled():
@@ -1270,6 +1351,66 @@ class ElasticDPTrainer:
             self._mesh.devices.size,
             " (sharded params)" if self._sharded_paths else "",
         )
+
+    def _layout_fields(self):
+        """``{"dp", "tp", "microbatch"}`` of the CURRENT mesh — the
+        ``resize_layout`` event payload (None before any establish).
+        dp/tp come from the live mesh shape, so the fields are truthful
+        whether a planner, a static hook, or the flat default laid the
+        world out."""
+        if self._mesh is None:
+            return None
+        shape = dict(self._mesh.shape)
+        mb = None
+        if (
+            self._planner is not None
+            and self._planner.last_plan is not None
+        ):
+            mb = int(self._planner.last_plan.layout.microbatch)
+        elif self.default_minibatch_size:
+            mb = int(self.default_minibatch_size)
+        return {
+            "dp": int(shape.get("data", self._mesh.devices.size)),
+            "tp": int(shape.get("model", 1)),
+            "microbatch": mb,
+        }
+
+    def _maybe_derive_profile(self, example_batch):
+        """Feed the layout planner its model profile BEFORE the first
+        mesh is laid out. Determinism is the point: ``axes_for`` must
+        answer from the profile on EVERY process from its very first
+        establish — if a fresh joiner solved from the static fallback
+        while survivors solved from a profile, the consensus world
+        would form over diverging meshes. The probe is mesh-free
+        (builder(None) — the same convention the worker's pjit-dense
+        probe uses) and abstract (eval_shape): no device work, and the
+        numbers are a pure function of the model structure."""
+        planner = self._planner
+        if planner is None or planner.profile is not None:
+            return
+        if self._builder is None:
+            return
+        example = (
+            example_batch
+            if example_batch is not None
+            else self._last_local
+        )
+        if example is None:
+            return
+        try:
+            _, param_specs = self._builder(None)
+            sharded = collect_sharded_paths(param_specs)
+            if not specs_use_axis(sharded, "model"):
+                return
+            abstract = self._abstract_ts(example)
+            specs = build_state_specs(abstract, sharded)
+            planner.set_profile(derive_model_profile(abstract, specs))
+        except Exception:
+            logger.warning(
+                "layout-planner profile derivation failed; the static "
+                "mesh_axes fallback stays in effect",
+                exc_info=True,
+            )
 
     def _check_optimizer_coupling(self):
         """Refuse cross-leaf optimizers for sharded-parameter jobs.
@@ -1399,7 +1540,7 @@ class ElasticDPTrainer:
             entry.dispatch_memo[batch_sig] = fn
         return fn
 
-    def _world_mesh_for(self, n_devices):
+    def _world_mesh_for(self, n_devices, axes=None):
         """Hypothetical mesh over the first ``n_devices`` visible
         devices (same layout rule as :func:`build_world_mesh`), or None
         when that size cannot materialize on this backend. This is the
@@ -1409,6 +1550,10 @@ class ElasticDPTrainer:
         across a cross-host re-form), while a GROWTH past the visible
         set returns None and the hint is dropped — no backend can
         compile for devices it cannot see (docs/compile_plane.md).
+
+        ``axes`` overrides the layout hook — a layout-hinted
+        speculation targets the SOLVER's candidate layout for that
+        world, not whatever the hook would answer today.
 
         Runs on the speculative compiler's daemon thread against a live
         established backend, but the device enumeration still goes
@@ -1420,9 +1565,12 @@ class ElasticDPTrainer:
         if n_devices <= 0 or n_devices > devices.size:
             return None
         sub = devices[:n_devices]
-        axes = (
-            self._mesh_axes_fn(n_devices) if self._mesh_axes_fn else None
-        )
+        if axes is None:
+            axes = (
+                self._mesh_axes_fn(n_devices)
+                if self._mesh_axes_fn
+                else None
+            )
         if not axes:
             return Mesh(sub, ("data",))
         names = tuple(axes)
@@ -1431,10 +1579,18 @@ class ElasticDPTrainer:
             return None
         return Mesh(sub.reshape(sizes), names)
 
-    def _abstract_step_args(self, mesh, example):
+    def _abstract_step_args(
+        self, mesh, example, state_specs=None, state_abstract=None
+    ):
         """ShapeDtypeStruct argument tuple for AOT-lowering the step on
         ``mesh`` — shapes exactly as :meth:`train_step` will place them
-        (padded rows derive from the worker's fixed minibatch)."""
+        (padded rows derive from the worker's fixed minibatch).
+
+        The replicated plane passes neither optional: the live state's
+        shapes with replicated shardings. A layout-hinted speculation
+        passes BOTH — the hypothetical layout's spec tree and padded
+        abstract state — so the lowered signature carries each leaf's
+        NamedSharding exactly as the future establish will place it."""
         features, labels = example
         # shape metadata only — no host materialization of the leaf
         leaf0 = jax.tree_util.tree_leaves(features)[0]
@@ -1458,16 +1614,27 @@ class ElasticDPTrainer:
                 sharding=NamedSharding(mesh, spec),
             )
 
-        def state_abs(leaf):
+        def state_abs(leaf, spec=None):
             return jax.ShapeDtypeStruct(
                 tuple(leaf.shape),
                 leaf.dtype,
-                sharding=NamedSharding(mesh, P()),
+                sharding=NamedSharding(
+                    mesh, spec if spec is not None else P()
+                ),
             )
 
+        state_src = (
+            state_abstract if state_abstract is not None else self._ts
+        )
+        if state_specs is None:
+            state_tree = jax.tree_util.tree_map(state_abs, state_src)
+        else:
+            state_tree = jax.tree_util.tree_map(
+                state_abs, state_src, state_specs
+            )
         row_shard = NamedSharding(mesh, P(row_axes))
         return (
-            jax.tree_util.tree_map(state_abs, self._ts),
+            state_tree,
             jax.tree_util.tree_map(batch_abs, features),
             jax.tree_util.tree_map(batch_abs, labels),
             jax.ShapeDtypeStruct(
@@ -1477,40 +1644,92 @@ class ElasticDPTrainer:
             jax.random.PRNGKey(0),
         )
 
-    def _speculative_compile(self, n_devices):
+    def _speculative_compile(self, hint):
         """SpeculativeCompiler's compile_fn: build + AOT-compile the
-        step for a hypothetical ``n_devices`` world and park it in the
-        executable cache. Returns False (-> counted dropped) for sizes
-        that cannot materialize. Sharded-parameter jobs are skipped:
-        their spec/padding trees are world-specific establish-time
-        state, and their re-forms tear the backend down regardless —
-        the persistent cache is their amortization layer. Gated on
-        is_sharded (not _sharded_paths): a builder-based plane with an
-        empty spec tree still rebuilds via its builder per establish,
-        and speculative entries keyed on that module identity could
-        never pay off."""
-        if not self.compile_cache_enabled or self.is_sharded:
+        step for a hypothetical world and park it in the executable
+        cache. Returns False (-> counted dropped) for candidates that
+        cannot materialize.
+
+        ``hint`` is either a bare device count (the replicated plane's
+        historic form) or a ``(n_devices, axes_items)`` tuple from
+        :meth:`_layout_hints` — a solver candidate layout for that
+        world. Bare-size hints on sharded planes stay skipped (their
+        spec/padding trees are world-specific establish-time state,
+        and multi-process re-forms tear the backend down regardless —
+        the persistent cache is their amortization layer). LAYOUT
+        hints on the pjit dense plane are the exception that motivated
+        this PR: a single-backend resize survives the membership
+        change, so a pre-compiled (mesh, specs) entry turns the next
+        planned layout change into pure state movement."""
+        axes = None
+        if isinstance(hint, tuple):
+            n_devices, axes = int(hint[0]), dict(hint[1])
+        else:
+            n_devices = int(hint)
+        if not self.compile_cache_enabled:
+            return False
+        if axes is None and self.is_sharded:
+            return False
+        if axes is not None and not (
+            self._pjit_dense and self._builder is not None
+        ):
             return False
         example = self._spec_example or self._last_local
         if example is None or self._ts is None:
             return False
-        mesh = self._world_mesh_for(n_devices)
+        mesh = self._world_mesh_for(n_devices, axes=axes)
         if mesh is None:
             return False
+        if axes is None:
+            state_specs = None
+            state_abstract = None
+        else:
+            _, param_specs = self._builder(mesh)
+            sharded = collect_sharded_paths(param_specs)
+            abstract = self._abstract_ts(example)
+            state_specs = build_state_specs(abstract, sharded)
+            state_abstract = self._padded_abstract_for(
+                mesh, abstract, state_specs
+            )
+            if not self._specs_fit_mesh(mesh, state_abstract, state_specs):
+                return False  # layout the shards reject: drop the hint
         key = (
             compile_plane.mesh_signature(mesh),
-            self._step_config_signature(None),
+            self._step_config_signature(state_specs),
         )
         if self._exec_cache.get(key, count=False) is not None:
             return True  # already built (idempotent hint)
-        step = self._build_step_fn(mesh, None)
+        step = self._build_step_fn(mesh, state_specs)
         entry = self._exec_cache.put(key, step, speculative=True)
         compile_plane.aot_compile(
             entry,
-            self._abstract_step_args(mesh, example),
+            self._abstract_step_args(
+                mesh,
+                example,
+                state_specs=state_specs,
+                state_abstract=state_abstract,
+            ),
             stats=self._exec_cache.stats,
         )
         return True
+
+    @staticmethod
+    def _specs_fit_mesh(mesh, abstract_ts, state_specs):
+        """Quiet feasibility probe for a HYPOTHETICAL layout: every
+        sharded dim must divide its mesh axis. The establish-path twin
+        (:meth:`_check_shard_divisibility`) raises with operator
+        guidance; a speculation just drops the candidate."""
+        ok = [True]
+
+        def check(leaf, spec):
+            for dim, axis_name in enumerate(spec or ()):
+                if axis_name is None:
+                    continue
+                if leaf.shape[dim] % int(mesh.shape[axis_name]):
+                    ok[0] = False
+
+        jax.tree_util.tree_map(check, abstract_ts, state_specs)
+        return ok[0]
 
     def _start_speculative_compiler(self):
         if not (self.speculative_compile and self.compile_cache_enabled):
@@ -1521,18 +1740,58 @@ class ElasticDPTrainer:
         sc.start()
         self._spec_compiler = sc
         # default hints: one process joining or leaving the current
-        # world; the worker layers membership-service hints on top
+        # world; the worker layers membership-service hints on top.
+        # With a layout planner the CURRENT size hints too — its top-2
+        # covers the next-best layout at this size, so a planned
+        # same-size layout change (e.g. a budget-driven dp/tp shift)
+        # finds its executable pre-built
         n_dev = self._mesh.devices.size
         n_proc = self._spec.num_processes if self._spec else 1
         per_proc = max(1, n_dev // max(1, n_proc))
-        sc.hint([n_dev - per_proc, n_dev + per_proc])
+        sizes = [n_dev - per_proc, n_dev + per_proc]
+        if self._planner is not None and self._pjit_dense:
+            sizes.append(n_dev)
+        self.hint_world_sizes(sizes)
 
     def hint_world_sizes(self, device_counts):
         """Feed likely next world sizes (in DEVICES) to the speculative
         compiler; non-blocking, deduplicated, no-op when speculation is
-        off."""
-        if self._spec_compiler is not None:
-            self._spec_compiler.hint(device_counts)
+        off. With a layout planner, each size expands to the solver's
+        top-2 (world, layout) candidates — the layout-hinted
+        speculation of the ISSUE-20 tentpole."""
+        if self._spec_compiler is None:
+            return
+        hints = []
+        for n in device_counts:
+            n = int(n)
+            expanded = self._layout_hints(n)
+            hints.extend(expanded if expanded else [n])
+        self._spec_compiler.hint(hints)
+
+    def _layout_hints(self, n_devices):
+        """Solver candidates for ``n_devices`` as hashable
+        ``(n, axes_items)`` hint tuples (empty without a planner /
+        profile / pjit plane — the bare size is the hint then)."""
+        if self._planner is None or not self._pjit_dense:
+            return []
+        if n_devices <= 0:
+            return []
+        try:
+            layouts = self._planner.candidates(n_devices, top=2)
+        except Exception:
+            logger.debug(
+                "layout candidate enumeration failed for %d devices",
+                n_devices,
+                exc_info=True,
+            )
+            return []
+        return [
+            (
+                n_devices,
+                tuple(layout_solver.mesh_axes_for(lay).items()),
+            )
+            for lay in layouts
+        ]
 
     def _shutdown_compile_helpers(self):
         sc, self._spec_compiler = self._spec_compiler, None
@@ -1613,17 +1872,21 @@ class ElasticDPTrainer:
             for spec_path in self._paddable_spec_paths
         )
 
-    def _pad_abstract(self, abstract):
-        """This world's placement shapes: PadDim0-marked sharded leaves
-        whose dim 0 doesn't divide the mesh round UP (recorded in
-        ``_logical_dim0``); everything else passes through. Resets the
-        logical map — padding is a per-world property."""
+    def _padded_abstract_for(
+        self, mesh, abstract, state_specs, record=False
+    ):
+        """Placement shapes of ``abstract`` on ``mesh``: PadDim0-marked
+        sharded leaves whose dim 0 doesn't divide round UP; everything
+        else passes through. ``record=True`` replaces
+        ``_logical_dim0`` (padding is a per-world property) — establish
+        only; a layout-hinted speculation computes a HYPOTHETICAL
+        world's padding on the daemon thread and must not mutate the
+        live trainer's map."""
         from elasticdl_tpu.common.pytree import key_path_names
 
-        self._logical_dim0 = {}
+        logical = {}
         axes = {
-            name: int(self._mesh.shape[name])
-            for name in self._mesh.axis_names
+            name: int(mesh.shape[name]) for name in mesh.axis_names
         }
 
         def pad(key_path, leaf, spec):
@@ -1635,13 +1898,23 @@ class ElasticDPTrainer:
                 names
             ):
                 return leaf
-            self._logical_dim0[names] = int(leaf.shape[0])
+            logical[names] = int(leaf.shape[0])
             return jax.ShapeDtypeStruct(
                 (pad0,) + tuple(leaf.shape[1:]), leaf.dtype
             )
 
-        return jax.tree_util.tree_map_with_path(
-            pad, abstract, self._state_specs
+        padded = jax.tree_util.tree_map_with_path(
+            pad, abstract, state_specs
+        )
+        if record:
+            self._logical_dim0 = logical
+        return padded
+
+    def _pad_abstract(self, abstract):
+        """This world's placement shapes (recorded in
+        ``_logical_dim0``); see :meth:`_padded_abstract_for`."""
+        return self._padded_abstract_for(
+            self._mesh, abstract, self._state_specs, record=True
         )
 
     def _pad_tree_values(self, tree, padded_abstract):
@@ -1719,8 +1992,41 @@ class ElasticDPTrainer:
             # interchange below (sharded checkpoints) is the path.
             try:
                 with profiling.annotate("elastic/resize/relayout"):
+
+                    def move(target, leaf, sharding):
+                        t_shape = tuple(target.shape)
+                        if tuple(leaf.shape) != t_shape:
+                            # a PadDim0 leaf whose padded extent
+                            # differs between the two worlds: repad in
+                            # DEVICE space (slice the old world's inert
+                            # rows off / append zero rows) before the
+                            # relayout put. Rows past the logical
+                            # extent are zeros by construction, so the
+                            # move stays bitwise on the logical rows.
+                            if tuple(leaf.shape[1:]) != t_shape[1:]:
+                                raise ValueError(
+                                    "relayout shape mismatch beyond "
+                                    "dim 0: %r -> %r"
+                                    % (tuple(leaf.shape), t_shape)
+                                )
+                            t0, o0 = t_shape[0], leaf.shape[0]
+                            if t0 < o0:
+                                leaf = leaf[:t0]
+                            else:
+                                leaf = jnp.concatenate(
+                                    [
+                                        leaf,
+                                        jnp.zeros(
+                                            (t0 - o0,) + t_shape[1:],
+                                            leaf.dtype,
+                                        ),
+                                    ],
+                                    axis=0,
+                                )
+                        return jax.device_put(leaf, sharding)
+
                     self._ts = jax.tree_util.tree_map(
-                        jax.device_put, old_ts, shardings
+                        move, padded, old_ts, shardings
                     )
                 logger.info(
                     "pjit dense plane re-laid out onto the new mesh "

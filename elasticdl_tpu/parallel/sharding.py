@@ -68,6 +68,26 @@ def tp_param_specs():
     return {k: dict(v) for k, v in _TP_SUFFIX_SPECS.items()}
 
 
+def tp_degree_candidates(model_dim_sizes, max_degree=None):
+    """The tp degrees a model admits: every degree that divides EVERY
+    model-sharded dimension (attention heads, MLP hidden, vocab...),
+    ascending, 1 always included. The layout solver intersects these
+    with the divisors of the world size, so a solver-chosen degree can
+    never produce a shard the mesh rejects. Pure host math — safe on
+    the establish path and the speculative compiler's daemon thread."""
+    dims = sorted({int(d) for d in model_dim_sizes if int(d) > 0})
+    if not dims:
+        return (1,)
+    limit = dims[0]
+    if max_degree:
+        limit = min(limit, int(max_degree))
+    return tuple(
+        deg
+        for deg in range(1, limit + 1)
+        if all(d % deg == 0 for d in dims)
+    )
+
+
 def _drop_missing_axes(spec, mesh):
     axes = set(mesh.axis_names)
     return P(*(a if a in axes else None for a in spec))

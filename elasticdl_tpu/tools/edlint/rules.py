@@ -728,8 +728,18 @@ class JitPurityRule(Rule):
         "partial, or as a decorator) must not print/log, mutate "
         "globals or self, or touch queue/threading/sleep — the side "
         "effect fires once per TRACE, not per step, and host syncs "
-        "inside traced code wedge the device pipeline"
+        "inside traced code wedge the device pipeline; files in "
+        "JIT_FREE_FILES are pinned jit-free BY CONSTRUCTION (no jax "
+        "import at all)"
     )
+
+    # Files whose design contract is "no device computation, ever":
+    # the layout solver runs on every process's establish path and
+    # inside the speculative compiler's daemon thread, where a traced
+    # computation (or any jax import, which can initialize a backend)
+    # would wedge a resize. Flag the import, not just jit call sites —
+    # by-construction means the capability is absent, not unused.
+    JIT_FREE_FILES = ("elasticdl_tpu/parallel/layout_solver.py",)
 
     def _is_jit(self, func_expr):
         d = dotted(func_expr)
@@ -814,8 +824,43 @@ class JitPurityRule(Rule):
                 )
         return None
 
+    def _check_jit_free(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            names = ()
+            if isinstance(node, ast.Import):
+                names = tuple(a.name for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                names = (node.module or "",)
+            for mod in names:
+                if mod == "jax" or mod.startswith("jax."):
+                    out.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "file is pinned jit-free by construction "
+                            "(runs on the establish path and the "
+                            "speculative compiler's daemon thread); "
+                            "importing %r reintroduces the device "
+                            "plane" % mod,
+                        )
+                    )
+            if isinstance(node, ast.Call) and self._is_jit(node.func):
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "file is pinned jit-free by construction; "
+                        "jit/pjit call sites are design regressions "
+                        "here",
+                    )
+                )
+        return out
+
     def check(self, ctx):
         out = []
+        if ctx.path in self.JIT_FREE_FILES:
+            out.extend(self._check_jit_free(ctx))
         targets = []  # (jit-site node, resolved fn)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call) and self._is_jit(node.func):
@@ -891,16 +936,49 @@ class LocksetRaceRule(Rule):
     )
     SCOPE_FILES = ("elasticdl_tpu/utils/profiling.py",)
 
+    # Files pinned lock-free BY CONSTRUCTION: the layout solver must
+    # be safe to call from the establish path and the speculative
+    # compiler's daemon thread simultaneously — it achieves that by
+    # holding no synchronization at all (pure functions + a planner
+    # whose mutable fields are written only from the establish path).
+    # Any Lock/RLock/Condition construction here is a design
+    # regression: it creates the deadlock surface the file exists to
+    # avoid.
+    LOCK_FREE_FILES = ("elasticdl_tpu/parallel/layout_solver.py",)
+
     def _in_scope(self, path):
         return path in self.SCOPE_FILES or any(
             path.startswith(p) for p in self.SCOPE_PREFIXES
         )
 
+    def _check_lock_free(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted(node.func).rsplit(".", 1)[-1]
+            if tail in ("Lock", "RLock", "Condition", "Semaphore",
+                        "BoundedSemaphore"):
+                out.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "file is pinned lock-free by construction "
+                        "(called from the establish path AND the "
+                        "speculative compiler's daemon thread); "
+                        "constructing %s() here creates the deadlock "
+                        "surface the solver exists to avoid" % tail,
+                    )
+                )
+        return out
+
     def check(self, ctx):
+        out = []
+        if ctx.path in self.LOCK_FREE_FILES:
+            out.extend(self._check_lock_free(ctx))
         project = getattr(ctx, "project", None)
         if project is None or not self._in_scope(ctx.path):
-            return []
-        out = []
+            return out
         for race in project.races():
             # races() is program-wide; report each at its write site so
             # the per-file ratchet keys stay meaningful

@@ -32,6 +32,7 @@ keep real locks, so the graph stays our code's graph. Explicit
 use in tests.
 """
 
+import itertools
 import json
 import os
 import sys
@@ -70,10 +71,17 @@ class _Tracer:
 
     def __init__(self):
         self._mu = _REAL_LOCK()
-        # id(lock) -> {id(successor): "siteA -> siteB" edge provenance}
+        # Keys are lock.uid — a never-recycled per-lock serial — NOT
+        # id(lock): suites that tear down and relaunch components
+        # mid-test (the chaos drills) free locks whose addresses
+        # CPython promptly reuses for new ones, and an id-keyed graph
+        # would re-label a dead lock's edges with the newcomer's
+        # name/site at export, manufacturing phantom edges the static
+        # cross-check then flags as unsound.
+        # uid -> {successor uid: "siteA -> siteB" edge provenance}
         self._edges = {}
-        self._names = {}  # id(lock) -> display name
-        self._sites = {}  # id(lock) -> full creation site "path:line"
+        self._names = {}  # uid -> display name
+        self._sites = {}  # uid -> full creation site "path:line"
         self._local = _threading.local()
 
     def _held(self):
@@ -105,7 +113,7 @@ class _Tracer:
         Runs BEFORE the underlying acquire so the offending thread gets
         the exception instead of the deadlock."""
         held = self._held()
-        lid = id(lock)
+        lid = lock.uid
         if any(h is lock for h in held):
             return  # reentrant re-acquire: never a new ordering edge
         if not held:
@@ -117,7 +125,7 @@ class _Tracer:
             self._names[lid] = lock.name
             self._sites[lid] = getattr(lock, "site", "")
             for h in held:
-                cycle = self._path(lid, id(h))
+                cycle = self._path(lid, h.uid)
                 if cycle is not None:
                     provenance = [
                         self._edges[a].get(b, "?")
@@ -137,13 +145,13 @@ class _Tracer:
                         )
                     )
             for h in held:
-                self._edges.setdefault(id(h), {}).setdefault(
+                self._edges.setdefault(h.uid, {}).setdefault(
                     lid, "%s held at %s" % (h.name, site)
                 )
 
     def on_acquired(self, lock):
         self._held().append(lock)
-        lid = id(lock)
+        lid = lock.uid
         if lid not in self._names:
             # non-blocking try-acquires bypass before_acquire (they
             # cannot deadlock) but edges FROM the lock still need its
@@ -163,10 +171,15 @@ class _Tracer:
 class _TracedBase:
     _REENTRANT = False
 
+    # never-recycled lock serials: the tracer's graph identity.
+    # next() on a C-implemented count is atomic under the GIL.
+    _uids = itertools.count(1)
+
     def __init__(self, name=None, site=None):
         self._inner = (
             _REAL_RLOCK() if self._REENTRANT else _REAL_LOCK()
         )
+        self.uid = next(_TracedBase._uids)
         self.name = name or "%s@%s" % (
             type(self).__name__,
             _site(2),

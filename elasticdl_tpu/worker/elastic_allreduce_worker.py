@@ -215,6 +215,7 @@ class ElasticAllReduceWorker:
                 )
         builder = None
         mesh_axes_fn = None
+        layout_planner = None
         self._host_model_factory = None
         if (
             self._serving_only
@@ -258,6 +259,20 @@ class ElasticAllReduceWorker:
                         "mesh_axes"
                     ](n, **_extra)
                 )
+            # elastic layout re-solve (docs/distributed.md "Layout
+            # re-solve"): resizes on the pjit dense plane re-plan
+            # dp x tp x micro-batch per world size instead of
+            # replaying the launch layout. The zoo's static mesh_axes
+            # stays as the fallback until the first establish derives
+            # the model profile; the per-device budget comes from
+            # EDL_LAYOUT_MEM_BUDGET_MB (unset: every layout fits).
+            from elasticdl_tpu.parallel.layout_solver import (
+                LayoutPlanner,
+            )
+
+            layout_planner = LayoutPlanner(
+                fallback_axes_fn=mesh_axes_fn
+            )
         elif (
             "build_distributed_model" in zoo_module
             and "build_collective_model" not in zoo_module
@@ -338,6 +353,7 @@ class ElasticAllReduceWorker:
             distributed_builder=builder,
             remat=parse_remat(remat),
             mesh_axes_fn=mesh_axes_fn,
+            layout_planner=layout_planner,
         )
         # in-memory replica plane: bounded-staleness no-disk recovery
         # for the sharded leaves (parallel/elastic.py ShardMirror);

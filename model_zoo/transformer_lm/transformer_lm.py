@@ -404,6 +404,7 @@ def build_distributed_model(
     # model itself
     params.pop("shard_vocab", None)
     params.pop("tensor_parallel", None)
+    params.pop("min_tensor_parallel", None)
     if stages > 1:
         _check_pipeline_params(params)
         return PipelinedTransformerLM(
@@ -461,6 +462,7 @@ def param_shardings(
     pipeline_stages=0,
     shard_vocab=False,
     tensor_parallel=0,
+    min_tensor_parallel=0,
     **_params,
 ):
     """Stacked stage parameters shard leaf-dim-0 over ``pipe``; with
@@ -477,12 +479,23 @@ def param_shardings(
     instead of replicated everywhere (docs/distributed.md), unlocking
     dense models bigger than one device's HBM inside the elastic world.
 
+    ``min_tensor_parallel`` opts into the elastic LAYOUT RE-SOLVE
+    (docs/distributed.md "Layout re-solve") without freezing a degree:
+    the TP specs are emitted (routing the config onto the pjit dense
+    plane — the worker's ``_zoo_wants_pjit_dense`` probe sees the
+    ``model`` axis), the layout solver picks the actual degree per
+    world size, and the value acts as the tp FLOOR the master derives
+    its world-size multiple from — so a solver-chosen degree can never
+    form a world the mesh rejects. The TP spec patterns themselves are
+    degree-free (the mesh's model-axis size carries the degree), which
+    is what makes a per-resize degree change sound.
+
     ``mesh=None`` is the capability probe (does this config shard at
     all?) — answered from the params alone."""
     from jax.sharding import PartitionSpec as P
 
     specs = {}
-    tp = int(tensor_parallel)
+    tp = max(int(tensor_parallel), int(min_tensor_parallel))
     if tp > 1 and int(pipeline_stages) > 1:
         raise ValueError(
             "tensor_parallel and pipeline_stages cannot combine yet: "
@@ -507,10 +520,23 @@ def param_shardings(
     return specs or None
 
 
-def mesh_axes(n_devices, pipeline_stages=0, tensor_parallel=0, **_params):
-    """Zoo hook: mesh shape for this model's parallelism config."""
+def mesh_axes(
+    n_devices,
+    pipeline_stages=0,
+    tensor_parallel=0,
+    min_tensor_parallel=0,
+    **_params,
+):
+    """Zoo hook: mesh shape for this model's parallelism config.
+
+    With only ``min_tensor_parallel`` set this answers the FLOOR layout
+    (tp = the floor) — the static fallback the layout planner starts
+    from and re-solves away from once the model profile exists. The
+    master's world-size-multiple derivation keeps every formable world
+    a multiple of the floor, so the divisibility check here cannot
+    fire on the planner's watch."""
     stages = int(pipeline_stages)
-    tp = int(tensor_parallel)
+    tp = max(int(tensor_parallel), int(min_tensor_parallel))
     if tp > 1:
         if stages > 1:
             raise ValueError(
@@ -557,6 +583,7 @@ def custom_model(
     pipeline_stages=0,
     microbatches=0,
     tensor_parallel=0,
+    min_tensor_parallel=0,
     shard_vocab=False,
 ):
     return TransformerLM(

@@ -117,6 +117,7 @@ JAX_PLATFORMS=cpu EDL_LOCKTRACE=1 EDL_LOCKTRACE_EXPORT="$LOCK_EDGES" \
     tests/test_master_journal.py \
     tests/test_serving.py \
     tests/test_serving_batcher.py \
+    tests/test_layout_solver.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 echo "== static<->dynamic lock-graph cross-check =="

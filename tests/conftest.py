@@ -76,6 +76,7 @@ _LOCKTRACE_SUITES = {
     "test_master_journal",
     "test_serving",
     "test_serving_batcher",
+    "test_layout_solver",
 }
 
 

@@ -321,6 +321,36 @@ def test_speculative_compiler_dedups_hints():
     assert sorted(seen) == [4, 6]
 
 
+def test_speculative_compiler_accepts_layout_hints():
+    """ISSUE 20: hints may be (world_size, layout) tuples — the layout
+    half is opaque to the compiler but participates in dedup, so two
+    different layouts of ONE world size both compile, while a repeated
+    (world, layout) pair does not."""
+    seen = []
+    done = threading.Event()
+
+    def compile_fn(hint):
+        seen.append(hint)
+        if len(seen) >= 3:
+            done.set()
+        return True
+
+    lay_a = (8, (("data", 4), ("model", 2)))
+    lay_b = (8, (("data", 2), ("model", 4)))
+    sc = SpeculativeCompiler(compile_fn)
+    sc.start()
+    sc.hint([lay_a, lay_b, lay_a, 8, lay_b])
+    assert done.wait(timeout=10)
+    sc.shutdown()
+    assert sorted(seen, key=str) == sorted(
+        [lay_a, lay_b, 8], key=str
+    )
+    # zero/negative world sizes are dropped in either form
+    sc2 = SpeculativeCompiler(compile_fn)
+    sc2.hint([0, (0, (("data", 1),))])
+    assert sc2.pending_count() == 0
+
+
 # ---------------------------------------------------------------------------
 # step overlap: staged H2D equivalence + deferred metric collection
 # ---------------------------------------------------------------------------

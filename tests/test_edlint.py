@@ -731,6 +731,81 @@ def test_r7_sees_decorator_and_shard_map_forms(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# R7/R8 — the layout solver's by-construction pins (ISSUE 20): the
+# solver file must hold NO jax import and NO synchronization primitive,
+# because it runs on the establish path of every process and inside the
+# speculative compiler's daemon thread simultaneously.
+# ---------------------------------------------------------------------------
+
+SOLVER_PATH = "elasticdl_tpu/parallel/layout_solver.py"
+
+SOLVER_GOOD = """
+import math
+import os
+
+
+def solve(n_devices, degrees):
+    out = []
+    for tp in sorted(degrees):
+        if n_devices % tp == 0:
+            out.append((n_devices // tp, tp))
+    return out
+"""
+
+SOLVER_BAD_JIT = """
+import jax
+
+
+def score(layouts):
+    return jax.jit(lambda xs: xs)(layouts)
+"""
+
+SOLVER_BAD_LOCK = """
+import threading
+
+
+class Planner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def plan(self, n):
+        with self._lock:
+            return n
+"""
+
+
+def test_r7_pins_layout_solver_jit_free(tmp_path):
+    bad = _lint(tmp_path, SOLVER_BAD_JIT, relpath=SOLVER_PATH)
+    assert "R7" in _rules_of(bad)
+    msgs = " | ".join(v.message for v in bad if v.rule == "R7")
+    assert "jit-free by construction" in msgs
+    # both the import and the jit call site are findings
+    assert "importing" in msgs and "call sites" in msgs
+    assert not _lint(tmp_path, SOLVER_GOOD, relpath=SOLVER_PATH)
+    # the SAME source anywhere else is fine — the pin is path-scoped
+    assert not _lint(
+        tmp_path, SOLVER_BAD_JIT, relpath="elasticdl_tpu/fixture.py"
+    )
+
+
+def test_r8_pins_layout_solver_lock_free(tmp_path):
+    bad = _lint(tmp_path, SOLVER_BAD_LOCK, relpath=SOLVER_PATH)
+    assert "R8" in _rules_of(bad)
+    msgs = " | ".join(v.message for v in bad if v.rule == "R8")
+    assert "lock-free by construction" in msgs
+    assert not _lint(tmp_path, SOLVER_GOOD, relpath=SOLVER_PATH)
+
+
+def test_real_layout_solver_satisfies_its_own_pins():
+    """The shipped solver passes the by-construction checks (no jax
+    import, no lock), so the pins gate regressions, not the present."""
+    with open(os.path.join(ROOT, SOLVER_PATH)) as f:
+        src = f.read()
+    assert "import jax" not in src
+    assert "threading" not in src
+
+
+# ---------------------------------------------------------------------------
 # R5 cross-file: the PR-4 ledger-lock chain THROUGH A MODULE BOUNDARY
 # ---------------------------------------------------------------------------
 
